@@ -1,0 +1,29 @@
+"""karpenter_core_tpu — a TPU-native cluster-autoscaling framework.
+
+A from-scratch rebuild of the capabilities of ``sigs.k8s.io/karpenter``
+(reference: /root/reference) in which the two hot combinatorial loops —
+the provisioning scheduler's first-fit-decreasing bin-pack
+(``pkg/controllers/provisioning/scheduling/scheduler.go:208``) and the
+consolidation candidate sweep
+(``pkg/controllers/disruption/multinodeconsolidation.go:110``) — are
+reformulated as batched pod-class × InstanceType tensor assignment in JAX,
+executed on TPU, while the surrounding control plane (cluster state,
+controllers, cloud-provider abstraction, lifecycle) is a Python asyncio
+rebuild of the reference's Go reconcilers.
+
+Layout (mirrors SURVEY.md §7):
+  api/            CRD-equivalent object model (NodePool, NodeClaim, Pod, Node)
+  scheduling/     L1 requirements/taints algebra (host side)
+  utils/          resource arithmetic, pod predicates, pdb, disruption cost
+  ops/            pure jittable JAX ops: compat matmuls, fit masks, FFD scan
+  models/         full solver programs (provisioning solve, consolidation sweep)
+  solver/         host<->device boundary: vocab interning, snapshot codec, Solver API
+  parallel/       device mesh + sharding of the solve across ICI
+  state/          cluster state cache
+  cloudprovider/  provider interface + kwok bench provider + test fake
+  kube/           in-memory apiserver-equivalent object store with watches
+  controllers/    provisioning / disruption / lifecycle / termination reconcilers
+  operator/       options, operator runtime
+"""
+
+__version__ = "0.1.0"

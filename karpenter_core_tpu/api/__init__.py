@@ -1,0 +1,1 @@
+from karpenter_core_tpu.api import labels  # noqa: F401

@@ -1,0 +1,318 @@
+"""Standalone kubernetes-shaped object model.
+
+The framework is self-contained (no kube-apiserver in the loop for tests and
+benchmarks — the in-memory ``kube`` store plays envtest's role, reference:
+pkg/test/environment.go:60-80), so the core API machinery objects the
+reference gets from client-go are defined here as plain dataclasses.
+
+Resource quantities are float64 (cpu in cores, memory/storage in bytes).
+The reference uses apimachinery's infinite-precision Quantity; every value the
+scheduler actually compares is well inside float64's 2^53 integer range.
+"""
+from __future__ import annotations
+
+import itertools
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+# ---------------------------------------------------------------------------
+# Quantities
+
+_QUANTITY_RE = re.compile(r"^([+-]?[0-9.]+(?:[eE][+-]?[0-9]+)?)([A-Za-z]*)$")
+
+_SUFFIX = {
+    "": 1.0,
+    "m": 1e-3,
+    "k": 1e3,
+    "M": 1e6,
+    "G": 1e9,
+    "T": 1e12,
+    "P": 1e15,
+    "E": 1e18,
+    "Ki": 2.0**10,
+    "Mi": 2.0**20,
+    "Gi": 2.0**30,
+    "Ti": 2.0**40,
+    "Pi": 2.0**50,
+    "Ei": 2.0**60,
+}
+
+
+def parse_quantity(value: "str | int | float") -> float:
+    """Parse a kubernetes quantity string ('100m', '1Gi', '2') to a float."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    m = _QUANTITY_RE.match(value.strip())
+    if not m:
+        raise ValueError(f"cannot parse quantity {value!r}")
+    number, suffix = m.groups()
+    if suffix not in _SUFFIX:
+        raise ValueError(f"unknown quantity suffix {suffix!r} in {value!r}")
+    return float(number) * _SUFFIX[suffix]
+
+
+# Resource names (mirror corev1.ResourceName values)
+RESOURCE_CPU = "cpu"
+RESOURCE_MEMORY = "memory"
+RESOURCE_PODS = "pods"
+RESOURCE_EPHEMERAL_STORAGE = "ephemeral-storage"
+
+ResourceList = dict  # dict[str, float]
+
+
+def resource_list(**kwargs) -> ResourceList:
+    """Build a ResourceList from keyword args; 'memory'/'ephemeral_storage' keys normalized."""
+    out = {}
+    for k, v in kwargs.items():
+        out[k.replace("_", "-")] = parse_quantity(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Metadata
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid() -> str:
+    return f"uid-{next(_uid_counter):08d}"
+
+
+@dataclass
+class OwnerReference:
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = False
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=new_uid)
+    labels: dict = field(default_factory=dict)
+    annotations: dict = field(default_factory=dict)
+    finalizers: list = field(default_factory=list)
+    owner_references: list = field(default_factory=list)
+    creation_timestamp: float = field(default_factory=time.time)
+    deletion_timestamp: Optional[float] = None
+    resource_version: int = 0
+    generation: int = 1
+
+
+# ---------------------------------------------------------------------------
+# Taints & tolerations
+
+TAINT_EFFECT_NO_SCHEDULE = "NoSchedule"
+TAINT_EFFECT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
+TAINT_EFFECT_NO_EXECUTE = "NoExecute"
+
+TOLERATION_OP_EXISTS = "Exists"
+TOLERATION_OP_EQUAL = "Equal"
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    effect: str
+    value: str = ""
+
+    def __str__(self) -> str:
+        return f"{self.key}={self.value}:{self.effect}" if self.value else f"{self.key}:{self.effect}"
+
+
+@dataclass(frozen=True)
+class Toleration:
+    """Mirror of corev1.Toleration.ToleratesTaint semantics."""
+
+    key: str = ""
+    operator: str = TOLERATION_OP_EQUAL
+    value: str = ""
+    effect: str = ""
+    toleration_seconds: Optional[float] = None
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator == TOLERATION_OP_EXISTS:
+            return True
+        if self.operator in ("", TOLERATION_OP_EQUAL):
+            return self.value == taint.value
+        return False  # unknown operators never tolerate (corev1 semantics)
+
+
+# ---------------------------------------------------------------------------
+# Node selector / affinity
+
+@dataclass(frozen=True)
+class NodeSelectorRequirement:
+    key: str
+    operator: str  # In | NotIn | Exists | DoesNotExist | Gt | Lt
+    values: tuple = ()
+    min_values: Optional[int] = None  # NodePool flexibility extension
+
+
+@dataclass(frozen=True)
+class NodeSelectorTerm:
+    match_expressions: tuple = ()  # tuple[NodeSelectorRequirement]
+
+
+@dataclass(frozen=True)
+class PreferredSchedulingTerm:
+    weight: int
+    preference: NodeSelectorTerm
+
+
+@dataclass
+class NodeAffinity:
+    required: list = field(default_factory=list)  # list[NodeSelectorTerm] (OR'd)
+    preferred: list = field(default_factory=list)  # list[PreferredSchedulingTerm]
+
+
+@dataclass(frozen=True)
+class LabelSelectorRequirement:
+    key: str
+    operator: str  # In | NotIn | Exists | DoesNotExist
+    values: tuple = ()
+
+
+@dataclass(frozen=True)
+class LabelSelector:
+    match_labels: tuple = ()  # tuple[(key, value)]
+    match_expressions: tuple = ()  # tuple[LabelSelectorRequirement]
+
+    def matches(self, labels: dict) -> bool:
+        for k, v in self.match_labels:
+            if labels.get(k) != v:
+                return False
+        for expr in self.match_expressions:
+            has = expr.key in labels
+            val = labels.get(expr.key)
+            if expr.operator == "In":
+                if not has or val not in expr.values:
+                    return False
+            elif expr.operator == "NotIn":
+                if has and val in expr.values:
+                    return False
+            elif expr.operator == "Exists":
+                if not has:
+                    return False
+            elif expr.operator == "DoesNotExist":
+                if has:
+                    return False
+        return True
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    topology_key: str
+    label_selector: Optional[LabelSelector] = None
+    namespaces: tuple = ()
+
+
+@dataclass(frozen=True)
+class WeightedPodAffinityTerm:
+    weight: int
+    pod_affinity_term: PodAffinityTerm
+
+
+@dataclass
+class PodAffinity:
+    required: list = field(default_factory=list)  # list[PodAffinityTerm]
+    preferred: list = field(default_factory=list)  # list[WeightedPodAffinityTerm]
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAffinity] = None
+
+
+@dataclass(frozen=True)
+class TopologySpreadConstraint:
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: str  # DoNotSchedule | ScheduleAnyway
+    label_selector: Optional[LabelSelector] = None
+    min_domains: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# Pod
+
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    # Aggregated resource requests (the reference computes this from container
+    # specs via resources.RequestsForPods, reference:
+    # pkg/utils/resources/resources.go:28; tests construct it directly).
+    resource_requests: ResourceList = field(default_factory=dict)
+    node_selector: dict = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: list = field(default_factory=list)
+    topology_spread_constraints: list = field(default_factory=list)
+    host_ports: list = field(default_factory=list)  # list[(ip, port, protocol)]
+    priority: int = 0
+    priority_class_name: str = ""
+    preemption_policy: str = "PreemptLowerPriority"
+    scheduling_gates: list = field(default_factory=list)
+    node_name: str = ""
+    phase: str = POD_PENDING
+    # conditions: list of (type, status, reason)
+    conditions: list = field(default_factory=list)
+    is_daemonset: bool = False
+    is_mirror: bool = False
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+# ---------------------------------------------------------------------------
+# Node
+
+@dataclass
+class NodeStatus:
+    capacity: ResourceList = field(default_factory=dict)
+    allocatable: ResourceList = field(default_factory=dict)
+    conditions: list = field(default_factory=list)  # list[(type, status)]
+    phase: str = ""
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    provider_id: str = ""
+    taints: list = field(default_factory=list)
+    unschedulable: bool = False
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def labels(self) -> dict:
+        return self.metadata.labels
+
+    def ready(self) -> bool:
+        return any(t == "Ready" and s == "True" for t, s, *_ in self.status.conditions)

@@ -1,0 +1,3 @@
+from karpenter_core_tpu.scheduling.requirement import Requirement  # noqa: F401
+from karpenter_core_tpu.scheduling.requirements import Requirements  # noqa: F401
+from karpenter_core_tpu.scheduling.taints import Taints, KNOWN_EPHEMERAL_TAINTS  # noqa: F401

@@ -1,0 +1,74 @@
+"""Resource-list arithmetic (reference: pkg/utils/resources/resources.go).
+
+ResourceLists are plain dict[str, float]; missing keys read as zero, matching
+apimachinery Quantity map semantics.
+"""
+from __future__ import annotations
+
+from typing import Iterable
+
+from karpenter_core_tpu.api.objects import RESOURCE_PODS, Pod
+
+
+def merge(*lists: dict) -> dict:
+    """Sum resource lists (resources.go:50-63)."""
+    out: dict = {}
+    for rl in lists:
+        for name, qty in rl.items():
+            out[name] = out.get(name, 0.0) + qty
+    return out
+
+
+def merge_into(dest: dict, src: dict) -> dict:
+    """In-place sum (resources.go:68-79)."""
+    for name, qty in src.items():
+        dest[name] = dest.get(name, 0.0) + qty
+    return dest
+
+
+def subtract(lhs: dict, rhs: dict) -> dict:
+    """lhs - rhs over lhs's keys (resources.go:81-93)."""
+    out = dict(lhs)
+    for name in lhs:
+        out[name] = lhs[name] - rhs.get(name, 0.0)
+    return out
+
+
+def requests_for_pods(*pods: Pod) -> dict:
+    """Total requests plus the implicit 'pods' count resource
+    (resources.go:28-37)."""
+    out = merge(*(p.resource_requests for p in pods))
+    out[RESOURCE_PODS] = out.get(RESOURCE_PODS, 0.0) + float(len(pods))
+    return out
+
+
+def fits(candidate: dict, total: dict) -> bool:
+    """candidate <= total pointwise; any negative total never fits
+    (resources.go:217-231)."""
+    if any_negative(total):
+        return False
+    return all(qty <= total.get(name, 0.0) for name, qty in candidate.items())
+
+
+def cmp_max(*lists: dict) -> dict:
+    """Pointwise max (resources.go MaxResources)."""
+    out: dict = {}
+    for rl in lists:
+        for name, qty in rl.items():
+            if qty > out.get(name, float("-inf")):
+                out[name] = qty
+    return out
+
+
+def any_negative(rl: dict) -> bool:
+    return any(q < 0 for q in rl.values())
+
+
+def is_zero(rl: dict) -> bool:
+    return all(q == 0 for q in rl.values())
+
+
+def to_string(rl: dict) -> str:
+    if not rl:
+        return "{}"
+    return ", ".join(f"{k}={v:g}" for k, v in sorted(rl.items()))

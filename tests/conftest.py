@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax loads.
+
+Real TPU hardware (one chip under axon) is reserved for bench.py; the test
+suite exercises the multi-chip sharding paths on a virtual CPU mesh the same
+way the driver's dryrun does.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

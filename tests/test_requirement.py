@@ -1,0 +1,154 @@
+"""Property tests for the Requirement set algebra.
+
+Strategy: instead of porting the reference's table tests
+(pkg/scheduling/requirement_test.go), every operator pair is checked
+against brute-force set semantics over a closed universe — r1 ∩ r2 must
+agree with pointwise has() for every probe value, including values outside
+the universe and integer probes for Gt/Lt.
+"""
+import itertools
+import random
+
+import pytest
+
+from karpenter_core_tpu.scheduling.requirement import (
+    MAX_LEN,
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    Requirement,
+)
+
+UNIVERSE = ["A", "B", "C", "1", "2", "3", "5", "10", "100", "zz"]
+PROBES = UNIVERSE + ["D", "0", "4", "7", "11", "99", "101", "-1", "x/y"]
+
+
+def gen_requirements(key="key"):
+    """A representative spread of requirements across all operators."""
+    out = []
+    value_sets = [
+        [],
+        ["A"],
+        ["A", "B"],
+        ["1", "2", "3"],
+        ["B", "C", "10"],
+        ["1", "100"],
+        UNIVERSE,
+    ]
+    for vs in value_sets:
+        if vs:
+            out.append(Requirement.new(key, OP_IN, vs))
+        out.append(Requirement.new(key, OP_NOT_IN, vs))
+    out.append(Requirement.new(key, OP_EXISTS))
+    out.append(Requirement.new(key, OP_DOES_NOT_EXIST))
+    for bound in ["0", "1", "2", "9", "100"]:
+        out.append(Requirement.new(key, OP_GT, [bound]))
+        out.append(Requirement.new(key, OP_LT, [bound]))
+    return out
+
+
+class TestOperator:
+    def test_in(self):
+        r = Requirement.new("k", OP_IN, ["A", "B"])
+        assert r.operator() == OP_IN
+        assert r.length() == 2
+        assert r.has("A") and r.has("B") and not r.has("C")
+
+    def test_not_in(self):
+        r = Requirement.new("k", OP_NOT_IN, ["A"])
+        assert r.operator() == OP_NOT_IN
+        assert r.length() == MAX_LEN - 1
+        assert not r.has("A") and r.has("B")
+
+    def test_exists(self):
+        r = Requirement.new("k", OP_EXISTS)
+        assert r.operator() == OP_EXISTS
+        assert r.length() == MAX_LEN
+        assert r.has("anything")
+
+    def test_does_not_exist(self):
+        r = Requirement.new("k", OP_DOES_NOT_EXIST)
+        assert r.operator() == OP_DOES_NOT_EXIST
+        assert r.length() == 0
+        assert not r.has("anything")
+
+    def test_gt(self):
+        r = Requirement.new("k", OP_GT, ["5"])
+        # Gt/Lt read as Exists-with-bounds (requirement.go:224-235)
+        assert r.operator() == OP_EXISTS
+        assert r.has("6") and r.has("100")
+        assert not r.has("5") and not r.has("4")
+        assert not r.has("abc")  # non-integers excluded by bounds
+
+    def test_lt(self):
+        r = Requirement.new("k", OP_LT, ["5"])
+        assert r.has("4") and r.has("0")
+        assert not r.has("5") and not r.has("6")
+        assert not r.has("abc")
+
+    def test_empty_in_is_does_not_exist(self):
+        assert Requirement.new("k", OP_IN, []).operator() == OP_DOES_NOT_EXIST
+
+    def test_label_normalization(self):
+        r = Requirement.new("beta.kubernetes.io/arch", OP_IN, ["amd64"])
+        assert r.key == "kubernetes.io/arch"
+
+
+class TestIntersectionProperty:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_pointwise_semantics(self, seed):
+        reqs = gen_requirements()
+        rng = random.Random(seed)
+        pairs = list(itertools.product(reqs, reqs))
+        rng.shuffle(pairs)
+        for r1, r2 in pairs:
+            inter = r1.intersection(r2)
+            for v in PROBES:
+                expected = r1.has(v) and r2.has(v)
+                # The closed intersection may be lossy only in one documented
+                # way: concrete (non-complement) results drop Gt/Lt bounds
+                # after filtering known values (requirement.go:183-186), which
+                # is exact for values in the explicit set. So has() must agree
+                # everywhere.
+                assert inter.has(v) == expected, (
+                    f"({r1!r}) ∩ ({r2!r}) at {v!r}: "
+                    f"got {inter.has(v)}, want {expected}"
+                )
+
+    def test_commutative_cardinality(self):
+        reqs = gen_requirements()
+        for r1, r2 in itertools.product(reqs, reqs):
+            a = r1.intersection(r2)
+            b = r2.intersection(r1)
+            assert a.length() == b.length(), f"{r1!r} vs {r2!r}"
+            assert a.operator() == b.operator()
+
+    def test_crossed_bounds_become_does_not_exist(self):
+        gt = Requirement.new("k", OP_GT, ["5"])
+        lt = Requirement.new("k", OP_LT, ["3"])
+        inter = gt.intersection(lt)
+        assert inter.operator() == OP_DOES_NOT_EXIST
+        assert inter.length() == 0
+
+    def test_min_values_max_wins(self):
+        r1 = Requirement.new("k", OP_IN, ["A", "B", "C"], min_values=2)
+        r2 = Requirement.new("k", OP_IN, ["A", "B"], min_values=3)
+        assert r1.intersection(r2).min_values == 3
+
+
+class TestAnyValue:
+    def test_in(self):
+        assert Requirement.new("k", OP_IN, ["A"]).any_value() == "A"
+
+    def test_not_in_avoids_excluded(self):
+        r = Requirement.new("k", OP_NOT_IN, ["0", "1"])
+        v = r.any_value()
+        assert v not in ("0", "1")
+        assert r.has(v)
+
+    def test_gt_bound_respected(self):
+        r = Requirement.new("k", OP_GT, ["10"])
+        assert int(r.any_value()) > 10

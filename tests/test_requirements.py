@@ -1,0 +1,279 @@
+"""Tests for Requirements (keyed sets) — Add/Compatible/Intersects rules
+mirroring pkg/scheduling/requirements_test.go behavior."""
+from karpenter_core_tpu.api import labels as apilabels
+from karpenter_core_tpu.api.objects import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+    PreferredSchedulingTerm,
+)
+from karpenter_core_tpu.scheduling.requirement import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_IN,
+    OP_NOT_IN,
+    Requirement,
+)
+from karpenter_core_tpu.scheduling.requirements import (
+    ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+    Requirements,
+)
+
+ZONE = apilabels.LABEL_TOPOLOGY_ZONE
+
+
+def reqs(*items) -> Requirements:
+    return Requirements([Requirement.new(k, op, vals) for k, op, vals in items])
+
+
+class TestAdd:
+    def test_add_intersects_on_collision(self):
+        r = reqs((ZONE, OP_IN, ["a", "b"]))
+        r.add(Requirement.new(ZONE, OP_IN, ["b", "c"]))
+        assert r.get(ZONE).sorted_values() == ["b"]
+
+    def test_add_disjoint_becomes_empty(self):
+        r = reqs((ZONE, OP_IN, ["a"]))
+        r.add(Requirement.new(ZONE, OP_IN, ["b"]))
+        assert r.get(ZONE).length() == 0
+        assert r.get(ZONE).operator() == OP_DOES_NOT_EXIST
+
+    def test_undefined_key_reads_as_exists(self):
+        r = Requirements()
+        assert r.get("anything").operator() == OP_EXISTS
+
+
+class TestIntersects:
+    def test_overlap_ok(self):
+        a = reqs((ZONE, OP_IN, ["a", "b"]))
+        b = reqs((ZONE, OP_IN, ["b", "c"]))
+        assert not a.intersects(b)
+
+    def test_disjoint_fails(self):
+        a = reqs((ZONE, OP_IN, ["a"]))
+        b = reqs((ZONE, OP_IN, ["b"]))
+        assert a.intersects(b)
+
+    def test_disjoint_keys_ignored(self):
+        a = reqs((ZONE, OP_IN, ["a"]))
+        b = reqs(("other", OP_IN, ["b"]))
+        assert not a.intersects(b)
+
+    def test_both_negative_empty_intersection_ok(self):
+        # NotIn vs DoesNotExist: empty intersection allowed when both negative
+        # (requirements.go:288-296)
+        a = reqs((ZONE, OP_DOES_NOT_EXIST, []))
+        b = reqs((ZONE, OP_NOT_IN, ["a"]))
+        assert not a.intersects(b)
+
+    def test_positive_vs_does_not_exist_fails(self):
+        a = reqs((ZONE, OP_IN, ["a"]))
+        b = reqs((ZONE, OP_DOES_NOT_EXIST, []))
+        assert a.intersects(b)
+
+
+class TestCompatible:
+    def test_well_known_undefined_allowed(self):
+        node = Requirements()
+        pod = reqs((ZONE, OP_IN, ["a"]))
+        assert node.is_compatible(pod, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)
+
+    def test_custom_undefined_denied(self):
+        node = Requirements()
+        pod = reqs(("mycompany.io/team", OP_IN, ["infra"]))
+        assert not node.is_compatible(pod, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)
+
+    def test_custom_undefined_negative_allowed(self):
+        node = Requirements()
+        pod = reqs(("mycompany.io/team", OP_NOT_IN, ["infra"]))
+        assert node.is_compatible(pod, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)
+
+    def test_custom_defined_intersecting_allowed(self):
+        node = reqs(("mycompany.io/team", OP_IN, ["infra", "web"]))
+        pod = reqs(("mycompany.io/team", OP_IN, ["infra"]))
+        assert node.is_compatible(pod, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)
+
+    def test_compatible_is_directional(self):
+        # node side defines; pod side undefined custom key is fine
+        node = reqs(("mycompany.io/team", OP_IN, ["infra"]))
+        pod = Requirements()
+        assert node.is_compatible(pod, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)
+
+
+class TestPodRequirements:
+    def test_node_selector(self):
+        pod = Pod(node_selector={ZONE: "a"})
+        r = Requirements.from_pod(pod)
+        assert r.get(ZONE).sorted_values() == ["a"]
+
+    def test_required_affinity_first_term(self):
+        pod = Pod(
+            affinity=Affinity(
+                node_affinity=NodeAffinity(
+                    required=[
+                        NodeSelectorTerm(
+                            match_expressions=(
+                                NodeSelectorRequirement(ZONE, OP_IN, ("a", "b")),
+                            )
+                        ),
+                        NodeSelectorTerm(
+                            match_expressions=(
+                                NodeSelectorRequirement(ZONE, OP_IN, ("c",)),
+                            )
+                        ),
+                    ]
+                )
+            )
+        )
+        r = Requirements.from_pod(pod)
+        # only the first term is used; the relaxation loop pops terms
+        assert r.get(ZONE).sorted_values() == ["a", "b"]
+
+    def test_preferred_promoted_when_no_required(self):
+        pod = Pod(
+            affinity=Affinity(
+                node_affinity=NodeAffinity(
+                    preferred=[
+                        PreferredSchedulingTerm(
+                            weight=1,
+                            preference=NodeSelectorTerm(
+                                match_expressions=(
+                                    NodeSelectorRequirement(ZONE, OP_IN, ("low",)),
+                                )
+                            ),
+                        ),
+                        PreferredSchedulingTerm(
+                            weight=10,
+                            preference=NodeSelectorTerm(
+                                match_expressions=(
+                                    NodeSelectorRequirement(ZONE, OP_IN, ("high",)),
+                                )
+                            ),
+                        ),
+                    ]
+                )
+            )
+        )
+        r = Requirements.from_pod(pod)
+        assert r.get(ZONE).sorted_values() == ["high"]
+
+    def test_preferred_folds_even_with_required(self):
+        # heaviest preferred term is treated as required unconditionally;
+        # the relaxation loop removes it later (requirements.go:96-103)
+        pod = Pod(
+            affinity=Affinity(
+                node_affinity=NodeAffinity(
+                    required=[
+                        NodeSelectorTerm(
+                            match_expressions=(
+                                NodeSelectorRequirement("inst", OP_IN, ("t1",)),
+                            )
+                        )
+                    ],
+                    preferred=[
+                        PreferredSchedulingTerm(
+                            weight=5,
+                            preference=NodeSelectorTerm(
+                                match_expressions=(
+                                    NodeSelectorRequirement(ZONE, OP_IN, ("a",)),
+                                )
+                            ),
+                        )
+                    ],
+                )
+            )
+        )
+        r = Requirements.from_pod(pod)
+        assert r.get(ZONE).sorted_values() == ["a"]
+        assert r.get("inst").sorted_values() == ["t1"]
+
+    def test_to_labels_excludes_well_known(self):
+        r = Requirements(
+            [
+                Requirement.new(ZONE, OP_IN, ["a"]),
+                Requirement.new("mycompany.io/team", OP_IN, ["infra"]),
+            ]
+        )
+        assert r.to_labels() == {"mycompany.io/team": "infra"}
+
+    def test_strict_ignores_preferred(self):
+        pod = Pod(
+            affinity=Affinity(
+                node_affinity=NodeAffinity(
+                    preferred=[
+                        PreferredSchedulingTerm(
+                            weight=1,
+                            preference=NodeSelectorTerm(
+                                match_expressions=(
+                                    NodeSelectorRequirement(ZONE, OP_IN, ("x",)),
+                                )
+                            ),
+                        )
+                    ]
+                )
+            )
+        )
+        r = Requirements.from_pod_strict(pod)
+        assert not r.has(ZONE)
+
+
+class TestTaints:
+    def test_tolerates(self):
+        from karpenter_core_tpu.api.objects import Taint, Toleration
+        from karpenter_core_tpu.scheduling.taints import Taints
+
+        taints = Taints([Taint(key="gpu", value="true", effect="NoSchedule")])
+        assert taints.tolerates(Pod())  # fails: no toleration
+        assert not taints.tolerates(
+            Pod(tolerations=[Toleration(key="gpu", operator="Exists")])
+        )
+        assert not taints.tolerates(
+            Pod(
+                tolerations=[
+                    Toleration(key="gpu", operator="Equal", value="true")
+                ]
+            )
+        )
+        assert taints.tolerates(
+            Pod(
+                tolerations=[
+                    Toleration(key="gpu", operator="Equal", value="false")
+                ]
+            )
+        )
+        # wildcard toleration (empty key + Exists)
+        assert not taints.tolerates(
+            Pod(tolerations=[Toleration(operator="Exists")])
+        )
+
+
+class TestResources:
+    def test_arithmetic(self):
+        from karpenter_core_tpu.utils import resources
+
+        a = {"cpu": 1.0, "memory": 2.0}
+        b = {"cpu": 0.5, "pods": 1.0}
+        assert resources.merge(a, b) == {"cpu": 1.5, "memory": 2.0, "pods": 1.0}
+        assert resources.subtract(a, b) == {"cpu": 0.5, "memory": 2.0}
+        assert resources.fits({"cpu": 1.0}, {"cpu": 1.0, "memory": 5})
+        assert not resources.fits({"cpu": 1.1}, {"cpu": 1.0})
+        # negative totals never fit (resources.go:217-222)
+        assert not resources.fits({}, {"cpu": -1.0})
+
+    def test_requests_for_pods_adds_pod_count(self):
+        from karpenter_core_tpu.utils import resources
+
+        pods = [Pod(resource_requests={"cpu": 1.0}) for _ in range(3)]
+        total = resources.requests_for_pods(*pods)
+        assert total["cpu"] == 3.0
+        assert total["pods"] == 3.0
+
+    def test_parse_quantity(self):
+        from karpenter_core_tpu.api.objects import parse_quantity
+
+        assert parse_quantity("100m") == 0.1
+        assert parse_quantity("1Gi") == 2**30
+        assert parse_quantity("2") == 2.0
+        assert parse_quantity(1.5) == 1.5
