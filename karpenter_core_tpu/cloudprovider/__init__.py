@@ -1,0 +1,8 @@
+from karpenter_core_tpu.cloudprovider.types import (  # noqa: F401
+    CloudProvider,
+    InstanceType,
+    Offering,
+    Offerings,
+    InsufficientCapacityError,
+    NodeClaimNotFoundError,
+)

@@ -1,0 +1,160 @@
+"""Tests for the cloud-provider layer and kwok catalog."""
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider, fake_instance_types
+from karpenter_core_tpu.cloudprovider.kwok import bench_catalog, build_catalog
+from karpenter_core_tpu.cloudprovider.types import (
+    order_by_price,
+    satisfies_min_values,
+    truncate_instance_types,
+)
+from karpenter_core_tpu.scheduling import Requirement, Requirements
+
+
+class TestKwokCatalog:
+    def test_default_catalog_size(self):
+        catalog = build_catalog()
+        # 12 cpu x 3 families x 2 os x 2 arch = 144 (gen_instance_types.go:73-115)
+        assert len(catalog) == 144
+        names = {it.name for it in catalog}
+        assert len(names) == 144
+
+    def test_offerings_lattice(self):
+        it = build_catalog()[0]
+        # 4 zones x {spot, on-demand}
+        assert len(it.offerings) == 8
+        spot = [o for o in it.offerings if o.capacity_type == L.CAPACITY_TYPE_SPOT]
+        od = [o for o in it.offerings if o.capacity_type == L.CAPACITY_TYPE_ON_DEMAND]
+        assert len(spot) == 4 and len(od) == 4
+        assert abs(spot[0].price - 0.7 * od[0].price) < 1e-9
+
+    def test_bench_catalog_is_800(self):
+        assert len(bench_catalog(800)) == 800
+
+    def test_allocatable_subtracts_overhead(self):
+        it = build_catalog()[0]
+        assert it.allocatable()["cpu"] < it.capacity["cpu"]
+
+    def test_order_by_price(self):
+        catalog = build_catalog()
+        reqs = Requirements(
+            [Requirement.new(L.CAPACITY_TYPE_LABEL_KEY, "In", [L.CAPACITY_TYPE_ON_DEMAND])]
+        )
+        ordered = order_by_price(catalog, reqs)
+        prices = [
+            it.offerings.available().compatible(reqs).cheapest().price
+            for it in ordered
+        ]
+        assert prices == sorted(prices)
+
+
+class TestMinValues:
+    def test_satisfied(self):
+        its = fake_instance_types(5)
+        reqs = Requirements(
+            [
+                Requirement.new(
+                    L.LABEL_INSTANCE_TYPE,
+                    "In",
+                    [it.name for it in its],
+                    min_values=3,
+                )
+            ]
+        )
+        _, err = satisfies_min_values(its, reqs)
+        assert err is None
+
+    def test_unsatisfied(self):
+        its = fake_instance_types(2)
+        reqs = Requirements(
+            [
+                Requirement.new(
+                    L.LABEL_INSTANCE_TYPE,
+                    "In",
+                    [it.name for it in its],
+                    min_values=5,
+                )
+            ]
+        )
+        _, err = satisfies_min_values(its, reqs)
+        assert err is not None
+
+    def test_truncate_preserves_min_values(self):
+        its = fake_instance_types(10)
+        reqs = Requirements(
+            [
+                Requirement.new(
+                    L.LABEL_INSTANCE_TYPE,
+                    "In",
+                    [it.name for it in its],
+                    min_values=8,
+                )
+            ]
+        )
+        truncated, err = truncate_instance_types(its, reqs, 5)
+        # truncation to 5 would violate minValues=8 -> keeps original + error
+        assert err is not None
+        assert len(truncated) == 10
+
+
+class TestFakeProvider:
+    def test_create_records_and_hydrates(self):
+        from karpenter_core_tpu.api.nodeclaim import NodeClaim
+
+        cp = FakeCloudProvider()
+        nc = NodeClaim()
+        nc.metadata.name = "test-claim"
+        out = cp.create(nc)
+        assert out.status.provider_id.startswith("fake://")
+        assert out.is_launched()
+        assert len(cp.create_calls) == 1
+        assert cp.get(out.status.provider_id) is out
+
+    def test_error_injection(self):
+        cp = FakeCloudProvider()
+        cp.next_create_error = RuntimeError("boom")
+        from karpenter_core_tpu.api.nodeclaim import NodeClaim
+
+        try:
+            cp.create(NodeClaim())
+            assert False
+        except RuntimeError:
+            pass
+        # error consumed; next create succeeds
+        cp.create(NodeClaim())
+
+
+class TestBudgets:
+    def test_percentage_budget(self):
+        from karpenter_core_tpu.api.nodepool import Budget
+
+        assert Budget(nodes="10%").allowed_disruptions(50) == 5
+        assert Budget(nodes="3").allowed_disruptions(50) == 3
+        assert Budget(nodes="0").allowed_disruptions(50) == 0
+
+    def test_cron_window(self):
+        import calendar
+
+        from karpenter_core_tpu.api.nodepool import Budget
+
+        # active 09:00-10:00 UTC daily
+        b = Budget(nodes="0", schedule="0 9 * * *", duration=3600.0)
+        at_930 = calendar.timegm((2026, 7, 29, 9, 30, 0, 0, 0, 0))
+        at_1130 = calendar.timegm((2026, 7, 29, 11, 30, 0, 0, 0, 0))
+        assert b.is_active(at_930)
+        assert not b.is_active(at_1130)
+
+    def test_reason_filtering(self):
+        from karpenter_core_tpu.api.nodepool import (
+            Budget,
+            NodePool,
+            REASON_DRIFTED,
+            REASON_UNDERUTILIZED,
+        )
+
+        np = NodePool()
+        np.spec.disruption.budgets = [
+            Budget(nodes="2", reasons=[REASON_DRIFTED]),
+            Budget(nodes="5"),
+        ]
+        assert np.allowed_disruptions_by_reason(REASON_DRIFTED, 100) == 2
+        assert np.allowed_disruptions_by_reason(REASON_UNDERUTILIZED, 100) == 5
