@@ -1,0 +1,125 @@
+"""Jittable feasibility kernels: the reference's set algebra as tensor ops.
+
+``compatible`` evaluates ``Requirements.Compatible`` (reference:
+pkg/scheduling/requirements.go:175-187, 283-304) for every (incoming,
+receiver) pair at once. Exactness under the closed world of solver/vocab.py:
+
+* Rule 1 (custom labels): incoming side defines a non-well-known key with a
+  positive operator that the receiver doesn't define → incompatible.
+  Pure scalar logic on the defines/negative planes.
+* Rule 2 (intersects, keys both define): ``intersection.length() == 0`` can
+  only happen when (a) at least one side is a concrete (non-complement) set —
+  then the intersection is a subset of that side's explicit values, all of
+  which are interned in the vocab, so vocab-mask overlap is exact — or
+  (b) both sides are complements whose merged Gt/Lt bounds cross
+  (requirement.go:163-165); complement∩complement is otherwise a complement
+  set with astronomically large cardinality, never empty. Both-negative
+  pairs (NotIn/DoesNotExist vs NotIn/DoesNotExist) are exempt
+  (requirements.go:288-296).
+
+The per-key overlap is evaluated as a batched matmul over the value axis —
+an [N,K*V] × [K*V,M]-shaped contraction batched per key, which XLA tiles
+onto the MXU — so feasibility for 50k pod-classes × 800 instance types rides
+the systolic array rather than a host loop over set objects.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("custom_rule",))
+def compatible(
+    inc_mask,
+    inc_defines,
+    inc_concrete,
+    inc_negative,
+    inc_gt,
+    inc_lt,
+    rec_mask,
+    rec_defines,
+    rec_concrete,
+    rec_negative,
+    rec_gt,
+    rec_lt,
+    well_known,
+    custom_rule: bool = True,
+):
+    """Pairwise compatibility.
+
+    incoming entities: [N, K, V] / [N, K] planes (e.g. pod classes)
+    receiver entities: [M, K, V] / [M, K] planes (e.g. instance types,
+    nodeclaim templates, existing nodes)
+    well_known: [K] bool — keys exempt from the custom-label rule.
+
+    Returns ok: [N, M] bool.
+    """
+    # Per-key overlap count via batched contraction over the value axis:
+    # [K, N, V] @ [K, V, M] -> [K, N, M]; bf16 is exact for 0/1 sums up to
+    # V <= 256 (integers to 256 are exactly representable).
+    a = jnp.transpose(inc_mask, (1, 0, 2)).astype(jnp.bfloat16)
+    b = jnp.transpose(rec_mask, (1, 2, 0)).astype(jnp.bfloat16)
+    overlap = jax.lax.batch_matmul(a, b) > 0  # [K, N, M]
+    overlap = jnp.transpose(overlap, (1, 2, 0))  # [N, M, K]
+
+    both = inc_defines[:, None, :] & rec_defines[None, :, :]  # [N, M, K]
+    either_concrete = inc_concrete[:, None, :] | rec_concrete[None, :, :]
+    crossed = (
+        jnp.maximum(inc_gt[:, None, :], rec_gt[None, :, :])
+        >= jnp.minimum(inc_lt[:, None, :], rec_lt[None, :, :])
+    )
+    empty = jnp.where(either_concrete, ~overlap, crossed)
+    both_negative = inc_negative[:, None, :] & rec_negative[None, :, :]
+    rule2 = both & empty & ~both_negative
+
+    if custom_rule:
+        rule1 = (
+            inc_defines[:, None, :]
+            & ~inc_negative[:, None, :]
+            & ~rec_defines[None, :, :]
+            & ~well_known[None, None, :]
+        )
+        bad = rule1 | rule2
+    else:
+        bad = rule2
+    return ~jnp.any(bad, axis=-1)
+
+
+@jax.jit
+def intersects(
+    inc_mask, inc_defines, inc_concrete, inc_negative, inc_gt, inc_lt,
+    rec_mask, rec_defines, rec_concrete, rec_negative, rec_gt, rec_lt,
+):
+    """Pairwise Requirements.Intersects (rule 2 only) — used where the
+    reference calls Intersects directly, e.g. instance-type filtering
+    (scheduling/nodeclaim.go:296-298) and offering compatibility."""
+    return compatible(
+        inc_mask, inc_defines, inc_concrete, inc_negative, inc_gt, inc_lt,
+        rec_mask, rec_defines, rec_concrete, rec_negative, rec_gt, rec_lt,
+        well_known=jnp.zeros(inc_mask.shape[1], dtype=bool),
+        custom_rule=False,
+    )
+
+
+@jax.jit
+def tolerates(entity_taints, pod_tolerates_taint):
+    """Taint feasibility: entity_taints [M, TA] bool (node/template has taint
+    ta), pod_tolerates_taint [N, TA] bool (class tolerates taint ta,
+    precomputed host-side with Toleration.tolerates). ok[n, m] = every taint
+    of m is tolerated by n (reference: pkg/scheduling/taints.go:46-59)."""
+    untolerated = entity_taints[None, :, :] & ~pod_tolerates_taint[:, None, :]
+    return ~jnp.any(untolerated, axis=-1)
+
+
+@jax.jit
+def fits(requests, allocatable):
+    """Resource fit: requests [N, R], allocatable [M, R] →
+    ok [N, M] = all-dims requests <= allocatable (reference:
+    pkg/utils/resources/resources.go:217-231; negative allocatable never
+    fits)."""
+    ok = jnp.all(
+        requests[:, None, :] <= allocatable[None, :, :], axis=-1
+    )
+    return ok & jnp.all(allocatable >= 0, axis=-1)[None, :]

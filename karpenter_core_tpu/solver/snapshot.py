@@ -1,0 +1,255 @@
+"""Cluster-snapshot → device-tensor codec.
+
+One ``Snapshot`` is the device-resident image of everything one solve needs:
+pod equivalence classes, instance-type catalog, nodeclaim templates, and
+existing nodes, all encoded over a single closed-world vocabulary
+(solver/vocab.py). This is the host↔device boundary the reference never had
+— its moral equivalent is the scheduler-input assembly in
+provisioner.go:215-284 (NodePool listing, instance types, topology-domain
+universe).
+
+Pods collapse into equivalence classes first (identical requirements,
+tolerations, and resource requests are exchangeable in the FFD loop — the
+reference walks them one at a time, we batch them; scheduler.go:208-266).
+50k pods from a handful of deployments typically collapse to a few hundred
+classes, which is what makes the device scan short.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karpenter_core_tpu.api import labels as apilabels
+from karpenter_core_tpu.api.objects import Pod, RESOURCE_PODS, Taint
+from karpenter_core_tpu.cloudprovider.types import InstanceType
+from karpenter_core_tpu.scheduling import Requirements
+from karpenter_core_tpu.solver.vocab import (
+    EntityMasks,
+    FrozenVocab,
+    Vocab,
+    encode_requirements_batch,
+)
+
+# Default resource axis; extended resources append dynamically.
+BASE_RESOURCES = ("cpu", "memory", "pods", "ephemeral-storage")
+
+
+@dataclass
+class PodClass:
+    """An equivalence class of pending pods."""
+
+    requirements: Requirements
+    strict_requirements: Requirements
+    tolerations: tuple
+    requests: dict
+    pods: List[Pod] = field(default_factory=list)
+
+    @property
+    def count(self) -> int:
+        return len(self.pods)
+
+
+def _requirements_signature(reqs: Requirements) -> tuple:
+    return tuple(
+        sorted(
+            (
+                key,
+                r.complement,
+                tuple(sorted(r.values)),
+                r.greater_than,
+                r.less_than,
+                r.min_values,
+            )
+            for key, r in reqs.items()
+        )
+    )
+
+
+def group_pods(pods: Sequence[Pod]) -> List[PodClass]:
+    """Dedupe pods into equivalence classes. Signature covers everything the
+    resource+requirements+taints solve observes; pods with affinity/spread
+    constraints get their own per-constraint signatures (handled by the
+    topology-aware path, round 2+)."""
+    classes: Dict[tuple, PodClass] = {}
+    for pod in pods:
+        reqs = Requirements.from_pod(pod)
+        strict = Requirements.from_pod_strict(pod)
+        sig = (
+            _requirements_signature(reqs),
+            tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)),
+            tuple(sorted(pod.resource_requests.items())),
+            tuple(
+                (c.topology_key, c.max_skew, c.when_unsatisfiable)
+                for c in pod.topology_spread_constraints
+            ),
+        )
+        cls = classes.get(sig)
+        if cls is None:
+            cls = PodClass(
+                requirements=reqs,
+                strict_requirements=strict,
+                tolerations=tuple(pod.tolerations),
+                requests=dict(pod.resource_requests),
+            )
+            classes[sig] = cls
+        cls.pods.append(pod)
+    return list(classes.values())
+
+
+@dataclass
+class Snapshot:
+    """Encoded solve inputs (numpy; jax device put happens in models/)."""
+
+    vocab: FrozenVocab
+    resource_names: List[str]
+    well_known: np.ndarray  # [K] bool
+
+    # pod classes
+    classes: List[PodClass]
+    class_masks: EntityMasks
+    class_requests: np.ndarray  # [C, R]
+    class_counts: np.ndarray  # [C] int32
+    class_tolerates: np.ndarray  # [C, TA] bool
+
+    # instance types
+    instance_types: List[InstanceType]
+    it_masks: EntityMasks
+    it_allocatable: np.ndarray  # [T, R]
+    it_min_price: np.ndarray  # [T] cheapest available offering price (inf if none)
+    it_has_offering: np.ndarray  # [T] bool any available offering
+
+    # taint vocabulary
+    taints: List[Taint]
+
+    @property
+    def C(self) -> int:
+        return len(self.classes)
+
+    @property
+    def T(self) -> int:
+        return len(self.instance_types)
+
+    @property
+    def R(self) -> int:
+        return len(self.resource_names)
+
+
+def encode_snapshot(
+    pods: Sequence[Pod],
+    instance_types: Sequence[InstanceType],
+    extra_requirements: Sequence[Requirements] = (),
+    extra_taints: Sequence[Sequence[Taint]] = (),
+) -> Tuple[Snapshot, Optional[EntityMasks], Optional[np.ndarray]]:
+    """Encode pods + catalog, plus an optional extra entity group sharing the
+    vocab — e.g. nodeclaim templates (one Requirements per template, one taint
+    list per template) or existing nodes.
+
+    Returns (snapshot, extra_masks [S,...], extra_taint_matrix [S, TA]).
+    """
+    classes = group_pods(pods)
+
+    vocab = Vocab()
+    for cls in classes:
+        vocab.observe_requirements(cls.requirements)
+    for it in instance_types:
+        vocab.observe_requirements(it.requirements)
+        for off in it.offerings:
+            vocab.observe_requirements(off.requirements)
+    for reqs in extra_requirements:
+        vocab.observe_requirements(reqs)
+    frozen = vocab.finalize()
+
+    well_known = np.zeros((frozen.K,), dtype=bool)
+    for key, kid in frozen.keys.items():
+        well_known[kid] = key in apilabels.WELL_KNOWN_LABELS
+    frozen.well_known_mask = well_known
+
+    # resource axis
+    resource_names = list(BASE_RESOURCES)
+    seen = set(resource_names)
+    for coll in (
+        [c.requests for c in classes],
+        [it.allocatable() for it in instance_types],
+    ):
+        for rl in coll:
+            for name in rl:
+                if name not in seen:
+                    seen.add(name)
+                    resource_names.append(name)
+
+    class_masks = encode_requirements_batch(frozen, [c.requirements for c in classes])
+    it_masks = encode_requirements_batch(
+        frozen, [it.requirements for it in instance_types]
+    )
+
+    C, R, T = len(classes), len(resource_names), len(instance_types)
+    class_requests = np.zeros((C, R), dtype=np.float32)
+    for i, cls in enumerate(classes):
+        for j, name in enumerate(resource_names):
+            class_requests[i, j] = cls.requests.get(name, 0.0)
+        # every pod occupies one slot of the 'pods' resource
+        class_requests[i, resource_names.index(RESOURCE_PODS)] += 1.0
+    class_counts = np.array([c.count for c in classes], dtype=np.int32)
+
+    it_allocatable = np.zeros((T, R), dtype=np.float32)
+    it_min_price = np.full((T,), np.inf, dtype=np.float32)
+    it_has_offering = np.zeros((T,), dtype=bool)
+    for i, it in enumerate(instance_types):
+        alloc = it.allocatable()
+        for j, name in enumerate(resource_names):
+            it_allocatable[i, j] = alloc.get(name, 0.0)
+        available = it.offerings.available()
+        if available:
+            it_has_offering[i] = True
+            it_min_price[i] = min(o.price for o in available)
+
+    # taint vocabulary: union over extra taint groups (templates/nodes);
+    # classes precompute toleration per taint host-side (exact semantics).
+    taint_list: List[Taint] = []
+    taint_ids: Dict[Taint, int] = {}
+    for group in extra_taints:
+        for t in group:
+            if t not in taint_ids:
+                taint_ids[t] = len(taint_list)
+                taint_list.append(t)
+    TA = max(len(taint_list), 1)
+    class_tolerates = np.zeros((C, TA), dtype=bool)
+    for i, cls in enumerate(classes):
+        for t, tid in taint_ids.items():
+            class_tolerates[i, tid] = any(
+                tol.tolerates(t) for tol in cls.tolerations
+            )
+
+    snapshot = Snapshot(
+        vocab=frozen,
+        resource_names=resource_names,
+        well_known=well_known,
+        classes=classes,
+        class_masks=class_masks,
+        class_requests=class_requests,
+        class_counts=class_counts,
+        class_tolerates=class_tolerates,
+        instance_types=list(instance_types),
+        it_masks=it_masks,
+        it_allocatable=it_allocatable,
+        it_min_price=it_min_price,
+        it_has_offering=it_has_offering,
+        taints=taint_list,
+    )
+
+    extra_masks = (
+        encode_requirements_batch(frozen, list(extra_requirements))
+        if extra_requirements
+        else None
+    )
+    extra_taint_matrix = None
+    if extra_taints:
+        extra_taint_matrix = np.zeros((len(extra_taints), TA), dtype=bool)
+        for i, group in enumerate(extra_taints):
+            for t in group:
+                tid = taint_ids.get(t)
+                if tid is not None:
+                    extra_taint_matrix[i, tid] = True
+    return snapshot, extra_masks, extra_taint_matrix

@@ -1,0 +1,164 @@
+"""Property tests: device feasibility kernels vs the host algebra oracle.
+
+Random Requirements batches are encoded over a closed-world vocab and run
+through ops/masks.compatible; every pair must agree with
+Requirements.compatible / .intersects on the host.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_core_tpu.api import labels as apilabels
+from karpenter_core_tpu.api.objects import Pod, Taint, Toleration
+from karpenter_core_tpu.ops import masks as dev
+from karpenter_core_tpu.scheduling import Requirement, Requirements
+from karpenter_core_tpu.scheduling.requirements import (
+    ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+)
+from karpenter_core_tpu.solver.vocab import Vocab, encode_requirements_batch
+
+KEYS = [
+    apilabels.LABEL_TOPOLOGY_ZONE,
+    apilabels.LABEL_ARCH,
+    apilabels.CAPACITY_TYPE_LABEL_KEY,
+    "mycompany.io/team",
+    "mycompany.io/tier",
+    "size",
+]
+VALUES = {
+    apilabels.LABEL_TOPOLOGY_ZONE: ["zone-a", "zone-b", "zone-c", "zone-d"],
+    apilabels.LABEL_ARCH: ["amd64", "arm64"],
+    apilabels.CAPACITY_TYPE_LABEL_KEY: ["spot", "on-demand"],
+    "mycompany.io/team": ["infra", "web", "ml"],
+    "mycompany.io/tier": ["1", "2", "3"],
+    "size": ["1", "2", "4", "8", "16", "32"],
+}
+
+
+def random_requirement(rng: random.Random, key: str) -> Requirement:
+    domain = VALUES[key]
+    op = rng.choice(["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"])
+    if op in ("Gt", "Lt"):
+        if not all(v.isdigit() for v in domain):
+            op = "In"
+        else:
+            return Requirement.new(key, op, [rng.choice(domain)])
+    k = rng.randint(1, len(domain))
+    return Requirement.new(key, op, rng.sample(domain, k))
+
+
+def random_requirements(rng: random.Random, min_keys=0, max_keys=4) -> Requirements:
+    n = rng.randint(min_keys, max_keys)
+    return Requirements(
+        random_requirement(rng, key) for key in rng.sample(KEYS, n)
+    )
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_compatible_matches_host(seed):
+    rng = random.Random(seed)
+    incoming = [random_requirements(rng) for _ in range(24)]
+    receivers = [random_requirements(rng) for _ in range(24)]
+
+    vocab = Vocab()
+    for r in incoming + receivers:
+        vocab.observe_requirements(r)
+    # receivers' defined-value universe must include domains the pods
+    # reference; also observe full domains (the provisioner's domain universe,
+    # provisioner.go:251-283)
+    for key, values in VALUES.items():
+        for v in values:
+            vocab.value_id(key, v)
+    frozen = vocab.finalize()
+    well_known = np.array(
+        [k in apilabels.WELL_KNOWN_LABELS for k in frozen.key_names], dtype=bool
+    )
+
+    inc = encode_requirements_batch(frozen, incoming)
+    rec = encode_requirements_batch(frozen, receivers)
+
+    got = np.asarray(
+        dev.compatible(
+            inc.mask, inc.defines, inc.concrete, inc.negative, inc.gt, inc.lt,
+            rec.mask, rec.defines, rec.concrete, rec.negative, rec.gt, rec.lt,
+            well_known,
+        )
+    )
+    got_intersects = np.asarray(
+        dev.intersects(
+            inc.mask, inc.defines, inc.concrete, inc.negative, inc.gt, inc.lt,
+            rec.mask, rec.defines, rec.concrete, rec.negative, rec.gt, rec.lt,
+        )
+    )
+
+    for i, pod_reqs in enumerate(incoming):
+        for j, node_reqs in enumerate(receivers):
+            want = node_reqs.is_compatible(
+                pod_reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+            )
+            assert got[i, j] == want, (
+                f"compat mismatch incoming=({pod_reqs!r}) receiver=({node_reqs!r}): "
+                f"device={got[i, j]} host={want}"
+            )
+            want_int = not node_reqs.intersects(pod_reqs)
+            assert got_intersects[i, j] == want_int, (
+                f"intersects mismatch incoming=({pod_reqs!r}) "
+                f"receiver=({node_reqs!r}): device={got_intersects[i, j]} host={want_int}"
+            )
+
+
+def test_tolerates_matches_host():
+    taints = [
+        Taint(key="a", value="1", effect="NoSchedule"),
+        Taint(key="b", value="", effect="NoExecute"),
+        Taint(key="c", value="x", effect="NoSchedule"),
+    ]
+    pods = [
+        Pod(),
+        Pod(tolerations=[Toleration(operator="Exists")]),
+        Pod(tolerations=[Toleration(key="a", operator="Equal", value="1")]),
+        Pod(
+            tolerations=[
+                Toleration(key="a", operator="Exists"),
+                Toleration(key="b", operator="Exists", effect="NoExecute"),
+            ]
+        ),
+    ]
+    entities = [[], [taints[0]], [taints[0], taints[1]], taints]
+
+    TA = len(taints)
+    pod_tol = np.array(
+        [[any(t.tolerates(ta) for t in p.tolerations) for ta in taints] for p in pods]
+    )
+    ent = np.array([[ta in group for ta in taints] for group in entities])
+
+    got = np.asarray(dev.tolerates(ent, pod_tol))
+    from karpenter_core_tpu.scheduling.taints import Taints
+
+    for i, p in enumerate(pods):
+        for j, group in enumerate(entities):
+            want = not Taints(group).tolerates(p)
+            assert got[i, j] == want, f"pod {i} vs taints {j}"
+
+
+def test_fits_matches_host():
+    from karpenter_core_tpu.utils import resources as res
+
+    rng = random.Random(0)
+    reqs = np.array(
+        [[rng.choice([0, 0.5, 1, 2, 4]), rng.choice([0, 1, 2, 8])] for _ in range(16)],
+        dtype=np.float32,
+    )
+    alloc = np.array(
+        [[rng.choice([0.5, 1, 2, 4]), rng.choice([1, 2, 8, -1])] for _ in range(12)],
+        dtype=np.float32,
+    )
+    got = np.asarray(dev.fits(reqs, alloc))
+    for i in range(16):
+        for j in range(12):
+            want = res.fits(
+                {"cpu": float(reqs[i, 0]), "memory": float(reqs[i, 1])},
+                {"cpu": float(alloc[j, 0]), "memory": float(alloc[j, 1])},
+            )
+            assert got[i, j] == want
