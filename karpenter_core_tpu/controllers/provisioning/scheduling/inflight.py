@@ -1,0 +1,198 @@
+"""In-flight scheduling entities: the hypothesized new node (NodeClaim) and
+the simulation wrapper for existing nodes
+(reference: scheduling/nodeclaim.go:35-148, existingnode.go:31-128)."""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from karpenter_core_tpu.api import labels as apilabels
+from karpenter_core_tpu.api.objects import Pod, Taint
+from karpenter_core_tpu.cloudprovider.types import InstanceType
+from karpenter_core_tpu.controllers.provisioning.scheduling.hostports import (
+    HostPortUsage,
+)
+from karpenter_core_tpu.controllers.provisioning.scheduling.nodeclaimtemplate import (
+    NodeClaimTemplate,
+    filter_instance_types,
+)
+from karpenter_core_tpu.controllers.provisioning.scheduling.topology import Topology
+from karpenter_core_tpu.scheduling import Requirement, Requirements, Taints
+from karpenter_core_tpu.scheduling.requirements import (
+    ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+    has_preferred_node_affinity,
+)
+from karpenter_core_tpu.utils import resources as resutil
+
+_hostname_counter = itertools.count(1)
+
+
+class IncompatibleError(Exception):
+    pass
+
+
+class InFlightNodeClaim:
+    """A node being hypothesized during the solve (nodeclaim.go:35-64)."""
+
+    def __init__(
+        self,
+        template: NodeClaimTemplate,
+        topology: Topology,
+        daemon_resources: dict,
+        instance_types: List[InstanceType],
+    ):
+        self.template = template
+        self.hostname = f"hostname-placeholder-{next(_hostname_counter):04d}"
+        topology.register(apilabels.LABEL_HOSTNAME, self.hostname)
+        self.requirements = template.requirements.copy()
+        self.requirements.add(
+            Requirement.new(apilabels.LABEL_HOSTNAME, "In", [self.hostname])
+        )
+        self.instance_type_options = list(instance_types)
+        self.daemon_resources = dict(daemon_resources)
+        self.requests = dict(daemon_resources)
+        self.pods: List[Pod] = []
+        self.topology = topology
+        self.host_port_usage = HostPortUsage()
+
+    def add(self, pod: Pod, pod_requests: dict) -> None:
+        """Raises IncompatibleError when the pod cannot join (nodeclaim.go:67-122)."""
+        errs = Taints(self.template.taints).tolerates(pod)
+        if errs:
+            raise IncompatibleError("; ".join(errs))
+
+        conflict = self.host_port_usage.conflicts(pod, pod.host_ports)
+        if conflict:
+            raise IncompatibleError(conflict)
+
+        claim_requirements = self.requirements.copy()
+        pod_requirements = Requirements.from_pod(pod)
+        errs = claim_requirements.compatible(
+            pod_requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+        )
+        if errs:
+            raise IncompatibleError(f"incompatible requirements, {errs}")
+        claim_requirements.add(*pod_requirements.values())
+
+        strict = (
+            Requirements.from_pod_strict(pod)
+            if has_preferred_node_affinity(pod)
+            else pod_requirements
+        )
+        topology_requirements = self.topology.add_requirements(
+            strict, claim_requirements, pod, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+        )
+        errs = claim_requirements.compatible(
+            topology_requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+        )
+        if errs:
+            raise IncompatibleError(f"incompatible topology, {errs}")
+        claim_requirements.add(*topology_requirements.values())
+
+        requests = resutil.merge(self.requests, pod_requests)
+        filtered = filter_instance_types(
+            self.instance_type_options, claim_requirements, requests
+        )
+        if not filtered.remaining:
+            total = resutil.merge(self.daemon_resources, pod_requests)
+            raise IncompatibleError(
+                f"no instance type satisfied resources {resutil.to_string(total)} "
+                f"and requirements ({filtered.failure_reason()})"
+            )
+
+        self.pods.append(pod)
+        self.instance_type_options = filtered.remaining
+        self.requests = requests
+        self.requirements = claim_requirements
+        self.topology.record(pod, claim_requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)
+        self.host_port_usage.add(pod, pod.host_ports)
+
+    def destroy(self) -> None:
+        self.topology.unregister(apilabels.LABEL_HOSTNAME, self.hostname)
+
+    def finalize_scheduling(self) -> None:
+        """Remove the placeholder hostname before launch (nodeclaim.go:139-148)."""
+        self.requirements.pop(apilabels.LABEL_HOSTNAME, None)
+
+
+@dataclass
+class SimNode:
+    """Minimal view of an existing/in-flight real node for simulation; the
+    cluster-state layer constructs these from StateNodes."""
+
+    name: str
+    labels: dict
+    taints: List[Taint]
+    available: dict  # allocatable minus bound pods (statenode.go:329-366)
+    capacity: dict = field(default_factory=dict)
+    daemon_requests: dict = field(default_factory=dict)
+    initialized: bool = True
+    nodeclaim_name: str = ""
+    nodepool_name: str = ""
+
+
+class ExistingNodeSim:
+    """Existing-node wrapper with daemon overhead floored at zero
+    (existingnode.go:42-128)."""
+
+    def __init__(self, node: SimNode, topology: Topology, daemon_resources: dict):
+        remaining = resutil.subtract(daemon_resources, node.daemon_requests)
+        for k in list(remaining):
+            if remaining[k] < 0:
+                remaining[k] = 0.0
+        self.node = node
+        self.cached_available = dict(node.available)
+        self.cached_taints = list(node.taints)
+        self.pods: List[Pod] = []
+        self.topology = topology
+        self.requests = remaining
+        self.requirements = Requirements.from_labels(node.labels)
+        self.requirements.add(
+            Requirement.new(apilabels.LABEL_HOSTNAME, "In", [node.name])
+        )
+        topology.register(apilabels.LABEL_HOSTNAME, node.name)
+        self.host_port_usage = HostPortUsage()
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def add(self, pod: Pod, pod_requests: dict) -> None:
+        errs = Taints(self.cached_taints).tolerates(pod)
+        if errs:
+            raise IncompatibleError("; ".join(errs))
+
+        conflict = self.host_port_usage.conflicts(pod, pod.host_ports)
+        if conflict:
+            raise IncompatibleError(conflict)
+
+        requests = resutil.merge(self.requests, pod_requests)
+        if not resutil.fits(requests, self.cached_available):
+            raise IncompatibleError("exceeds node resources")
+
+        node_requirements = self.requirements.copy()
+        pod_requirements = Requirements.from_pod(pod)
+        errs = node_requirements.compatible(pod_requirements)
+        if errs:
+            raise IncompatibleError(f"incompatible requirements, {errs}")
+        node_requirements.add(*pod_requirements.values())
+
+        strict = (
+            Requirements.from_pod_strict(pod)
+            if has_preferred_node_affinity(pod)
+            else pod_requirements
+        )
+        topology_requirements = self.topology.add_requirements(
+            strict, node_requirements, pod
+        )
+        errs = node_requirements.compatible(topology_requirements)
+        if errs:
+            raise IncompatibleError(f"incompatible topology, {errs}")
+        node_requirements.add(*topology_requirements.values())
+
+        self.pods.append(pod)
+        self.requests = requests
+        self.requirements = node_requirements
+        self.topology.record(pod, node_requirements)
+        self.host_port_usage.add(pod, pod.host_ports)

@@ -1,0 +1,46 @@
+"""Topology tracking interface.
+
+The reference Topology (pkg/controllers/provisioning/scheduling/topology.go:41-321)
+tracks topology-spread / pod-affinity / pod-anti-affinity domain counts and
+tightens requirements per pod placement. Round 1 ships the interface with
+hostname-domain registration (enough for requirement bookkeeping and the
+resource/requirements/taints bench configs); spread/affinity group counting
+is the dedicated topology milestone — the device-side formulation keeps
+per-group domain-count vectors and computes skew as max-min over the count
+tensor.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from karpenter_core_tpu.api.objects import Pod
+from karpenter_core_tpu.scheduling import Requirements
+
+
+class Topology:
+    def __init__(self):
+        self.domains: dict = {}  # key -> set of registered domain values
+
+    def register(self, key: str, value: str) -> None:
+        self.domains.setdefault(key, set()).add(value)
+
+    def unregister(self, key: str, value: str) -> None:
+        self.domains.get(key, set()).discard(value)
+
+    def add_requirements(
+        self,
+        strict_pod_requirements: Requirements,
+        node_requirements: Requirements,
+        pod: Pod,
+        allow_undefined=frozenset(),
+    ) -> Requirements:
+        """Topology-derived extra requirements for placing pod on this node.
+        No spread/affinity groups yet -> no tightening."""
+        return Requirements()
+
+    def record(self, pod: Pod, requirements: Requirements, allow_undefined=frozenset()) -> None:
+        pass
+
+    def update(self, pod: Pod) -> None:
+        """Recompute groups after a relaxation changed the pod's constraints."""
+        pass

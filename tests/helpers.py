@@ -1,0 +1,136 @@
+"""Shared test fixtures: pod generators and nodepool builders, modeled on the
+reference's test object builders (pkg/test/pods.go:399-438 MakeDiversePodOptions,
+scheduling_benchmark_test.go:233-247)."""
+from __future__ import annotations
+
+import random
+from typing import List, Optional
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.api.nodepool import NodePool, NodePoolSpec
+from karpenter_core_tpu.api.objects import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    Pod,
+    Toleration,
+    TopologySpreadConstraint,
+    resource_list,
+)
+
+GIB = 2.0**30
+
+
+def make_pod(
+    cpu: float = 0.5,
+    memory_gib: float = 1.0,
+    name: Optional[str] = None,
+    node_selector: Optional[dict] = None,
+    zone_in: Optional[List[str]] = None,
+    tolerations: Optional[list] = None,
+    spread_zone: bool = False,
+    spread_hostname: bool = False,
+) -> Pod:
+    affinity = None
+    if zone_in:
+        affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(
+                        match_expressions=(
+                            NodeSelectorRequirement(
+                                L.LABEL_TOPOLOGY_ZONE, "In", tuple(zone_in)
+                            ),
+                        )
+                    )
+                ]
+            )
+        )
+    constraints = []
+    if spread_zone:
+        constraints.append(
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=L.LABEL_TOPOLOGY_ZONE,
+                when_unsatisfiable="DoNotSchedule",
+            )
+        )
+    if spread_hostname:
+        constraints.append(
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=L.LABEL_HOSTNAME,
+                when_unsatisfiable="DoNotSchedule",
+            )
+        )
+    return Pod(
+        metadata=ObjectMeta(name=name or f"pod-{ObjectMeta().uid}"),
+        resource_requests={"cpu": cpu, "memory": memory_gib * GIB},
+        node_selector=dict(node_selector or {}),
+        affinity=affinity,
+        tolerations=list(tolerations or []),
+        topology_spread_constraints=constraints,
+    )
+
+
+def make_diverse_pods(n: int, seed: int = 0, with_topology: bool = False) -> List[Pod]:
+    """~1/6 each: generic, zonal-affinity, spread variants (benchmark mix)."""
+    rng = random.Random(seed)
+    pods = []
+    for i in range(n):
+        kind = rng.randrange(6) if with_topology else rng.randrange(3)
+        cpu = rng.choice([0.1, 0.25, 0.5, 1.0, 2.0])
+        mem = rng.choice([0.25, 0.5, 1.0, 2.0, 4.0])
+        if kind == 0:
+            pods.append(make_pod(cpu, mem, name=f"generic-{i}"))
+        elif kind == 1:
+            pods.append(
+                make_pod(cpu, mem, name=f"zonal-{i}", zone_in=["zone-a", "zone-b"])
+            )
+        elif kind == 2:
+            pods.append(
+                make_pod(
+                    cpu,
+                    mem,
+                    name=f"selector-{i}",
+                    node_selector={L.LABEL_OS: "linux"},
+                )
+            )
+        elif kind == 3:
+            pods.append(make_pod(cpu, mem, name=f"spread-z-{i}", spread_zone=True))
+        elif kind == 4:
+            pods.append(make_pod(cpu, mem, name=f"spread-h-{i}", spread_hostname=True))
+        else:
+            pods.append(
+                make_pod(
+                    cpu,
+                    mem,
+                    name=f"zonal2-{i}",
+                    zone_in=["zone-c"],
+                )
+            )
+    return pods
+
+
+def make_nodepool(
+    name: str = "default",
+    requirements: Optional[list] = None,
+    taints: Optional[list] = None,
+    limits: Optional[dict] = None,
+    weight: int = 0,
+) -> NodePool:
+    np = NodePool(metadata=ObjectMeta(name=name))
+    np.spec = NodePoolSpec()
+    np.spec.weight = weight
+    if requirements:
+        np.spec.template.requirements = list(requirements)
+    if taints:
+        np.spec.template.taints = list(taints)
+    if limits:
+        from karpenter_core_tpu.api.nodepool import Limits
+
+        np.spec.limits = Limits()
+        np.spec.limits.update(limits)
+    return np

@@ -1,0 +1,266 @@
+"""Functional tests for the greedy host scheduler (the parity oracle),
+covering the core behaviors of the reference's provisioning suite."""
+import pytest
+
+from helpers import GIB, make_diverse_pods, make_nodepool, make_pod
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.api.objects import NodeSelectorRequirement, Taint, Toleration
+from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import SimNode
+from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import Scheduler
+
+
+def make_scheduler(nodepools=None, catalog=None, existing=None, daemons=None):
+    nodepools = nodepools or [make_nodepool()]
+    catalog = catalog if catalog is not None else build_catalog()
+    return Scheduler(
+        nodepools,
+        {np.name: list(catalog) for np in nodepools},
+        existing_nodes=existing,
+        daemonset_pods=daemons,
+    )
+
+
+class TestBasicPacking:
+    def test_single_pod_single_node(self):
+        s = make_scheduler()
+        res = s.solve([make_pod(cpu=1.0)])
+        assert res.all_pods_scheduled()
+        assert res.node_count() == 1
+        assert len(res.new_node_claims[0].pods) == 1
+
+    def test_many_small_pods_pack_onto_one_node(self):
+        s = make_scheduler()
+        # 10 x 0.1 cpu easily fits a single small instance
+        res = s.solve([make_pod(cpu=0.1, memory_gib=0.1) for _ in range(10)])
+        assert res.all_pods_scheduled()
+        assert res.node_count() == 1
+
+    def test_pods_larger_than_any_instance_fail(self):
+        s = make_scheduler()
+        res = s.solve([make_pod(cpu=10000.0)])
+        assert not res.all_pods_scheduled()
+        assert res.node_count() == 0
+
+    def test_ffd_opens_multiple_nodes(self):
+        # max instance = 256 cpu; 300 x 2cpu needs at least 3 nodes worth
+        s = make_scheduler()
+        res = s.solve([make_pod(cpu=2.0, memory_gib=0.5) for _ in range(300)])
+        assert res.all_pods_scheduled()
+        total_cpu = 300 * 2.0
+        assert res.node_count() >= 2
+        # sanity: packed pods count matches
+        assert sum(len(c.pods) for c in res.new_node_claims) == 300
+
+    def test_pod_count_limit_respected(self):
+        # 1-cpu instance allows 16 pods; 40 tiny pods need >= 2 nodes if
+        # scheduler picks the smallest; FFD narrows instance types instead
+        s = make_scheduler()
+        res = s.solve([make_pod(cpu=0.001, memory_gib=0.01) for _ in range(2000)])
+        assert res.all_pods_scheduled()
+        for claim in res.new_node_claims:
+            pods_limit = min(
+                it.allocatable()["pods"] for it in claim.instance_type_options
+            )
+            assert len(claim.pods) <= pods_limit
+
+
+class TestRequirements:
+    def test_node_selector_restricts_instance_types(self):
+        s = make_scheduler()
+        res = s.solve(
+            [make_pod(node_selector={L.LABEL_ARCH: L.ARCHITECTURE_ARM64})]
+        )
+        assert res.all_pods_scheduled()
+        for it in res.new_node_claims[0].instance_type_options:
+            assert it.requirements.get(L.LABEL_ARCH).has("arm64")
+
+    def test_incompatible_selector_fails(self):
+        s = make_scheduler()
+        res = s.solve([make_pod(node_selector={L.LABEL_ARCH: "riscv"})])
+        assert not res.all_pods_scheduled()
+
+    def test_nodepool_requirements_partition(self):
+        np = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    L.LABEL_ARCH, "In", (L.ARCHITECTURE_AMD64,)
+                )
+            ]
+        )
+        s = make_scheduler([np])
+        res = s.solve([make_pod(node_selector={L.LABEL_ARCH: "arm64"})])
+        assert not res.all_pods_scheduled()
+
+    def test_zone_affinity(self):
+        s = make_scheduler()
+        res = s.solve([make_pod(zone_in=["zone-b"])])
+        assert res.all_pods_scheduled()
+        claim = res.new_node_claims[0]
+        assert claim.requirements.get(L.LABEL_TOPOLOGY_ZONE).sorted_values() == [
+            "zone-b"
+        ]
+
+    def test_incompatible_pods_open_separate_nodes(self):
+        s = make_scheduler()
+        res = s.solve(
+            [
+                make_pod(cpu=0.1, name="a", zone_in=["zone-a"]),
+                make_pod(cpu=0.1, name="b", zone_in=["zone-b"]),
+            ]
+        )
+        assert res.all_pods_scheduled()
+        assert res.node_count() == 2
+
+    def test_custom_label_on_nodepool(self):
+        np = make_nodepool()
+        np.spec.template.labels = {"mycompany.io/team": "infra"}
+        s = make_scheduler([np])
+        res = s.solve(
+            [make_pod(node_selector={"mycompany.io/team": "infra"})]
+        )
+        assert res.all_pods_scheduled()
+        res2 = make_scheduler([np]).solve(
+            [make_pod(node_selector={"mycompany.io/team": "web"})]
+        )
+        assert not res2.all_pods_scheduled()
+
+
+class TestTaints:
+    def test_tainted_nodepool_needs_toleration(self):
+        np = make_nodepool(
+            taints=[Taint(key="dedicated", value="ml", effect="NoSchedule")]
+        )
+        s = make_scheduler([np])
+        res = s.solve([make_pod()])
+        assert not res.all_pods_scheduled()
+
+        s2 = make_scheduler([np])
+        res2 = s2.solve(
+            [
+                make_pod(
+                    tolerations=[
+                        Toleration(key="dedicated", operator="Equal", value="ml")
+                    ]
+                )
+            ]
+        )
+        assert res2.all_pods_scheduled()
+
+    def test_weighted_nodepool_preference(self):
+        plain = make_nodepool("plain", weight=0)
+        preferred = make_nodepool("preferred", weight=10)
+        s = make_scheduler([plain, preferred])
+        res = s.solve([make_pod()])
+        assert res.all_pods_scheduled()
+        assert res.new_node_claims[0].template.nodepool_name == "preferred"
+
+
+class TestExistingNodes:
+    def _existing(self, cpu=4.0):
+        return SimNode(
+            name="existing-1",
+            labels={
+                L.LABEL_ARCH: "amd64",
+                L.LABEL_OS: "linux",
+                L.LABEL_TOPOLOGY_ZONE: "zone-a",
+                L.NODEPOOL_LABEL_KEY: "default",
+            },
+            taints=[],
+            available={"cpu": cpu, "memory": 8 * GIB, "pods": 100.0},
+            capacity={"cpu": cpu, "memory": 8 * GIB, "pods": 110.0},
+        )
+
+    def test_pods_prefer_existing_capacity(self):
+        s = make_scheduler(existing=[self._existing()])
+        res = s.solve([make_pod(cpu=1.0)])
+        assert res.all_pods_scheduled()
+        assert res.node_count() == 0
+        assert len(res.existing_nodes[0].pods) == 1
+
+    def test_overflow_opens_new_node(self):
+        s = make_scheduler(existing=[self._existing(cpu=1.0)])
+        res = s.solve([make_pod(cpu=0.8), make_pod(cpu=0.8)])
+        assert res.all_pods_scheduled()
+        assert res.node_count() == 1
+        assert len(res.existing_nodes[0].pods) == 1
+
+    def test_tainted_existing_node_skipped(self):
+        node = self._existing()
+        node.taints = [Taint(key="x", effect="NoSchedule")]
+        s = make_scheduler(existing=[node])
+        res = s.solve([make_pod(cpu=1.0)])
+        assert res.all_pods_scheduled()
+        assert res.node_count() == 1
+        assert not res.existing_nodes[0].pods
+
+
+class TestLimits:
+    def test_limits_cap_node_creation(self):
+        np = make_nodepool(limits={"cpu": 4.0})
+        s = make_scheduler([np])
+        # each pod needs its own 2-cpu+ node because of hostname spread? no —
+        # use big pods: 3 pods x 3 cpu; max capacity 4 cpu per the limit
+        res = s.solve([make_pod(cpu=3.0, name=f"p{i}") for i in range(3)])
+        # pessimistic subtractMax: the first node consumes the whole 4-cpu
+        # budget, remaining pods fail
+        assert not res.all_pods_scheduled()
+        assert res.node_count() >= 1
+
+    def test_no_limits_unbounded(self):
+        s = make_scheduler()
+        res = s.solve([make_pod(cpu=3.0, name=f"p{i}") for i in range(5)])
+        assert res.all_pods_scheduled()
+
+
+class TestRelaxation:
+    def test_preferred_affinity_relaxed_on_failure(self):
+        from karpenter_core_tpu.api.objects import (
+            Affinity,
+            NodeAffinity,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+            PreferredSchedulingTerm,
+        )
+
+        pod = make_pod()
+        pod.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                preferred=[
+                    PreferredSchedulingTerm(
+                        weight=1,
+                        preference=NodeSelectorTerm(
+                            match_expressions=(
+                                NodeSelectorRequirement(
+                                    L.LABEL_TOPOLOGY_ZONE, "In", ("nonexistent-zone",)
+                                ),
+                            )
+                        ),
+                    )
+                ]
+            )
+        )
+        s = make_scheduler()
+        res = s.solve([pod])
+        # fails with the preference, relaxes, then schedules
+        assert res.all_pods_scheduled()
+
+    def test_impossible_required_affinity_still_fails(self):
+        pod = make_pod(zone_in=["nonexistent-zone"])
+        s = make_scheduler()
+        res = s.solve([pod])
+        assert not res.all_pods_scheduled()
+
+
+class TestScale:
+    def test_diverse_500_pods(self):
+        s = make_scheduler()
+        pods = make_diverse_pods(500, seed=42)
+        res = s.solve(pods)
+        assert res.all_pods_scheduled()
+        assert (
+            sum(len(c.pods) for c in res.new_node_claims)
+            + sum(len(n.pods) for n in res.existing_nodes)
+            == 500
+        )
