@@ -1,0 +1,579 @@
+"""The TPU provisioning solver — flagship model.
+
+Drop-in counterpart of the greedy host scheduler
+(controllers/provisioning/scheduling/scheduler.py): same inputs (nodepools,
+instance-type catalog, existing nodes, pending pods), same Results shape,
+but the FFD loop runs on device as a class-batched scan (ops/ffd.py) after
+feasibility is precomputed as batched matmuls (ops/masks.py).
+
+Pipeline per solve:
+ 1. host: pods → equivalence classes, sorted cpu/memory-descending
+    (queue.go:76-112 ordering, lifted to classes)
+ 2. host: snapshot encode over a closed-world vocab (solver/snapshot.py)
+ 3. device: class×IT / class×template compatibility + fresh-node viability
+ 4. device: FFD scan over classes → per-slot take counts
+ 5. host: decode — merge each slot's class groups through the exact host
+    algebra (Requirements.add + filter_instance_types), yielding the same
+    InFlightNodeClaim objects the greedy path produces
+ 6. host: relaxation outer loop re-runs 1-5 for still-unschedulable pods
+    (preferences.go:38-57)
+
+NodePool resource limits are enforced host-side after decode (the greedy
+path is authoritative when limits are tight — scheduler.go:389-434's
+pessimistic subtract-max); round-1 device solve does not model limits.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from karpenter_core_tpu.api import labels as apilabels
+from karpenter_core_tpu.api.nodepool import NodePool
+from karpenter_core_tpu.api.objects import Pod, Taint
+from karpenter_core_tpu.cloudprovider.types import InstanceType
+from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import (
+    ExistingNodeSim,
+    IncompatibleError,
+    InFlightNodeClaim,
+    SimNode,
+)
+from karpenter_core_tpu.controllers.provisioning.scheduling.nodeclaimtemplate import (
+    NodeClaimTemplate,
+    filter_instance_types,
+)
+from karpenter_core_tpu.controllers.provisioning.scheduling.preferences import (
+    Preferences,
+)
+from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import Results
+from karpenter_core_tpu.controllers.provisioning.scheduling.topology import Topology
+from karpenter_core_tpu.ops import masks as mops
+from karpenter_core_tpu.ops.ffd import (
+    BIG,
+    ClassStep,
+    FFDStatics,
+    SlotState,
+    ffd_solve,
+)
+from karpenter_core_tpu.scheduling import Requirements, Taints
+from karpenter_core_tpu.solver.snapshot import PodClass, group_pods
+from karpenter_core_tpu.solver.vocab import EntityMasks, GT_NONE, LT_NONE
+from karpenter_core_tpu.utils import resources as resutil
+
+
+def _neutralize(masks: EntityMasks) -> EntityMasks:
+    """Apply the neutral-where-undefined invariant required by ffd_step."""
+    d = masks.defines
+    return EntityMasks(
+        mask=np.where(d[:, :, None], masks.mask, True),
+        defines=d,
+        concrete=np.where(d, masks.concrete, False),
+        negative=np.where(d, masks.negative, True),
+        gt=masks.gt,
+        lt=masks.lt,
+    )
+
+
+def _tolerates_taints(tolerations, taints) -> bool:
+    return all(any(tol.tolerates(t) for tol in tolerations) for t in taints)
+
+
+@dataclass
+class _Prepared:
+    snapshot: object
+    classes: List[PodClass]
+    templates: List[NodeClaimTemplate]
+    class_it: np.ndarray  # [C, T]
+    tmpl_ok: np.ndarray  # [C, S] compat+taints
+    new_template: np.ndarray  # [C]
+    kstar: np.ndarray  # [C]
+    statics: FFDStatics
+    init_state: SlotState
+    exist_taint_ok: np.ndarray  # [C, N]
+    existing_sims: List[ExistingNodeSim]
+    n_slots: int
+
+
+class DeviceScheduler:
+    """Same construction surface as the greedy Scheduler, device solve."""
+
+    def __init__(
+        self,
+        nodepools: List[NodePool],
+        instance_types: Dict[str, List[InstanceType]],
+        existing_nodes: Optional[List[SimNode]] = None,
+        daemonset_pods: Optional[List[Pod]] = None,
+        max_slots: int = 256,
+        validate: bool = False,
+    ):
+        self.nodepools = sorted(nodepools, key=lambda n: (-n.spec.weight, n.name))
+        self.instance_types = instance_types
+        self.existing_nodes = list(existing_nodes or [])
+        self.daemonset_pods = list(daemonset_pods or [])
+        self.max_slots = max_slots
+        self.validate = validate
+        self.topology = Topology()
+
+        tolerate_pns = any(
+            t.effect == "PreferNoSchedule"
+            for np_ in self.nodepools
+            for t in np_.spec.template.taints
+        )
+        self.preferences = Preferences(tolerate_pns)
+
+        self.templates: List[NodeClaimTemplate] = []
+        for np_ in self.nodepools:
+            nct = NodeClaimTemplate.from_nodepool(np_)
+            nct.instance_type_options = filter_instance_types(
+                instance_types.get(np_.name, []), nct.requirements, {}
+            ).remaining
+            if nct.instance_type_options:
+                self.templates.append(nct)
+
+        # daemon overhead per template (scheduler.go:358-364)
+        from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+            _daemon_compatible,
+        )
+
+        self.daemon_overhead = [
+            resutil.requests_for_pods(
+                *[p for p in self.daemonset_pods if _daemon_compatible(nct, p)]
+            )
+            for nct in self.templates
+        ]
+
+    # ------------------------------------------------------------------
+
+    def solve(self, pods: List[Pod]) -> Results:
+        """Device solve + host decode + relaxation outer loop."""
+        import copy
+
+        pending = list(pods)
+        errors: Dict[str, str] = {}
+        claims: List[InFlightNodeClaim] = []
+        existing_sims: List[ExistingNodeSim] = []
+        max_slots = self.max_slots
+
+        for _ in range(8):  # relaxation rounds (preferences ladder depth)
+            if not pending:
+                break
+            result = self._solve_once(pending, max_slots)
+            if result is None:  # slot overflow — retry larger
+                max_slots *= 2
+                continue
+            claims, existing_sims, failed = result
+            if not failed:
+                errors = {}
+                pending = []
+                break
+            errors = {p.uid: msg for p, msg in failed}
+            relaxed_any = False
+            next_pending = []
+            for p, _msg in failed:
+                if self.preferences.relax(p):
+                    relaxed_any = True
+                next_pending.append(p)
+            pending = next_pending
+            if not relaxed_any:
+                break
+
+        for c in claims:
+            c.finalize_scheduling()
+        return Results(
+            new_node_claims=claims,
+            existing_nodes=existing_sims,
+            pod_errors=errors,
+        )
+
+    # ------------------------------------------------------------------
+
+    def _solve_once(
+        self, pods: List[Pod], max_slots: int
+    ) -> Optional[Tuple[List[InFlightNodeClaim], List[ExistingNodeSim], list]]:
+        prep = self._prepare(pods, max_slots)
+        if prep is None:
+            # no viable templates and no existing capacity: everything fails
+            return [], [], [(p, "no nodepool matched pod") for p in pods]
+
+        C = len(prep.classes)
+        state, takes, unplaced = ffd_solve(
+            prep.init_state,
+            self._class_steps(prep),
+            prep.statics,
+        )
+        if bool(state.overflow):
+            return None
+        takes = np.asarray(takes)  # [C, N]
+        unplaced = np.asarray(unplaced)
+        return self._decode(prep, np.asarray(takes), unplaced, np.asarray(state.template))
+
+    # ------------------------------------------------------------------
+
+    def _prepare(self, pods: List[Pod], max_slots: int) -> Optional[_Prepared]:
+        if not self.templates and not self.existing_nodes:
+            return None
+        classes = group_pods(pods)
+        # class order = pod queue order lifted to classes (queue.go:76-112)
+        classes.sort(
+            key=lambda c: (
+                -c.requests.get("cpu", 0.0),
+                -c.requests.get("memory", 0.0),
+                min(p.metadata.creation_timestamp for p in c.pods),
+            )
+        )
+        return self._prepare_with_vocab(classes, max_slots)
+
+    def _prepare_with_vocab(self, classes, max_slots) -> Optional[_Prepared]:
+        from karpenter_core_tpu.solver.vocab import Vocab, encode_requirements_batch
+
+        catalog = self._catalog_union()
+        T, S = len(catalog), len(self.templates)
+        exist_label_reqs = [
+            Requirements.from_labels(n.labels) for n in self.existing_nodes
+        ]
+
+        vocab = Vocab()
+        for cls in classes:
+            vocab.observe_requirements(cls.requirements)
+        for it in catalog:
+            vocab.observe_requirements(it.requirements)
+            for off in it.offerings:
+                vocab.observe_requirements(off.requirements)
+        for t in self.templates:
+            vocab.observe_requirements(t.requirements)
+        for r in exist_label_reqs:
+            vocab.observe_requirements(r)
+        frozen = vocab.finalize()
+        well_known = np.array(
+            [k in apilabels.WELL_KNOWN_LABELS for k in frozen.key_names], dtype=bool
+        )
+
+        # resource axis
+        resource_names = list(
+            dict.fromkeys(
+                ["cpu", "memory", "pods", "ephemeral-storage"]
+                + [n for c in classes for n in c.requests]
+                + [n for it in catalog for n in it.allocatable()]
+            )
+        )
+        R = len(resource_names)
+
+        def rvec(rl: dict) -> np.ndarray:
+            return np.array([rl.get(n, 0.0) for n in resource_names], dtype=np.float32)
+
+        class_masks = _neutralize(
+            encode_requirements_batch(frozen, [c.requirements for c in classes])
+        )
+        it_masks = encode_requirements_batch(frozen, [it.requirements for it in catalog])
+        tmpl_masks = _neutralize(
+            encode_requirements_batch(frozen, [t.requirements for t in self.templates])
+        )
+        exist_masks = (
+            _neutralize(encode_requirements_batch(frozen, exist_label_reqs))
+            if exist_label_reqs
+            else None
+        )
+
+        C = len(classes)
+        class_requests = np.stack(
+            [rvec(resutil.requests_for_pods(c.pods[0])) for c in classes]
+        ) if classes else np.zeros((0, R), dtype=np.float32)
+
+        it_alloc = np.stack([rvec(it.allocatable()) for it in catalog])
+
+        # offerings tensor [T, Z, CT] over the zone/ct vocab rows
+        zone_kid = frozen.keys.get(apilabels.LABEL_TOPOLOGY_ZONE, 0)
+        ct_kid = frozen.keys.get(apilabels.CAPACITY_TYPE_LABEL_KEY, 0)
+        Z = max(len(frozen.value_names[zone_kid]), 1)
+        CT = max(len(frozen.value_names[ct_kid]), 1)
+        off_avail = np.zeros((T, Z, CT), dtype=bool)
+        for ti, it in enumerate(catalog):
+            for off in it.offerings:
+                if not off.available:
+                    continue
+                z = frozen.values[zone_kid].get(off.zone)
+                c_ = frozen.values[ct_kid].get(off.capacity_type)
+                if z is not None and c_ is not None:
+                    off_avail[ti, z, c_] = True
+
+        # device compat precomputes
+        cm, im, tm = class_masks, it_masks, tmpl_masks
+        class_it = np.asarray(
+            mops.intersects(
+                cm.mask, cm.defines, cm.concrete, cm.negative, cm.gt, cm.lt,
+                im.mask, im.defines, im.concrete, im.negative, im.gt, im.lt,
+            )
+        ) if C else np.zeros((0, T), dtype=bool)
+        tmpl_compat = np.asarray(
+            mops.compatible(
+                cm.mask, cm.defines, cm.concrete, cm.negative, cm.gt, cm.lt,
+                tm.mask, tm.defines, tm.concrete, tm.negative, tm.gt, tm.lt,
+                jnp.asarray(well_known),
+            )
+        ) if C and S else np.zeros((C, S), dtype=bool)
+
+        taint_ok = np.array(
+            [
+                [_tolerates_taints(c.tolerations, t.taints) for t in self.templates]
+                for c in classes
+            ],
+            dtype=bool,
+        ) if C and S else np.zeros((C, S), dtype=bool)
+        tmpl_ok = tmpl_compat & taint_ok
+
+        # template-IT viability from the host prefilter (exact reference path)
+        it_index = {id(it): i for i, it in enumerate(catalog)}
+        tmpl_it = np.zeros((S, T), dtype=bool)
+        for si, t in enumerate(self.templates):
+            for it in t.instance_type_options:
+                tmpl_it[si, it_index[id(it)]] = True
+        tmpl_overhead = np.stack(
+            [rvec(o) for o in self.daemon_overhead]
+        ) if S else np.zeros((0, R), dtype=np.float32)
+
+        # fresh-node viability + kstar per class (first template wins)
+        new_template = np.full((C,), -1, dtype=np.int32)
+        kstar = np.zeros((C,), dtype=np.int32)
+        for ci in range(C):
+            zmask_c = class_masks.mask[ci, zone_kid, :Z]
+            ctmask_c = class_masks.mask[ci, ct_kid, :CT]
+            for si in range(S):
+                if not tmpl_ok[ci, si]:
+                    continue
+                viable = tmpl_it[si] & class_it[ci]
+                if not viable.any():
+                    continue
+                zmask = zmask_c & tmpl_masks.mask[si, zone_kid, :Z]
+                ctmask = ctmask_c & tmpl_masks.mask[si, ct_kid, :CT]
+                off_ok = (
+                    off_avail & zmask[None, :, None] & ctmask[None, None, :]
+                ).any(axis=(1, 2))
+                head = it_alloc - tmpl_overhead[si][None, :]
+                r = class_requests[ci]
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    per_dim = np.where(r[None, :] > 0, head / np.where(r > 0, r, 1.0), np.inf)
+                k_it = np.floor(per_dim.min(axis=1))
+                k_it = np.where(viable & off_ok, k_it, -1)
+                if k_it.max() >= 1:
+                    new_template[ci] = si
+                    kstar[ci] = int(k_it.max())
+                    break
+
+        # initial slot state with existing nodes seeded in rows [0, E)
+        N = max_slots
+        K, V = frozen.K, frozen.V
+        E = len(self.existing_nodes)
+        if E > N:
+            return None
+
+        valmask = np.ones((N, K, V), dtype=bool)
+        defines = np.zeros((N, K), dtype=bool)
+        complement = np.ones((N, K), dtype=bool)
+        negative = np.ones((N, K), dtype=bool)
+        gt = np.full((N, K), GT_NONE, dtype=np.int32)
+        lt = np.full((N, K), LT_NONE, dtype=np.int32)
+        itmask = np.zeros((N, T), dtype=bool)
+        requests = np.zeros((N, R), dtype=np.float32)
+        capacity = np.full((N, R), np.float32(BIG))
+        kind = np.zeros((N,), dtype=np.int8)
+        template_arr = np.full((N,), -1, dtype=np.int32)
+
+        existing_sims = []
+        topo = Topology()
+        for ei, node in enumerate(self.existing_nodes):
+            sim = ExistingNodeSim(node, topo, self._node_daemon_overhead(node))
+            existing_sims.append(sim)
+            valmask[ei] = exist_masks.mask[ei]
+            defines[ei] = exist_masks.defines[ei]
+            complement[ei] = np.where(
+                exist_masks.defines[ei], ~exist_masks.concrete[ei], True
+            )
+            negative[ei] = np.where(
+                exist_masks.defines[ei], exist_masks.negative[ei], True
+            )
+            gt[ei] = exist_masks.gt[ei]
+            lt[ei] = exist_masks.lt[ei]
+            requests[ei] = rvec(sim.requests)
+            capacity[ei] = rvec(sim.cached_available)
+            kind[ei] = 1
+
+        exist_taint_ok = np.ones((C, N), dtype=bool)
+        for ci, cls in enumerate(classes):
+            for ei, node in enumerate(self.existing_nodes):
+                exist_taint_ok[ci, ei] = _tolerates_taints(
+                    cls.tolerations, node.taints
+                )
+
+        statics = FFDStatics(
+            it_alloc=jnp.asarray(it_alloc),
+            off_avail=jnp.asarray(off_avail),
+            zone_key=jnp.int32(zone_kid),
+            ct_key=jnp.int32(ct_kid),
+            tmpl_mask=jnp.asarray(tmpl_masks.mask),
+            tmpl_defines=jnp.asarray(tmpl_masks.defines),
+            tmpl_complement=jnp.asarray(
+                np.where(tmpl_masks.defines, ~tmpl_masks.concrete, True)
+            ),
+            tmpl_negative=jnp.asarray(
+                np.where(tmpl_masks.defines, tmpl_masks.negative, True)
+            ),
+            tmpl_gt=jnp.asarray(tmpl_masks.gt),
+            tmpl_lt=jnp.asarray(tmpl_masks.lt),
+            tmpl_it=jnp.asarray(tmpl_it),
+            tmpl_overhead=jnp.asarray(tmpl_overhead),
+            well_known=jnp.asarray(well_known),
+            gt_none=jnp.int32(GT_NONE),
+            lt_none=jnp.int32(LT_NONE),
+        )
+        init_state = SlotState(
+            valmask=jnp.asarray(valmask),
+            defines=jnp.asarray(defines),
+            complement=jnp.asarray(complement),
+            negative=jnp.asarray(negative),
+            gt=jnp.asarray(gt),
+            lt=jnp.asarray(lt),
+            itmask=jnp.asarray(itmask),
+            requests=jnp.asarray(requests),
+            capacity=jnp.asarray(capacity),
+            kind=jnp.asarray(kind),
+            template=jnp.asarray(template_arr),
+            next_free=jnp.int32(E),
+            overflow=jnp.asarray(False),
+        )
+
+        class Snap:
+            pass
+
+        snap = Snap()
+        snap.vocab = frozen
+        snap.resource_names = resource_names
+        snap.catalog = catalog
+        snap.class_masks = class_masks
+        snap.class_requests = class_requests
+
+        return _Prepared(
+            snapshot=snap,
+            classes=classes,
+            templates=self.templates,
+            class_it=class_it,
+            tmpl_ok=tmpl_ok,
+            new_template=new_template,
+            kstar=kstar,
+            statics=statics,
+            init_state=init_state,
+            exist_taint_ok=exist_taint_ok,
+            existing_sims=existing_sims,
+            n_slots=N,
+        )
+
+    def _class_steps(self, prep: _Prepared) -> ClassStep:
+        cm = prep.snapshot.class_masks
+        C = len(prep.classes)
+        counts = np.array([c.count for c in prep.classes], dtype=np.int32)
+        return ClassStep(
+            mask=jnp.asarray(cm.mask),
+            defines=jnp.asarray(cm.defines),
+            concrete=jnp.asarray(cm.concrete),
+            negative=jnp.asarray(cm.negative),
+            gt=jnp.asarray(cm.gt),
+            lt=jnp.asarray(cm.lt),
+            count=jnp.asarray(counts),
+            requests=jnp.asarray(prep.snapshot.class_requests),
+            class_it=jnp.asarray(prep.class_it),
+            tmpl_ok=jnp.asarray(prep.tmpl_ok),
+            exist_taint_ok=jnp.asarray(prep.exist_taint_ok),
+            new_template=jnp.asarray(prep.new_template),
+            kstar=jnp.asarray(prep.kstar),
+        )
+
+    def _catalog_union(self) -> List[InstanceType]:
+        seen = {}
+        for t in self.templates:
+            for it in t.instance_type_options:
+                seen.setdefault(id(it), it)
+        # include full per-pool catalogs so class_it covers everything
+        for its in self.instance_types.values():
+            for it in its:
+                seen.setdefault(id(it), it)
+        return list(seen.values())
+
+    def _node_daemon_overhead(self, node: SimNode) -> dict:
+        daemons = []
+        for p in self.daemonset_pods:
+            if Taints(node.taints).tolerates(p):
+                continue
+            if Requirements.from_labels(node.labels).compatible(
+                Requirements.from_pod(p)
+            ):
+                continue
+            daemons.append(p)
+        return resutil.requests_for_pods(*daemons)
+
+    # ------------------------------------------------------------------
+
+    def _decode(
+        self,
+        prep: _Prepared,
+        takes: np.ndarray,
+        unplaced: np.ndarray,
+        slot_template: np.ndarray,
+    ) -> Tuple[List[InFlightNodeClaim], List[ExistingNodeSim], list]:
+        """Re-materialize device placements through the host algebra.
+
+        Each slot's class groups are merged with the exact reference-semantics
+        machinery (Requirements.add + filter_instance_types), so the returned
+        claims are indistinguishable from greedy-path output. Any group the
+        host algebra rejects (device/host divergence) falls into the failed
+        list and re-enters via relaxation or greedy fallback."""
+        C, N = takes.shape
+        E = len(prep.existing_sims)
+        failed: list = []
+
+        # distribute per-class pod lists
+        assigned: Dict[int, List[Tuple[int, int]]] = {}  # slot -> [(class, k)]
+        for ci in range(C):
+            offset = 0
+            cls = prep.classes[ci]
+            for n in np.nonzero(takes[ci])[0]:
+                assigned.setdefault(int(n), []).append((ci, int(takes[ci, n])))
+            k_unplaced = int(unplaced[ci])
+            if k_unplaced:
+                for p in cls.pods[cls.count - k_unplaced :]:
+                    failed.append((p, "no nodepool matched pod"))
+
+        claims: List[InFlightNodeClaim] = []
+        topo = Topology()
+        pod_cursor = {ci: 0 for ci in range(C)}
+
+        for n in sorted(assigned):
+            groups = assigned[n]
+            if n < E:
+                target = prep.existing_sims[n]
+                add = target.add
+            else:
+                si = int(slot_template[n])
+                template = prep.templates[si]
+                target = InFlightNodeClaim(
+                    template,
+                    topo,
+                    self.daemon_overhead[si],
+                    template.instance_type_options,
+                )
+                claims.append(target)
+                add = target.add
+            for ci, k in groups:
+                cls = prep.classes[ci]
+                start = pod_cursor[ci]
+                pods = cls.pods[start : start + k]
+                pod_cursor[ci] = start + k
+                req = resutil.requests_for_pods(pods[0]) if pods else {}
+                for p in pods:
+                    try:
+                        add(p, req)
+                    except IncompatibleError as e:
+                        failed.append((p, f"device/host divergence: {e}"))
+        # drop empty claims (all groups failed)
+        claims = [c for c in claims if c.pods]
+        return claims, prep.existing_sims, failed

@@ -1,0 +1,293 @@
+"""The batched first-fit-decreasing kernel.
+
+The reference's hot loop walks pods one at a time through existing nodes,
+in-flight claims, and fresh templates (scheduler.go:208-316). Here the walk
+is a ``lax.scan`` over pod *equivalence classes* (solver/snapshot.py), each
+step placing a whole class with vectorized arithmetic over all open slots at
+once:
+
+* slot feasibility — the evolving claim-requirements state is kept as mask
+  planes ([N,K,V] value masks + defines/complement/negative/gt/lt planes)
+  and evaluated against the class with the same closed-world algebra as
+  ops/masks.compatible;
+* capacity — per-slot take counts ``k_max`` are computed per instance type
+  as floor((allocatable - requests) / class_request) and maximized over the
+  slot's viable-IT mask; existing nodes use their fixed available vector;
+* placement — first-fit in slot order via exclusive cumulative sums;
+  leftovers open ceil(rem / kstar) identical fresh slots from the class's
+  chosen template.
+
+Instance-type narrowing rides a dedicated [N,T] viable mask (so the huge
+instance-type value vocabulary never enters the slot planes), and offering
+availability is evaluated against the slot's zone/capacity-type masks each
+step (the claim-requirements-vs-offering check of nodeclaim.go:252).
+
+Known, deliberate round-1 deviations from pod-at-a-time semantics (parity-
+tested in tests/test_device_solver.py): within one class placement is
+first-fit in slot order rather than emptiest-first (scheduler.go:277), and
+same-shape classes are processed class-by-class rather than interleaved —
+both only matter once topology counting lands.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+BIG = jnp.float32(3.4e38)
+
+
+class SlotState(NamedTuple):
+    valmask: jax.Array  # [N, K, V] bool — intersected allowed values
+    defines: jax.Array  # [N, K] bool
+    complement: jax.Array  # [N, K] bool (AND of contributors)
+    negative: jax.Array  # [N, K] bool (AND of contributors)
+    gt: jax.Array  # [N, K] int32
+    lt: jax.Array  # [N, K] int32
+    itmask: jax.Array  # [N, T] bool — viable instance types (new slots)
+    requests: jax.Array  # [N, R] float32
+    capacity: jax.Array  # [N, R] float32 (existing slots; BIG for new)
+    kind: jax.Array  # [N] int8: 0 unused, 1 existing, 2 new
+    template: jax.Array  # [N] int32 (new slots; -1 otherwise)
+    next_free: jax.Array  # [] int32
+    overflow: jax.Array  # [] bool
+
+
+class ClassStep(NamedTuple):
+    """Per-class scanned inputs."""
+
+    mask: jax.Array  # [K, V] bool
+    defines: jax.Array  # [K] bool
+    concrete: jax.Array  # [K] bool
+    negative: jax.Array  # [K] bool
+    gt: jax.Array  # [K] int32
+    lt: jax.Array  # [K] int32
+    count: jax.Array  # [] int32
+    requests: jax.Array  # [R] float32
+    class_it: jax.Array  # [T] bool — pod-vs-instance-type compat
+    tmpl_ok: jax.Array  # [S] bool — compat+taints vs each template
+    exist_taint_ok: jax.Array  # [N] bool — tolerates existing slot n's taints
+    new_template: jax.Array  # [] int32 — chosen template for fresh nodes (-1 none)
+    kstar: jax.Array  # [] int32 — pods per fresh node on the best IT
+
+
+class FFDStatics(NamedTuple):
+    """Solve-constant device arrays."""
+
+    it_alloc: jax.Array  # [T, R]
+    off_avail: jax.Array  # [T, Z, CT] bool
+    zone_key: jax.Array  # [] int32 — key id of the zone label
+    ct_key: jax.Array  # [] int32 — key id of the capacity-type label
+    tmpl_mask: jax.Array  # [S, K, V]
+    tmpl_defines: jax.Array  # [S, K]
+    tmpl_complement: jax.Array  # [S, K]
+    tmpl_negative: jax.Array  # [S, K]
+    tmpl_gt: jax.Array  # [S, K]
+    tmpl_lt: jax.Array  # [S, K]
+    tmpl_it: jax.Array  # [S, T] bool
+    tmpl_overhead: jax.Array  # [S, R] — daemon overhead requests
+    well_known: jax.Array  # [K] bool
+    gt_none: jax.Array  # [] int32
+    lt_none: jax.Array  # [] int32
+
+
+def _class_slot_compatible(state: SlotState, c: ClassStep, statics: FFDStatics):
+    """Requirements.Compatible(class -> slot) vectorized over slots.
+
+    Mirrors ops/masks.compatible; the custom-label rule applies with
+    well-known keys exempt on new slots (nodeclaim.go:80) and no exemption
+    on existing nodes (existingnode.go:103)."""
+    overlap = jnp.any(state.valmask & c.mask[None, :, :], axis=-1)  # [N, K]
+    both = state.defines & c.defines[None, :]
+    either_concrete = ~state.complement | c.concrete[None, :]
+    crossed = jnp.maximum(state.gt, c.gt[None, :]) >= jnp.minimum(
+        state.lt, c.lt[None, :]
+    )
+    empty = jnp.where(either_concrete, ~overlap, crossed)
+    both_negative = state.negative & c.negative[None, :]
+    rule2 = both & empty & ~both_negative
+
+    is_new = (state.kind == 2)[:, None]
+    allow = statics.well_known[None, :] & is_new
+    rule1 = (
+        c.defines[None, :]
+        & ~c.negative[None, :]
+        & ~state.defines
+        & ~allow
+    )
+    return ~jnp.any(rule1 | rule2, axis=-1)  # [N]
+
+
+def _offering_ok(state: SlotState, statics: FFDStatics, joined_valmask):
+    """[N, T] — instance type t has an available offering compatible with the
+    slot's (zone, capacity-type) masks after the joining class narrows them
+    (cloudprovider types.go:256-310 Offerings.Available().HasCompatible)."""
+    Z = statics.off_avail.shape[1]
+    CT = statics.off_avail.shape[2]
+    zmask = jax.lax.dynamic_index_in_dim(
+        joined_valmask, statics.zone_key, axis=1, keepdims=False
+    )[:, :Z]  # [N, Z]
+    ctmask = jax.lax.dynamic_index_in_dim(
+        joined_valmask, statics.ct_key, axis=1, keepdims=False
+    )[:, :CT]  # [N, CT]
+    # any (z, ct): off_avail[t, z, ct] & zmask[n, z] & ctmask[n, ct]
+    per_zone = jnp.einsum(
+        "tzc,nc->ntz",
+        statics.off_avail.astype(jnp.float32),
+        ctmask.astype(jnp.float32),
+    )
+    joint = jnp.einsum("ntz,nz->nt", per_zone, zmask.astype(jnp.float32))
+    return joint > 0
+
+
+def _k_max(state: SlotState, c: ClassStep, statics: FFDStatics, viable_it):
+    """Max pods of the class each slot can absorb. [N]"""
+    r = c.requests  # [R]
+    safe_r = jnp.where(r > 0, r, 1.0)
+    # new slots: per viable instance type
+    head = (statics.it_alloc[None, :, :] - state.requests[:, None, :]) / safe_r
+    head = jnp.where(r[None, None, :] > 0, head, BIG)
+    k_it = jnp.floor(jnp.min(head, axis=-1))  # [N, T]
+    k_it = jnp.where(viable_it, k_it, -1.0)
+    k_new = jnp.max(k_it, axis=-1)  # [N]
+    # existing slots: fixed available capacity
+    head_e = (state.capacity - state.requests) / safe_r
+    head_e = jnp.where(r[None, :] > 0, head_e, BIG)
+    k_exist = jnp.floor(jnp.min(head_e, axis=-1))  # [N]
+    k = jnp.where(state.kind == 1, k_exist, k_new)
+    return jnp.clip(k, 0.0, 2**30).astype(jnp.int32)
+
+
+def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics):
+    """Place one pod class; returns (state', take [N] int32 + unplaced [])."""
+    N = state.kind.shape[0]
+
+    # -- feasibility on open slots ---------------------------------------
+    req_ok = _class_slot_compatible(state, c, statics)
+    taint_ok = jnp.where(
+        state.kind == 1,
+        c.exist_taint_ok,
+        c.tmpl_ok[jnp.clip(state.template, 0)],
+    )
+    joined_valmask = state.valmask & jnp.where(
+        c.defines[None, :, None], c.mask[None, :, :], True
+    )
+    off_ok = _offering_ok(state, statics, joined_valmask)  # [N, T]
+    viable_it = state.itmask & c.class_it[None, :] & off_ok
+    k_max = _k_max(state, c, statics, viable_it)
+
+    feasible = (
+        (state.kind > 0)
+        & req_ok
+        & taint_ok
+        & ((state.kind == 1) | jnp.any(viable_it, axis=-1))
+    )
+    k_max = jnp.where(feasible, k_max, 0)
+
+    # -- first-fit fill in slot order ------------------------------------
+    m = c.count
+    before = jnp.cumsum(k_max) - k_max  # exclusive prefix
+    take = jnp.clip(m - before, 0, k_max)  # [N]
+    rem = m - jnp.sum(take)
+
+    # -- open fresh slots -------------------------------------------------
+    has_template = c.new_template >= 0
+    kstar = jnp.maximum(c.kstar, 1)
+    n_new = jnp.where(
+        has_template & (rem > 0), (rem + kstar - 1) // kstar, 0
+    )
+    idx = jnp.arange(N, dtype=jnp.int32)
+    fresh = (idx >= state.next_free) & (idx < state.next_free + n_new)
+    take_fresh = jnp.where(
+        fresh, jnp.clip(rem - (idx - state.next_free) * kstar, 0, kstar), 0
+    )
+    overflow = state.overflow | (state.next_free + n_new > N)
+    unplaced = jnp.where(has_template, 0, rem)
+
+    s = jnp.clip(c.new_template, 0)
+    took = take > 0
+
+    # -- merge class requirement state into slots that took ---------------
+    # Invariant (established by the model builder): keys an entity does not
+    # define carry NEUTRAL state — all-True valmask, complement=True,
+    # negative=True, sentinel bounds — so intersection-on-add is uniform:
+    # mask AND, complement AND (~concrete), negative AND, gt max, lt min
+    # (requirement.go:155-188 under the closed world).
+    upd = (took | fresh)[:, None] & c.defines[None, :]  # [N, K]
+    base_valmask = jnp.where(
+        fresh[:, None, None], statics.tmpl_mask[s][None, :, :], state.valmask
+    )
+    base_defines = jnp.where(fresh[:, None], statics.tmpl_defines[s][None, :], state.defines)
+    base_complement = jnp.where(
+        fresh[:, None], statics.tmpl_complement[s][None, :], state.complement
+    )
+    base_negative = jnp.where(
+        fresh[:, None], statics.tmpl_negative[s][None, :], state.negative
+    )
+    base_gt = jnp.where(fresh[:, None], statics.tmpl_gt[s][None, :], state.gt)
+    base_lt = jnp.where(fresh[:, None], statics.tmpl_lt[s][None, :], state.lt)
+
+    new_valmask = jnp.where(
+        upd[:, :, None], base_valmask & c.mask[None, :, :], base_valmask
+    )
+    new_defines = base_defines | upd
+    new_complement = jnp.where(
+        upd, base_complement & ~c.concrete[None, :], base_complement
+    )
+    new_negative = jnp.where(upd, base_negative & c.negative[None, :], base_negative)
+    new_gt = jnp.where(upd, jnp.maximum(base_gt, c.gt[None, :]), base_gt)
+    new_lt = jnp.where(upd, jnp.minimum(base_lt, c.lt[None, :]), base_lt)
+
+    # -- requests / capacity / itmask -------------------------------------
+    take_all = take + take_fresh
+    base_requests = jnp.where(
+        fresh[:, None], statics.tmpl_overhead[s][None, :], state.requests
+    )
+    new_requests = base_requests + take_all[:, None].astype(jnp.float32) * c.requests[None, :]
+
+    fits_new = jnp.all(
+        new_requests[:, None, :] <= statics.it_alloc[None, :, :], axis=-1
+    )  # [N, T]
+    base_itmask = jnp.where(
+        fresh[:, None], statics.tmpl_it[s][None, :], state.itmask
+    )
+    joined = took | fresh
+    new_itmask = jnp.where(
+        joined[:, None],
+        base_itmask & c.class_it[None, :] & fits_new & _offering_ok(
+            state, statics, new_valmask
+        ),
+        base_itmask,
+    )
+
+    new_kind = jnp.where(fresh, jnp.int8(2), state.kind)
+    new_template = jnp.where(fresh, s, state.template)
+    new_capacity = jnp.where(fresh[:, None], BIG, state.capacity)
+
+    state2 = SlotState(
+        valmask=new_valmask,
+        defines=new_defines,
+        complement=new_complement,
+        negative=new_negative,
+        gt=new_gt,
+        lt=new_lt,
+        itmask=new_itmask,
+        requests=new_requests,
+        capacity=new_capacity,
+        kind=new_kind,
+        template=new_template,
+        next_free=state.next_free + n_new,
+        overflow=overflow,
+    )
+    return state2, (take_all, unplaced)
+
+
+@partial(jax.jit, static_argnames=())
+def ffd_solve(state: SlotState, classes: ClassStep, statics: FFDStatics):
+    """Scan all classes; returns (final state, takes [C, N], unplaced [C])."""
+    final, (takes, unplaced) = jax.lax.scan(
+        lambda st, c: ffd_step(st, c, statics), state, classes
+    )
+    return final, takes, unplaced

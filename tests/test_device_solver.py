@@ -1,0 +1,239 @@
+"""Differential tests: DeviceScheduler (TPU class-FFD solve) vs the greedy
+host oracle. Node-count parity and zero constraint violations on identical
+inputs (SURVEY.md §4 blueprint item (a))."""
+import pytest
+
+from helpers import GIB, make_diverse_pods, make_nodepool, make_pod
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.api.objects import NodeSelectorRequirement, Taint, Toleration
+from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import SimNode
+from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import Scheduler
+from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+CATALOG = build_catalog(cpu_grid=[1, 2, 4, 8, 16], mem_factors=[2, 4])  # 40 types
+
+
+def both(nodepools=None, existing=None, daemons=None, catalog=None):
+    nodepools = nodepools or [make_nodepool()]
+    catalog = catalog or CATALOG
+    its = {np.name: list(catalog) for np in nodepools}
+    greedy = Scheduler(nodepools, its, existing_nodes=existing, daemonset_pods=daemons)
+    device = DeviceScheduler(
+        nodepools, its, existing_nodes=existing, daemonset_pods=daemons,
+        max_slots=64,
+    )
+    return greedy, device
+
+
+def assert_parity(pods_factory, nodepools=None, existing=None, exact=True):
+    import copy
+
+    greedy, device = both(
+        nodepools=copy.deepcopy(nodepools) if nodepools else None,
+        existing=copy.deepcopy(existing) if existing else None,
+    )
+    g = greedy.solve(pods_factory())
+    d = device.solve(pods_factory())
+    assert g.all_pods_scheduled() == d.all_pods_scheduled(), (
+        f"scheduled mismatch: greedy={g.pod_errors} device={d.pod_errors}"
+    )
+    if exact:
+        assert g.node_count() == d.node_count(), (
+            f"node count: greedy={g.node_count()} device={d.node_count()}"
+        )
+    # pods conservation
+    g_pods = sum(len(c.pods) for c in g.new_node_claims) + sum(
+        len(n.pods) for n in g.existing_nodes
+    )
+    d_pods = sum(len(c.pods) for c in d.new_node_claims) + sum(
+        len(n.pods) for n in d.existing_nodes
+    )
+    assert g_pods == d_pods
+    return g, d
+
+
+class TestParityBasic:
+    def test_single_pod(self):
+        assert_parity(lambda: [make_pod(cpu=1.0)])
+
+    def test_homogeneous_small(self):
+        assert_parity(
+            lambda: [make_pod(cpu=0.5, memory_gib=1.0, name=f"p{i}") for i in range(50)]
+        )
+
+    def test_homogeneous_large_batch(self):
+        assert_parity(
+            lambda: [make_pod(cpu=2.0, memory_gib=2.0, name=f"p{i}") for i in range(500)]
+        )
+
+    def test_two_sizes(self):
+        def pods():
+            return [make_pod(cpu=4.0, name=f"big{i}") for i in range(20)] + [
+                make_pod(cpu=0.25, name=f"small{i}") for i in range(100)
+            ]
+
+        assert_parity(pods)
+
+    def test_unschedulable_huge_pod(self):
+        g, d = assert_parity(lambda: [make_pod(cpu=10000.0)])
+        assert not d.all_pods_scheduled()
+
+
+class TestParityRequirements:
+    def test_arch_selector(self):
+        assert_parity(
+            lambda: [
+                make_pod(node_selector={L.LABEL_ARCH: "arm64"}, name=f"p{i}")
+                for i in range(30)
+            ]
+        )
+
+    def test_zone_partition(self):
+        def pods():
+            out = []
+            for i in range(30):
+                out.append(make_pod(cpu=0.5, zone_in=["zone-a"], name=f"a{i}"))
+                out.append(make_pod(cpu=0.5, zone_in=["zone-b"], name=f"b{i}"))
+            return out
+
+        assert_parity(pods)
+
+    def test_mixed_constrained_unconstrained(self):
+        def pods():
+            return (
+                [make_pod(cpu=1.0, name=f"free{i}") for i in range(25)]
+                + [
+                    make_pod(
+                        cpu=1.0,
+                        node_selector={L.LABEL_OS: "linux"},
+                        name=f"lin{i}",
+                    )
+                    for i in range(25)
+                ]
+            )
+
+        assert_parity(pods)
+
+    def test_nodepool_requirements(self):
+        np_ = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(L.LABEL_ARCH, "In", ("amd64",)),
+                NodeSelectorRequirement(
+                    L.LABEL_TOPOLOGY_ZONE, "In", ("zone-a", "zone-b")
+                ),
+            ]
+        )
+        assert_parity(
+            lambda: [make_pod(cpu=1.0, name=f"p{i}") for i in range(40)],
+            nodepools=[np_],
+        )
+
+    def test_custom_label_nodepool(self):
+        np_ = make_nodepool()
+        np_.spec.template.labels = {"mycompany.io/team": "infra"}
+        assert_parity(
+            lambda: [
+                make_pod(
+                    node_selector={"mycompany.io/team": "infra"}, name=f"p{i}"
+                )
+                for i in range(10)
+            ]
+            + [make_pod(name=f"q{i}") for i in range(10)],
+            nodepools=[np_],
+        )
+
+    def test_incompatible_selector_fails_both(self):
+        g, d = assert_parity(
+            lambda: [make_pod(node_selector={L.LABEL_ARCH: "riscv"})]
+        )
+        assert not d.all_pods_scheduled()
+
+
+class TestParityTaints:
+    def test_tainted_pool(self):
+        np_ = make_nodepool(
+            taints=[Taint(key="dedicated", value="ml", effect="NoSchedule")]
+        )
+        tol = [Toleration(key="dedicated", operator="Equal", value="ml")]
+        assert_parity(
+            lambda: [make_pod(tolerations=tol, name=f"t{i}") for i in range(10)]
+            + [make_pod(name=f"n{i}") for i in range(5)],
+            nodepools=[np_],
+        )
+
+    def test_two_pools_taint_split(self):
+        plain = make_nodepool("plain")
+        tainted = make_nodepool(
+            "tainted", taints=[Taint(key="gpu", value="", effect="NoSchedule")]
+        )
+        assert_parity(
+            lambda: [make_pod(name=f"p{i}") for i in range(20)],
+            nodepools=[plain, tainted],
+        )
+
+
+class TestParityExisting:
+    def _nodes(self, n=2, cpu=8.0):
+        return [
+            SimNode(
+                name=f"existing-{i}",
+                labels={
+                    L.LABEL_ARCH: "amd64",
+                    L.LABEL_OS: "linux",
+                    L.LABEL_TOPOLOGY_ZONE: "zone-a",
+                    L.NODEPOOL_LABEL_KEY: "default",
+                    L.LABEL_INSTANCE_TYPE: "s-8x-amd64-linux",
+                },
+                taints=[],
+                available={"cpu": cpu, "memory": 16 * GIB, "pods": 100.0},
+                capacity={"cpu": cpu, "memory": 16 * GIB, "pods": 110.0},
+            )
+            for i in range(n)
+        ]
+
+    def test_fill_existing_first(self):
+        assert_parity(
+            lambda: [make_pod(cpu=1.0, name=f"p{i}") for i in range(10)],
+            existing=self._nodes(),
+        )
+
+    def test_overflow_to_new(self):
+        assert_parity(
+            lambda: [make_pod(cpu=2.0, name=f"p{i}") for i in range(30)],
+            existing=self._nodes(),
+        )
+
+    def test_tainted_existing_skipped(self):
+        nodes = self._nodes(1)
+        nodes[0].taints = [Taint(key="x", effect="NoSchedule")]
+        assert_parity(
+            lambda: [make_pod(cpu=1.0, name=f"p{i}") for i in range(5)],
+            existing=nodes,
+        )
+
+
+class TestParityScale:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_diverse_mix(self, seed):
+        # node counts may differ slightly (first-fit vs emptiest-first within
+        # class); require <= 10% deviation and full schedulability
+        import copy
+
+        pods = make_diverse_pods(300, seed=seed)
+        greedy, device = both()
+        g = greedy.solve(copy.deepcopy(pods))
+        d = device.solve(copy.deepcopy(pods))
+        assert g.all_pods_scheduled()
+        assert d.all_pods_scheduled()
+        assert abs(g.node_count() - d.node_count()) <= max(
+            1, int(0.1 * g.node_count())
+        ), f"greedy={g.node_count()} device={d.node_count()}"
+
+    def test_no_divergence_failures(self):
+        device = both()[1]
+        res = device.solve(make_diverse_pods(200, seed=7))
+        assert not any(
+            "divergence" in msg for msg in res.pod_errors.values()
+        ), res.pod_errors
