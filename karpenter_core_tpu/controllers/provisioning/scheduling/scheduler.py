@@ -116,15 +116,7 @@ class Scheduler:
     def _build_existing(self, nodes: List[SimNode], daemonset_pods: List[Pod]):
         """(scheduler.go:318-354)"""
         for node in nodes:
-            daemons = []
-            for p in daemonset_pods:
-                if Taints(node.taints).tolerates(p):
-                    continue
-                if Requirements.from_labels(node.labels).compatible(
-                    Requirements.from_pod(p)
-                ):
-                    continue
-                daemons.append(p)
+            daemons = node_daemon_pods(node, daemonset_pods)
             self.existing_nodes.append(
                 ExistingNodeSim(
                     node, self.topology, resutil.requests_for_pods(*daemons)
@@ -223,6 +215,21 @@ class Scheduler:
                 )
             return None
         return "; ".join(errs) or "no nodepool matched pod"
+
+
+def node_daemon_pods(node: SimNode, daemonset_pods: List[Pod]) -> List[Pod]:
+    """Daemonset pods that would land on this node: tolerate its taints and
+    match its labels (scheduler.go:320-332)."""
+    daemons = []
+    for p in daemonset_pods:
+        if Taints(node.taints).tolerates(p):
+            continue
+        if Requirements.from_labels(node.labels).compatible(
+            Requirements.from_pod(p)
+        ):
+            continue
+        daemons.append(p)
+    return daemons
 
 
 def _daemon_compatible(template: NodeClaimTemplate, pod: Pod) -> bool:

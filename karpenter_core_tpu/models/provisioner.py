@@ -80,9 +80,17 @@ def _tolerates_taints(tolerations, taints) -> bool:
     return all(any(tol.tolerates(t) for tol in tolerations) for t in taints)
 
 
+class _SlotOverflow(Exception):
+    """More slots needed than max_slots — caller doubles and retries."""
+
+
 @dataclass
 class _Prepared:
-    snapshot: object
+    vocab: object
+    resource_names: List[str]
+    catalog: List[InstanceType]
+    class_masks: EntityMasks
+    class_requests: np.ndarray  # [C, R]
     classes: List[PodClass]
     templates: List[NodeClaimTemplate]
     class_it: np.ndarray  # [C, T]
@@ -110,7 +118,11 @@ class DeviceScheduler:
     ):
         self.nodepools = sorted(nodepools, key=lambda n: (-n.spec.weight, n.name))
         self.instance_types = instance_types
-        self.existing_nodes = list(existing_nodes or [])
+        # initialized nodes first, then by name (scheduler.go:344-354) —
+        # must match the greedy oracle's fill order
+        self.existing_nodes = sorted(
+            existing_nodes or [], key=lambda n: (not n.initialized, n.name)
+        )
         self.daemonset_pods = list(daemonset_pods or [])
         self.max_slots = max_slots
         self.validate = validate
@@ -147,35 +159,31 @@ class DeviceScheduler:
     # ------------------------------------------------------------------
 
     def solve(self, pods: List[Pod]) -> Results:
-        """Device solve + host decode + relaxation outer loop."""
-        import copy
+        """Device solve + host decode + relaxation outer loop.
 
-        pending = list(pods)
+        Each relaxation round re-solves the FULL pod set (relaxations mutate
+        only previously-failed pods' specs), so placements from earlier rounds
+        are never dropped — the same world-re-solve the reference reaches via
+        requeue-on-relax (scheduler.go:251-258)."""
+        all_pods = list(pods)
         errors: Dict[str, str] = {}
         claims: List[InFlightNodeClaim] = []
         existing_sims: List[ExistingNodeSim] = []
         max_slots = self.max_slots
 
-        for _ in range(8):  # relaxation rounds (preferences ladder depth)
-            if not pending:
-                break
-            result = self._solve_once(pending, max_slots)
+        for _ in range(16):  # relaxation ladder depth + overflow retries
+            result = self._solve_once(all_pods, max_slots)
             if result is None:  # slot overflow — retry larger
                 max_slots *= 2
                 continue
             claims, existing_sims, failed = result
-            if not failed:
-                errors = {}
-                pending = []
-                break
             errors = {p.uid: msg for p, msg in failed}
+            if not failed:
+                break
             relaxed_any = False
-            next_pending = []
             for p, _msg in failed:
                 if self.preferences.relax(p):
                     relaxed_any = True
-                next_pending.append(p)
-            pending = next_pending
             if not relaxed_any:
                 break
 
@@ -192,7 +200,10 @@ class DeviceScheduler:
     def _solve_once(
         self, pods: List[Pod], max_slots: int
     ) -> Optional[Tuple[List[InFlightNodeClaim], List[ExistingNodeSim], list]]:
-        prep = self._prepare(pods, max_slots)
+        try:
+            prep = self._prepare(pods, max_slots)
+        except _SlotOverflow:
+            return None
         if prep is None:
             # no viable templates and no existing capacity: everything fails
             return [], [], [(p, "no nodepool matched pod") for p in pods]
@@ -205,9 +216,12 @@ class DeviceScheduler:
         )
         if bool(state.overflow):
             return None
-        takes = np.asarray(takes)  # [C, N]
-        unplaced = np.asarray(unplaced)
-        return self._decode(prep, np.asarray(takes), unplaced, np.asarray(state.template))
+        return self._decode(
+            prep,
+            np.asarray(takes),
+            np.asarray(unplaced),
+            np.asarray(state.template),
+        )
 
     # ------------------------------------------------------------------
 
@@ -230,6 +244,9 @@ class DeviceScheduler:
 
         catalog = self._catalog_union()
         T, S = len(catalog), len(self.templates)
+        # T == 0 (existing-capacity-only solve) keeps a dummy never-viable
+        # IT axis so reductions over T stay well-formed
+        pad_T = max(T, 1)
         exist_label_reqs = [
             Requirements.from_labels(n.labels) for n in self.existing_nodes
         ]
@@ -281,14 +298,16 @@ class DeviceScheduler:
             [rvec(resutil.requests_for_pods(c.pods[0])) for c in classes]
         ) if classes else np.zeros((0, R), dtype=np.float32)
 
-        it_alloc = np.stack([rvec(it.allocatable()) for it in catalog])
+        it_alloc = np.zeros((pad_T, R), dtype=np.float32)
+        for ti, it in enumerate(catalog):
+            it_alloc[ti] = rvec(it.allocatable())
 
         # offerings tensor [T, Z, CT] over the zone/ct vocab rows
         zone_kid = frozen.keys.get(apilabels.LABEL_TOPOLOGY_ZONE, 0)
         ct_kid = frozen.keys.get(apilabels.CAPACITY_TYPE_LABEL_KEY, 0)
         Z = max(len(frozen.value_names[zone_kid]), 1)
         CT = max(len(frozen.value_names[ct_kid]), 1)
-        off_avail = np.zeros((T, Z, CT), dtype=bool)
+        off_avail = np.zeros((pad_T, Z, CT), dtype=bool)
         for ti, it in enumerate(catalog):
             for off in it.offerings:
                 if not off.available:
@@ -305,7 +324,11 @@ class DeviceScheduler:
                 cm.mask, cm.defines, cm.concrete, cm.negative, cm.gt, cm.lt,
                 im.mask, im.defines, im.concrete, im.negative, im.gt, im.lt,
             )
-        ) if C else np.zeros((0, T), dtype=bool)
+        ) if C and T else np.zeros((C, T), dtype=bool)
+        if class_it.shape[1] < pad_T:
+            class_it = np.pad(
+                class_it, ((0, 0), (0, pad_T - class_it.shape[1]))
+            )
         tmpl_compat = np.asarray(
             mops.compatible(
                 cm.mask, cm.defines, cm.concrete, cm.negative, cm.gt, cm.lt,
@@ -325,7 +348,7 @@ class DeviceScheduler:
 
         # template-IT viability from the host prefilter (exact reference path)
         it_index = {id(it): i for i, it in enumerate(catalog)}
-        tmpl_it = np.zeros((S, T), dtype=bool)
+        tmpl_it = np.zeros((S, pad_T), dtype=bool)
         for si, t in enumerate(self.templates):
             for it in t.instance_type_options:
                 tmpl_it[si, it_index[id(it)]] = True
@@ -366,7 +389,7 @@ class DeviceScheduler:
         K, V = frozen.K, frozen.V
         E = len(self.existing_nodes)
         if E > N:
-            return None
+            raise _SlotOverflow()
 
         valmask = np.ones((N, K, V), dtype=bool)
         defines = np.zeros((N, K), dtype=bool)
@@ -374,7 +397,7 @@ class DeviceScheduler:
         negative = np.ones((N, K), dtype=bool)
         gt = np.full((N, K), GT_NONE, dtype=np.int32)
         lt = np.full((N, K), LT_NONE, dtype=np.int32)
-        itmask = np.zeros((N, T), dtype=bool)
+        itmask = np.zeros((N, pad_T), dtype=bool)
         requests = np.zeros((N, R), dtype=np.float32)
         capacity = np.full((N, R), np.float32(BIG))
         kind = np.zeros((N,), dtype=np.int8)
@@ -443,18 +466,12 @@ class DeviceScheduler:
             overflow=jnp.asarray(False),
         )
 
-        class Snap:
-            pass
-
-        snap = Snap()
-        snap.vocab = frozen
-        snap.resource_names = resource_names
-        snap.catalog = catalog
-        snap.class_masks = class_masks
-        snap.class_requests = class_requests
-
         return _Prepared(
-            snapshot=snap,
+            vocab=frozen,
+            resource_names=resource_names,
+            catalog=catalog,
+            class_masks=class_masks,
+            class_requests=class_requests,
             classes=classes,
             templates=self.templates,
             class_it=class_it,
@@ -469,8 +486,7 @@ class DeviceScheduler:
         )
 
     def _class_steps(self, prep: _Prepared) -> ClassStep:
-        cm = prep.snapshot.class_masks
-        C = len(prep.classes)
+        cm = prep.class_masks
         counts = np.array([c.count for c in prep.classes], dtype=np.int32)
         return ClassStep(
             mask=jnp.asarray(cm.mask),
@@ -480,7 +496,7 @@ class DeviceScheduler:
             gt=jnp.asarray(cm.gt),
             lt=jnp.asarray(cm.lt),
             count=jnp.asarray(counts),
-            requests=jnp.asarray(prep.snapshot.class_requests),
+            requests=jnp.asarray(prep.class_requests),
             class_it=jnp.asarray(prep.class_it),
             tmpl_ok=jnp.asarray(prep.tmpl_ok),
             exist_taint_ok=jnp.asarray(prep.exist_taint_ok),
@@ -500,16 +516,13 @@ class DeviceScheduler:
         return list(seen.values())
 
     def _node_daemon_overhead(self, node: SimNode) -> dict:
-        daemons = []
-        for p in self.daemonset_pods:
-            if Taints(node.taints).tolerates(p):
-                continue
-            if Requirements.from_labels(node.labels).compatible(
-                Requirements.from_pod(p)
-            ):
-                continue
-            daemons.append(p)
-        return resutil.requests_for_pods(*daemons)
+        from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+            node_daemon_pods,
+        )
+
+        return resutil.requests_for_pods(
+            *node_daemon_pods(node, self.daemonset_pods)
+        )
 
     # ------------------------------------------------------------------
 
@@ -534,7 +547,6 @@ class DeviceScheduler:
         # distribute per-class pod lists
         assigned: Dict[int, List[Tuple[int, int]]] = {}  # slot -> [(class, k)]
         for ci in range(C):
-            offset = 0
             cls = prep.classes[ci]
             for n in np.nonzero(takes[ci])[0]:
                 assigned.setdefault(int(n), []).append((ci, int(takes[ci, n])))

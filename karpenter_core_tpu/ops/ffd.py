@@ -120,7 +120,7 @@ def _class_slot_compatible(state: SlotState, c: ClassStep, statics: FFDStatics):
     return ~jnp.any(rule1 | rule2, axis=-1)  # [N]
 
 
-def _offering_ok(state: SlotState, statics: FFDStatics, joined_valmask):
+def _offering_ok(statics: FFDStatics, joined_valmask):
     """[N, T] — instance type t has an available offering compatible with the
     slot's (zone, capacity-type) masks after the joining class narrows them
     (cloudprovider types.go:256-310 Offerings.Available().HasCompatible)."""
@@ -174,7 +174,7 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics):
     joined_valmask = state.valmask & jnp.where(
         c.defines[None, :, None], c.mask[None, :, :], True
     )
-    off_ok = _offering_ok(state, statics, joined_valmask)  # [N, T]
+    off_ok = _offering_ok(statics, joined_valmask)  # [N, T]
     viable_it = state.itmask & c.class_it[None, :] & off_ok
     k_max = _k_max(state, c, statics, viable_it)
 
@@ -257,7 +257,7 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics):
     new_itmask = jnp.where(
         joined[:, None],
         base_itmask & c.class_it[None, :] & fits_new & _offering_ok(
-            state, statics, new_valmask
+            statics, new_valmask
         ),
         base_itmask,
     )

@@ -1,14 +1,29 @@
-"""Test configuration: force an 8-device virtual CPU mesh before jax loads.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
 Real TPU hardware (one chip under axon) is reserved for bench.py; the test
 suite exercises the multi-chip sharding paths on a virtual CPU mesh the same
 way the driver's dryrun does.
+
+This box's axon sitecustomize imports jax and programmatically selects the
+axon platform at interpreter start, so env vars (JAX_PLATFORMS /
+JAX_PLATFORM_NAME) set here are too late — the working override is
+jax.config.update after import, before first backend use.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    assert jax.default_backend() == "cpu", (
+        f"tests must run on the virtual CPU mesh, got {jax.default_backend()}"
+    )
+    assert len(jax.devices()) == 8, f"expected 8 CPU devices, got {jax.devices()}"

@@ -214,6 +214,79 @@ class TestParityExisting:
         )
 
 
+class TestRegressions:
+    def test_relaxation_keeps_earlier_placements(self):
+        # 8 plain pods + 1 pod with unsatisfiable preferred affinity: the
+        # relax round must re-solve the world, not just the failed pod
+        from karpenter_core_tpu.api.objects import (
+            Affinity,
+            NodeAffinity,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+            PreferredSchedulingTerm,
+        )
+
+        plain = [make_pod(cpu=0.5, name=f"plain{i}") for i in range(8)]
+        fussy = make_pod(cpu=0.5, name="fussy")
+        fussy.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                preferred=[
+                    PreferredSchedulingTerm(
+                        weight=1,
+                        preference=NodeSelectorTerm(
+                            match_expressions=(
+                                NodeSelectorRequirement(
+                                    L.LABEL_TOPOLOGY_ZONE, "In", ("nope",)
+                                ),
+                            )
+                        ),
+                    )
+                ]
+            )
+        )
+        device = both()[1]
+        res = device.solve(plain + [fussy])
+        assert res.all_pods_scheduled(), res.pod_errors
+        placed = sum(len(c.pods) for c in res.new_node_claims) + sum(
+            len(n.pods) for n in res.existing_nodes
+        )
+        assert placed == 9
+
+    def test_empty_catalog_with_existing_nodes(self):
+        nodes = [
+            SimNode(
+                name="only",
+                labels={L.NODEPOOL_LABEL_KEY: "default"},
+                taints=[],
+                available={"cpu": 4.0, "memory": 8 * GIB, "pods": 10.0},
+            )
+        ]
+        device = DeviceScheduler(
+            [make_nodepool()], {"default": []}, existing_nodes=nodes, max_slots=8
+        )
+        res = device.solve([make_pod(cpu=1.0)])
+        assert res.all_pods_scheduled(), res.pod_errors
+        assert res.node_count() == 0
+        assert len(res.existing_nodes[0].pods) == 1
+
+    def test_more_existing_nodes_than_slots_grows(self):
+        nodes = [
+            SimNode(
+                name=f"n{i}",
+                labels={L.NODEPOOL_LABEL_KEY: "default"},
+                taints=[],
+                available={"cpu": 4.0, "memory": 8 * GIB, "pods": 10.0},
+            )
+            for i in range(3)
+        ]
+        device = DeviceScheduler(
+            [make_nodepool()], {"default": CATALOG}, existing_nodes=nodes,
+            max_slots=2,
+        )
+        res = device.solve([make_pod(cpu=1.0)])
+        assert res.all_pods_scheduled(), res.pod_errors
+
+
 class TestParityScale:
     @pytest.mark.parametrize("seed", [0, 1, 2])
     def test_diverse_mix(self, seed):
