@@ -47,7 +47,11 @@ from karpenter_core_tpu.controllers.provisioning.scheduling.nodeclaimtemplate im
 from karpenter_core_tpu.controllers.provisioning.scheduling.preferences import (
     Preferences,
 )
-from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import Results
+from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+    Results,
+    _daemon_compatible,
+    node_daemon_pods,
+)
 from karpenter_core_tpu.controllers.provisioning.scheduling.topology import Topology
 from karpenter_core_tpu.ops import masks as mops
 from karpenter_core_tpu.ops.ffd import (
@@ -82,6 +86,11 @@ def _tolerates_taints(tolerations, taints) -> bool:
 
 class _SlotOverflow(Exception):
     """More slots needed than max_slots — caller doubles and retries."""
+
+
+# one slot per pod is the true worst case; 1M slots is far past any
+# realistic solve and bounds the doubling loop
+_SLOT_HARD_CAP = 1 << 20
 
 
 @dataclass
@@ -145,10 +154,6 @@ class DeviceScheduler:
                 self.templates.append(nct)
 
         # daemon overhead per template (scheduler.go:358-364)
-        from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
-            _daemon_compatible,
-        )
-
         self.daemon_overhead = [
             resutil.requests_for_pods(
                 *[p for p in self.daemonset_pods if _daemon_compatible(nct, p)]
@@ -170,10 +175,22 @@ class DeviceScheduler:
         claims: List[InFlightNodeClaim] = []
         existing_sims: List[ExistingNodeSim] = []
         max_slots = self.max_slots
+        while max_slots < len(self.existing_nodes):
+            max_slots *= 2
 
-        for _ in range(16):  # relaxation ladder depth + overflow retries
+        # relaxation terminates naturally: each relax() strips one soft term
+        # (preferences.go:38-57); the greedy oracle loops the same way
+        while True:
             result = self._solve_once(all_pods, max_slots)
             if result is None:  # slot overflow — retry larger
+                if max_slots >= _SLOT_HARD_CAP:
+                    errors = {
+                        p.uid: f"solver slot overflow at {max_slots} slots"
+                        for p in all_pods
+                    }
+                    return Results(
+                        new_node_claims=[], existing_nodes=[], pod_errors=errors
+                    )
                 max_slots *= 2
                 continue
             claims, existing_sims, failed = result
@@ -245,8 +262,10 @@ class DeviceScheduler:
         catalog = self._catalog_union()
         T, S = len(catalog), len(self.templates)
         # T == 0 (existing-capacity-only solve) keeps a dummy never-viable
-        # IT axis so reductions over T stay well-formed
+        # IT axis so reductions over T stay well-formed; same for the
+        # template axis S (gathers on a zero-size axis are invalid)
         pad_T = max(T, 1)
+        pad_S = max(S, 1)
         exist_label_reqs = [
             Requirements.from_labels(n.labels) for n in self.existing_nodes
         ]
@@ -287,6 +306,15 @@ class DeviceScheduler:
         tmpl_masks = _neutralize(
             encode_requirements_batch(frozen, [t.requirements for t in self.templates])
         )
+        if S == 0:  # dummy neutral template row (never selected: tmpl_ok False)
+            tmpl_masks = EntityMasks(
+                mask=np.ones((pad_S, frozen.K, frozen.V), dtype=bool),
+                defines=np.zeros((pad_S, frozen.K), dtype=bool),
+                concrete=np.zeros((pad_S, frozen.K), dtype=bool),
+                negative=np.ones((pad_S, frozen.K), dtype=bool),
+                gt=np.full((pad_S, frozen.K), GT_NONE, dtype=np.int32),
+                lt=np.full((pad_S, frozen.K), LT_NONE, dtype=np.int32),
+            )
         exist_masks = (
             _neutralize(encode_requirements_batch(frozen, exist_label_reqs))
             if exist_label_reqs
@@ -335,7 +363,7 @@ class DeviceScheduler:
                 tm.mask, tm.defines, tm.concrete, tm.negative, tm.gt, tm.lt,
                 jnp.asarray(well_known),
             )
-        ) if C and S else np.zeros((C, S), dtype=bool)
+        ) if C and S else np.zeros((C, pad_S), dtype=bool)
 
         taint_ok = np.array(
             [
@@ -343,18 +371,18 @@ class DeviceScheduler:
                 for c in classes
             ],
             dtype=bool,
-        ) if C and S else np.zeros((C, S), dtype=bool)
+        ) if C and S else np.zeros((C, pad_S), dtype=bool)
         tmpl_ok = tmpl_compat & taint_ok
 
         # template-IT viability from the host prefilter (exact reference path)
         it_index = {id(it): i for i, it in enumerate(catalog)}
-        tmpl_it = np.zeros((S, pad_T), dtype=bool)
+        tmpl_it = np.zeros((pad_S, pad_T), dtype=bool)
         for si, t in enumerate(self.templates):
             for it in t.instance_type_options:
                 tmpl_it[si, it_index[id(it)]] = True
         tmpl_overhead = np.stack(
             [rvec(o) for o in self.daemon_overhead]
-        ) if S else np.zeros((0, R), dtype=np.float32)
+        ) if S else np.zeros((pad_S, R), dtype=np.float32)
 
         # fresh-node viability + kstar per class (first template wins)
         new_template = np.full((C,), -1, dtype=np.int32)
@@ -516,10 +544,6 @@ class DeviceScheduler:
         return list(seen.values())
 
     def _node_daemon_overhead(self, node: SimNode) -> dict:
-        from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
-            node_daemon_pods,
-        )
-
         return resutil.requests_for_pods(
             *node_daemon_pods(node, self.daemonset_pods)
         )
