@@ -1,0 +1,84 @@
+"""Round bench: device-solver scheduling throughput on the kwok catalog.
+
+Scenario = BASELINE.json config 1 scaled to this round: cpu/mem-request-only
+pending pods, single NodePool, kwok instance catalog (reference harness:
+scheduling_benchmark_test.go:75-95 grid, 100 pods/sec CI floor at :53).
+Prints ONE JSON line; vs_baseline is pods/sec over the reference's enforced
+100 pods/sec floor.
+
+Runs on whatever backend JAX selects (real TPU chip under the driver;
+force CPU with JAX_PLATFORM_NAME=cpu).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+N_PODS = int(__import__("os").environ.get("BENCH_PODS", "5000"))
+N_TYPES = int(__import__("os").environ.get("BENCH_TYPES", "400"))
+GIB = 2.0**30
+
+
+def build():
+    from karpenter_core_tpu.api.objects import ObjectMeta, Pod
+    from karpenter_core_tpu.api.nodepool import NodePool, NodePoolSpec
+    from karpenter_core_tpu.cloudprovider.kwok import bench_catalog
+    from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+    catalog = bench_catalog(N_TYPES)
+    pool = NodePool(metadata=ObjectMeta(name="default"))
+    pool.spec = NodePoolSpec()
+    # diverse cpu/mem shapes -> many pod equivalence classes (the FFD scan
+    # length); mirrors the benchmark's diverse pod mix minus topology
+    pods = [
+        Pod(
+            metadata=ObjectMeta(name=f"p{i}"),
+            resource_requests={
+                "cpu": 0.1 * (1 + i % 16),
+                "memory": 0.25 * GIB * (1 + i % 12),
+            },
+        )
+        for i in range(N_PODS)
+    ]
+    sched = DeviceScheduler([pool], {"default": catalog}, max_slots=1024)
+    return sched, pods
+
+
+def main():
+    sched, pods = build()
+
+    t0 = time.perf_counter()
+    res = sched.solve(pods)  # cold: includes jit compile
+    cold = time.perf_counter() - t0
+    assert res.all_pods_scheduled(), list(res.pod_errors.items())[:3]
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        res = sched.solve(pods)
+        times.append(time.perf_counter() - t0)
+    p50 = sorted(times)[len(times) // 2]
+    pods_per_sec = N_PODS / p50
+
+    print(
+        json.dumps(
+            {
+                "metric": f"solve_throughput_{N_PODS}pods_{N_TYPES}types",
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/sec",
+                "vs_baseline": round(pods_per_sec / 100.0, 2),
+                "detail": {
+                    "p50_solve_s": round(p50, 3),
+                    "cold_solve_s": round(cold, 3),
+                    "nodes": res.node_count(),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -17,7 +17,10 @@ from karpenter_core_tpu.controllers.provisioning.scheduling.nodeclaimtemplate im
     NodeClaimTemplate,
     filter_instance_types,
 )
-from karpenter_core_tpu.controllers.provisioning.scheduling.topology import Topology
+from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+    Topology,
+    TopologyError,
+)
 from karpenter_core_tpu.scheduling import Requirement, Requirements, Taints
 from karpenter_core_tpu.scheduling.requirements import (
     ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
@@ -80,9 +83,12 @@ class InFlightNodeClaim:
             if has_preferred_node_affinity(pod)
             else pod_requirements
         )
-        topology_requirements = self.topology.add_requirements(
-            strict, claim_requirements, pod, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
-        )
+        try:
+            topology_requirements = self.topology.add_requirements(
+                strict, claim_requirements, pod, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+            )
+        except TopologyError as e:
+            raise IncompatibleError(str(e))
         errs = claim_requirements.compatible(
             topology_requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
         )
@@ -183,9 +189,12 @@ class ExistingNodeSim:
             if has_preferred_node_affinity(pod)
             else pod_requirements
         )
-        topology_requirements = self.topology.add_requirements(
-            strict, node_requirements, pod
-        )
+        try:
+            topology_requirements = self.topology.add_requirements(
+                strict, node_requirements, pod
+            )
+        except TopologyError as e:
+            raise IncompatibleError(str(e))
         errs = node_requirements.compatible(topology_requirements)
         if errs:
             raise IncompatibleError(f"incompatible topology, {errs}")

@@ -27,8 +27,14 @@ from karpenter_core_tpu.controllers.provisioning.scheduling.nodeclaimtemplate im
 from karpenter_core_tpu.controllers.provisioning.scheduling.preferences import (
     Preferences,
 )
-from karpenter_core_tpu.controllers.provisioning.scheduling.queue import Queue
-from karpenter_core_tpu.controllers.provisioning.scheduling.topology import Topology
+from karpenter_core_tpu.controllers.provisioning.scheduling.queue import (
+    Queue,
+    by_cpu_and_memory_descending,
+)
+from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+    Topology,
+    domain_universe,
+)
 from karpenter_core_tpu.scheduling import Requirements, Taints
 from karpenter_core_tpu.scheduling.requirements import (
     ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
@@ -74,7 +80,12 @@ class Scheduler:
         daemonset_pods: Optional[List[Pod]] = None,
         topology: Optional[Topology] = None,
     ):
-        self.topology = topology or Topology()
+        # default topology over the discoverable domain universe
+        # (provisioner.go:251-283); the provisioning controller passes a
+        # Topology seeded with live cluster pods instead
+        self.topology = topology or Topology(
+            domains=domain_universe(nodepools, instance_types, existing_nodes or [])
+        )
         daemonset_pods = daemonset_pods or []
 
         tolerate_prefer_no_schedule = any(
@@ -136,6 +147,7 @@ class Scheduler:
         errors: Dict[str, str] = {}
         for p in pods:
             self.cached_pod_requests[p.uid] = resutil.requests_for_pods(p)
+            self.topology.update(p)  # NewTopology registers every solve pod
         q = Queue(pods, self.cached_pod_requests)
         pods_by_uid = {p.uid: p for p in pods}
 
@@ -162,59 +174,80 @@ class Scheduler:
         )
 
     def _add(self, pod: Pod) -> Optional[str]:
-        """(scheduler.go:268-316)"""
-        pod_requests = self.cached_pod_requests[pod.uid]
-        # 1. existing real nodes
-        for node in self.existing_nodes:
-            try:
-                node.add(pod, pod_requests)
-                return None
-            except IncompatibleError:
-                continue
+        return place_pod(
+            pod,
+            self.cached_pod_requests[pod.uid],
+            self.existing_nodes,
+            self.new_node_claims,
+            self.templates,
+            self.daemon_overhead,
+            self.topology,
+            self.remaining_resources,
+        )
 
-        # 2. in-flight claims, emptiest first (scheduler.go:277)
-        self.new_node_claims.sort(key=lambda c: len(c.pods))
-        for claim in self.new_node_claims:
-            try:
-                claim.add(pod, pod_requests)
-                return None
-            except IncompatibleError:
-                continue
 
-        # 3. open a new claim from the first workable template
-        errs = []
-        for template in self.templates:
-            instance_types = template.instance_type_options
-            remaining = self.remaining_resources.get(template.nodepool_name)
-            if remaining is not None:
-                instance_types = _filter_by_remaining_resources(
-                    instance_types, remaining
-                )
-                if not instance_types:
-                    errs.append(
-                        f"all available instance types exceed limits for "
-                        f"nodepool {template.nodepool_name!r}"
-                    )
-                    continue
-            claim = InFlightNodeClaim(
-                template,
-                self.topology,
-                self.daemon_overhead.get(id(template), {}),
-                instance_types,
-            )
-            try:
-                claim.add(pod, pod_requests)
-            except IncompatibleError as e:
-                claim.destroy()
-                errs.append(f"incompatible with nodepool {template.nodepool_name!r}: {e}")
-                continue
-            self.new_node_claims.append(claim)
-            if remaining is not None:
-                self.remaining_resources[template.nodepool_name] = _subtract_max(
-                    remaining, claim.instance_type_options
-                )
+def place_pod(
+    pod: Pod,
+    pod_requests: dict,
+    existing_nodes: List[ExistingNodeSim],
+    claims: List[InFlightNodeClaim],
+    templates: List[NodeClaimTemplate],
+    daemon_overhead: Dict[int, dict],  # id(template) -> resources
+    topology: Topology,
+    remaining_resources: Dict[str, dict],  # nodepool -> remaining; mutated
+) -> Optional[str]:
+    """The single-pod placement policy (scheduler.go:268-316): existing real
+    nodes, then in-flight claims emptiest first, then a fresh claim from the
+    first workable template. Shared by the greedy loop and the device
+    solver's host fallback so the order/limit policy cannot diverge."""
+    for node in existing_nodes:
+        try:
+            node.add(pod, pod_requests)
             return None
-        return "; ".join(errs) or "no nodepool matched pod"
+        except IncompatibleError:
+            continue
+
+    claims.sort(key=lambda c: len(c.pods))
+    for claim in claims:
+        try:
+            claim.add(pod, pod_requests)
+            return None
+        except IncompatibleError:
+            continue
+
+    errs = []
+    for template in templates:
+        instance_types = template.instance_type_options
+        remaining = remaining_resources.get(template.nodepool_name)
+        if remaining is not None:
+            instance_types = _filter_by_remaining_resources(
+                instance_types, remaining
+            )
+            if not instance_types:
+                errs.append(
+                    f"all available instance types exceed limits for "
+                    f"nodepool {template.nodepool_name!r}"
+                )
+                continue
+        claim = InFlightNodeClaim(
+            template,
+            topology,
+            daemon_overhead.get(id(template), {}),
+            instance_types,
+        )
+        try:
+            claim.add(pod, pod_requests)
+        except IncompatibleError as e:
+            claim.destroy()
+            errs.append(f"incompatible with nodepool {template.nodepool_name!r}: {e}")
+            continue
+        claims.append(claim)
+        if remaining is not None:
+            remaining_resources[template.nodepool_name] = _subtract_max(
+                remaining, claim.instance_type_options
+            )
+        return None
+    return "; ".join(errs) or "no nodepool matched pod"
 
 
 def node_daemon_pods(node: SimNode, daemonset_pods: List[Pod]) -> List[Pod]:

@@ -47,12 +47,20 @@ from karpenter_core_tpu.controllers.provisioning.scheduling.nodeclaimtemplate im
 from karpenter_core_tpu.controllers.provisioning.scheduling.preferences import (
     Preferences,
 )
+from karpenter_core_tpu.controllers.provisioning.scheduling.queue import (
+    by_cpu_and_memory_descending,
+)
 from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
     Results,
     _daemon_compatible,
     node_daemon_pods,
+    place_pod,
 )
-from karpenter_core_tpu.controllers.provisioning.scheduling.topology import Topology
+from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+    Topology,
+    domain_universe,
+    has_topology_constraints,
+)
 from karpenter_core_tpu.ops import masks as mops
 from karpenter_core_tpu.ops.ffd import (
     BIG,
@@ -111,6 +119,7 @@ class _Prepared:
     exist_taint_ok: np.ndarray  # [C, N]
     existing_sims: List[ExistingNodeSim]
     n_slots: int
+    topo: Topology
 
 
 class DeviceScheduler:
@@ -135,7 +144,9 @@ class DeviceScheduler:
         self.daemonset_pods = list(daemonset_pods or [])
         self.max_slots = max_slots
         self.validate = validate
-        self.topology = Topology()
+        self.domains_universe = domain_universe(
+            nodepools, instance_types, self.existing_nodes
+        )
 
         tolerate_pns = any(
             t.effect == "PreferNoSchedule"
@@ -217,15 +228,28 @@ class DeviceScheduler:
     def _solve_once(
         self, pods: List[Pod], max_slots: int
     ) -> Optional[Tuple[List[InFlightNodeClaim], List[ExistingNodeSim], list]]:
-        try:
-            prep = self._prepare(pods, max_slots)
-        except _SlotOverflow:
-            return None
-        if prep is None:
+        if not self.templates and not self.existing_nodes:
             # no viable templates and no existing capacity: everything fails
             return [], [], [(p, "no nodepool matched pod") for p in pods]
 
-        C = len(prep.classes)
+        # one Topology per solve round; every pod's groups are (re)built so
+        # relaxed specs take effect (topology.go NewTopology:60-86)
+        topo = Topology(
+            domains={k: set(v) for k, v in self.domains_universe.items()}
+        )
+        for p in pods:
+            topo.update(p)
+
+        # topology-coupled pods take the host algebra (full spread/affinity
+        # semantics); the device FFD batches the topology-free mass
+        simple = [p for p in pods if not has_topology_constraints(p)]
+        constrained = [p for p in pods if has_topology_constraints(p)]
+
+        try:
+            prep = self._prepare(simple, max_slots, topo)
+        except _SlotOverflow:
+            return None
+
         state, takes, unplaced = ffd_solve(
             prep.init_state,
             self._class_steps(prep),
@@ -233,18 +257,29 @@ class DeviceScheduler:
         )
         if bool(state.overflow):
             return None
-        return self._decode(
+        claims, existing_sims, failed = self._decode(
             prep,
             np.asarray(takes),
             np.asarray(unplaced),
             np.asarray(state.template),
         )
 
+        constrained_requests = {
+            p.uid: resutil.requests_for_pods(p) for p in constrained
+        }
+        for p in by_cpu_and_memory_descending(constrained, constrained_requests):
+            err = self._host_fallback_add(
+                p, claims, existing_sims, topo, constrained_requests[p.uid]
+            )
+            if err is not None:
+                failed.append((p, err))
+        return claims, existing_sims, failed
+
     # ------------------------------------------------------------------
 
-    def _prepare(self, pods: List[Pod], max_slots: int) -> Optional[_Prepared]:
-        if not self.templates and not self.existing_nodes:
-            return None
+    def _prepare(
+        self, pods: List[Pod], max_slots: int, topo: Topology
+    ) -> _Prepared:
         classes = group_pods(pods)
         # class order = pod queue order lifted to classes (queue.go:76-112)
         classes.sort(
@@ -254,9 +289,9 @@ class DeviceScheduler:
                 min(p.metadata.creation_timestamp for p in c.pods),
             )
         )
-        return self._prepare_with_vocab(classes, max_slots)
+        return self._prepare_with_vocab(classes, max_slots, topo)
 
-    def _prepare_with_vocab(self, classes, max_slots) -> Optional[_Prepared]:
+    def _prepare_with_vocab(self, classes, max_slots, topo: Topology) -> _Prepared:
         from karpenter_core_tpu.solver.vocab import Vocab, encode_requirements_batch
 
         catalog = self._catalog_union()
@@ -432,7 +467,6 @@ class DeviceScheduler:
         template_arr = np.full((N,), -1, dtype=np.int32)
 
         existing_sims = []
-        topo = Topology()
         for ei, node in enumerate(self.existing_nodes):
             sim = ExistingNodeSim(node, topo, self._node_daemon_overhead(node))
             existing_sims.append(sim)
@@ -511,6 +545,7 @@ class DeviceScheduler:
             exist_taint_ok=exist_taint_ok,
             existing_sims=existing_sims,
             n_slots=N,
+            topo=topo,
         )
 
     def _class_steps(self, prep: _Prepared) -> ClassStep:
@@ -561,12 +596,15 @@ class DeviceScheduler:
 
         Each slot's class groups are merged with the exact reference-semantics
         machinery (Requirements.add + filter_instance_types), so the returned
-        claims are indistinguishable from greedy-path output. Any group the
-        host algebra rejects (device/host divergence) falls into the failed
-        list and re-enters via relaxation or greedy fallback."""
+        claims are indistinguishable from greedy-path output. Any pod the
+        host algebra rejects (device/host divergence — e.g. float32 capacity
+        arithmetic saying an exact fit holds where float64 disagrees) is
+        re-placed through the host greedy add; only pods the host path also
+        rejects surface as failures (and re-enter via relaxation)."""
         C, N = takes.shape
         E = len(prep.existing_sims)
         failed: list = []
+        divergent: List[Pod] = []
 
         # distribute per-class pod lists
         assigned: Dict[int, List[Tuple[int, int]]] = {}  # slot -> [(class, k)]
@@ -580,7 +618,7 @@ class DeviceScheduler:
                     failed.append((p, "no nodepool matched pod"))
 
         claims: List[InFlightNodeClaim] = []
-        topo = Topology()
+        topo = prep.topo
         pod_cursor = {ci: 0 for ci in range(C)}
 
         for n in sorted(assigned):
@@ -608,8 +646,42 @@ class DeviceScheduler:
                 for p in pods:
                     try:
                         add(p, req)
-                    except IncompatibleError as e:
-                        failed.append((p, f"device/host divergence: {e}"))
-        # drop empty claims (all groups failed)
-        claims = [c for c in claims if c.pods]
-        return claims, prep.existing_sims, failed
+                    except IncompatibleError:
+                        divergent.append(p)
+        for p in divergent:
+            err = self._host_fallback_add(p, claims, prep.existing_sims, topo)
+            if err is not None:
+                failed.append((p, err))
+        # drop empty claims (all groups failed), releasing their placeholder
+        # hostnames from the shared per-round topology
+        kept = []
+        for c in claims:
+            if c.pods:
+                kept.append(c)
+            else:
+                c.destroy()
+        return kept, prep.existing_sims, failed
+
+    def _host_fallback_add(
+        self,
+        pod: Pod,
+        claims: List[InFlightNodeClaim],
+        existing_sims: List[ExistingNodeSim],
+        topo: Topology,
+        pod_requests: Optional[dict] = None,
+    ) -> Optional[str]:
+        """Host placement via the shared greedy policy (place_pod). Round-1
+        device path does not track NodePool limits, so remaining_resources is
+        empty here — the greedy path is authoritative when limits are tight."""
+        if pod_requests is None:
+            pod_requests = resutil.requests_for_pods(pod)
+        return place_pod(
+            pod,
+            pod_requests,
+            existing_sims,
+            claims,
+            self.templates,
+            {id(t): o for t, o in zip(self.templates, self.daemon_overhead)},
+            topo,
+            {},
+        )

@@ -10,11 +10,14 @@ from karpenter_core_tpu.api import labels as L
 from karpenter_core_tpu.api.nodepool import NodePool, NodePoolSpec
 from karpenter_core_tpu.api.objects import (
     Affinity,
+    LabelSelector,
     NodeAffinity,
     NodeSelectorRequirement,
     NodeSelectorTerm,
     ObjectMeta,
     Pod,
+    PodAffinity,
+    PodAffinityTerm,
     Toleration,
     TopologySpreadConstraint,
     resource_list,
@@ -23,50 +26,92 @@ from karpenter_core_tpu.api.objects import (
 GIB = 2.0**30
 
 
+def selector_for(labels: dict) -> LabelSelector:
+    return LabelSelector(match_labels=tuple(sorted(labels.items())))
+
+
 def make_pod(
     cpu: float = 0.5,
     memory_gib: float = 1.0,
     name: Optional[str] = None,
+    labels: Optional[dict] = None,
     node_selector: Optional[dict] = None,
     zone_in: Optional[List[str]] = None,
     tolerations: Optional[list] = None,
     spread_zone: bool = False,
     spread_hostname: bool = False,
+    max_skew: int = 1,
+    affinity_to: Optional[dict] = None,
+    anti_affinity_to: Optional[dict] = None,
+    affinity_key: str = L.LABEL_TOPOLOGY_ZONE,
 ) -> Pod:
-    affinity = None
+    """Spread constraints self-select on the pod's labels (defaulted to
+    app=<spread kind> like the reference's test deployments); affinity_to /
+    anti_affinity_to give required pod-(anti-)affinity over affinity_key."""
+    node_affinity = None
     if zone_in:
-        affinity = Affinity(
-            node_affinity=NodeAffinity(
-                required=[
-                    NodeSelectorTerm(
-                        match_expressions=(
-                            NodeSelectorRequirement(
-                                L.LABEL_TOPOLOGY_ZONE, "In", tuple(zone_in)
-                            ),
-                        )
+        node_affinity = NodeAffinity(
+            required=[
+                NodeSelectorTerm(
+                    match_expressions=(
+                        NodeSelectorRequirement(
+                            L.LABEL_TOPOLOGY_ZONE, "In", tuple(zone_in)
+                        ),
                     )
-                ]
-            )
+                )
+            ]
         )
+    labels = dict(labels or {})
     constraints = []
+    if spread_zone or spread_hostname:
+        labels.setdefault("app", "spread")
     if spread_zone:
         constraints.append(
             TopologySpreadConstraint(
-                max_skew=1,
+                max_skew=max_skew,
                 topology_key=L.LABEL_TOPOLOGY_ZONE,
                 when_unsatisfiable="DoNotSchedule",
+                label_selector=selector_for({"app": labels["app"]}),
             )
         )
     if spread_hostname:
         constraints.append(
             TopologySpreadConstraint(
-                max_skew=1,
+                max_skew=max_skew,
                 topology_key=L.LABEL_HOSTNAME,
                 when_unsatisfiable="DoNotSchedule",
+                label_selector=selector_for({"app": labels["app"]}),
             )
         )
+    pod_affinity = None
+    pod_anti_affinity = None
+    if affinity_to is not None:
+        pod_affinity = PodAffinity(
+            required=[
+                PodAffinityTerm(
+                    topology_key=affinity_key,
+                    label_selector=selector_for(affinity_to),
+                )
+            ]
+        )
+    if anti_affinity_to is not None:
+        pod_anti_affinity = PodAffinity(
+            required=[
+                PodAffinityTerm(
+                    topology_key=affinity_key,
+                    label_selector=selector_for(anti_affinity_to),
+                )
+            ]
+        )
+    affinity = None
+    if node_affinity or pod_affinity or pod_anti_affinity:
+        affinity = Affinity(
+            node_affinity=node_affinity,
+            pod_affinity=pod_affinity,
+            pod_anti_affinity=pod_anti_affinity,
+        )
     return Pod(
-        metadata=ObjectMeta(name=name or f"pod-{ObjectMeta().uid}"),
+        metadata=ObjectMeta(name=name or f"pod-{ObjectMeta().uid}", labels=labels),
         resource_requests={"cpu": cpu, "memory": memory_gib * GIB},
         node_selector=dict(node_selector or {}),
         affinity=affinity,
@@ -103,12 +148,16 @@ def make_diverse_pods(n: int, seed: int = 0, with_topology: bool = False) -> Lis
         elif kind == 4:
             pods.append(make_pod(cpu, mem, name=f"spread-h-{i}", spread_hostname=True))
         else:
+            # self anti-affinity on hostname: one pod per node (the
+            # reference benchmark's anti-affinity slice)
             pods.append(
                 make_pod(
                     cpu,
                     mem,
-                    name=f"zonal2-{i}",
-                    zone_in=["zone-c"],
+                    name=f"anti-{i}",
+                    labels={"app": "anti"},
+                    anti_affinity_to={"app": "anti"},
+                    affinity_key=L.LABEL_HOSTNAME,
                 )
             )
     return pods

@@ -1,0 +1,289 @@
+"""Topology spread / pod-affinity / anti-affinity semantics, mirroring the
+reference's topology suite scenarios
+(reference: pkg/controllers/provisioning/scheduling/topology_test.go)."""
+import pytest
+
+from tests.helpers import GIB, make_diverse_pods, make_nodepool, make_pod
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.api.objects import (
+    NodeSelectorRequirement,
+    ObjectMeta,
+    Pod,
+)
+from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import SimNode
+from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import Scheduler
+from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+    Topology,
+    domain_universe,
+)
+from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+CATALOG = build_catalog(cpu_grid=[1, 2, 4, 8, 16], mem_factors=[2, 4])
+
+THREE_ZONES = NodeSelectorRequirement(
+    L.LABEL_TOPOLOGY_ZONE, "In", ("zone-a", "zone-b", "zone-c")
+)
+
+
+def three_zone_pool():
+    return make_nodepool(requirements=[THREE_ZONES])
+
+
+def claim_zone(claim) -> str:
+    req = claim.requirements.get(L.LABEL_TOPOLOGY_ZONE)
+    vals = req.sorted_values()
+    assert len(vals) == 1, f"zone not collapsed: {req!r}"
+    return vals[0]
+
+
+def zone_counts(res) -> dict:
+    counts = {}
+    for claim in res.new_node_claims:
+        counts[claim_zone(claim)] = counts.get(claim_zone(claim), 0) + len(claim.pods)
+    for sim in res.existing_nodes:
+        if sim.pods:
+            z = sim.node.labels.get(L.LABEL_TOPOLOGY_ZONE)
+            counts[z] = counts.get(z, 0) + len(sim.pods)
+    return counts
+
+
+class TestZoneSpread:
+    def test_even_spread_across_zones(self):
+        s = Scheduler([three_zone_pool()], {"default": CATALOG})
+        res = s.solve([make_pod(cpu=1.0, spread_zone=True) for _ in range(9)])
+        assert res.all_pods_scheduled(), res.pod_errors
+        assert zone_counts(res) == {"zone-a": 3, "zone-b": 3, "zone-c": 3}
+
+    def test_max_skew_two(self):
+        s = Scheduler([three_zone_pool()], {"default": CATALOG})
+        res = s.solve(
+            [make_pod(cpu=1.0, spread_zone=True, max_skew=2) for _ in range(4)]
+        )
+        assert res.all_pods_scheduled(), res.pod_errors
+        counts = zone_counts(res)
+        assert max(counts.values()) - min(counts.values() or [0]) <= 2
+
+    def test_spread_counts_only_selected_pods(self):
+        # unselected pods (different app label) don't count toward skew
+        s = Scheduler([three_zone_pool()], {"default": CATALOG})
+        spread = [
+            make_pod(cpu=1.0, labels={"app": "web"}, spread_zone=True)
+            for _ in range(3)
+        ]
+        others = [make_pod(cpu=1.0) for _ in range(6)]
+        res = s.solve(spread + others)
+        assert res.all_pods_scheduled(), res.pod_errors
+
+    def test_zone_spread_respects_node_affinity_filter(self):
+        # pod restricted to zone-a+b spreads over those two only
+        s = Scheduler([three_zone_pool()], {"default": CATALOG})
+        res = s.solve(
+            [
+                make_pod(
+                    cpu=1.0, spread_zone=True, zone_in=["zone-a", "zone-b"]
+                )
+                for _ in range(4)
+            ]
+        )
+        assert res.all_pods_scheduled(), res.pod_errors
+        counts = zone_counts(res)
+        assert set(counts) == {"zone-a", "zone-b"}
+        assert counts["zone-a"] == counts["zone-b"] == 2
+
+
+class TestHostnameSpread:
+    def test_one_pod_per_node(self):
+        s = Scheduler([make_nodepool()], {"default": CATALOG})
+        res = s.solve([make_pod(cpu=1.0, spread_hostname=True) for _ in range(5)])
+        assert res.all_pods_scheduled(), res.pod_errors
+        assert res.node_count() == 5
+        assert all(len(c.pods) == 1 for c in res.new_node_claims)
+
+
+class TestPodAffinity:
+    def test_self_affinity_single_zone(self):
+        s = Scheduler([three_zone_pool()], {"default": CATALOG})
+        res = s.solve(
+            [
+                make_pod(
+                    cpu=1.0, labels={"app": "db"}, affinity_to={"app": "db"}
+                )
+                for _ in range(4)
+            ]
+        )
+        assert res.all_pods_scheduled(), res.pod_errors
+        assert len(zone_counts(res)) == 1  # all co-located
+
+    def test_affinity_follows_committed_target(self):
+        # web pods co-locate with the db pod, whose zone IS determined
+        # (zone_in pins it); db schedules first (bigger cpu sorts first)
+        s = Scheduler([three_zone_pool()], {"default": CATALOG})
+        db = make_pod(cpu=4.0, labels={"app": "db"}, zone_in=["zone-b"])
+        webs = [
+            make_pod(cpu=1.0, labels={"app": "web"}, affinity_to={"app": "db"})
+            for _ in range(3)
+        ]
+        res = s.solve([db] + webs)
+        assert res.all_pods_scheduled(), res.pod_errors
+        assert set(zone_counts(res)) == {"zone-b"}
+
+    def test_affinity_to_uncommitted_target_fails(self):
+        # late committal: the target's zone is undetermined within the batch,
+        # so affinity pods cannot schedule (topology_test.go "unconstrained
+        # target")
+        s = Scheduler([three_zone_pool()], {"default": CATALOG})
+        db = make_pod(cpu=4.0, labels={"app": "db"})
+        webs = [
+            make_pod(cpu=1.0, labels={"app": "web"}, affinity_to={"app": "db"})
+            for _ in range(2)
+        ]
+        res = s.solve([db] + webs)
+        assert len(res.pod_errors) == 2
+
+    def test_affinity_to_absent_target_fails(self):
+        s = Scheduler([three_zone_pool()], {"default": CATALOG})
+        res = s.solve(
+            [
+                make_pod(cpu=1.0, affinity_to={"app": "nonexistent"})
+                for _ in range(3)
+            ]
+        )
+        assert len(res.pod_errors) == 3
+
+
+class TestPodAntiAffinity:
+    def test_hostname_anti_affinity_separates_nodes(self):
+        # hostname domains are single-valued per claim, so self anti-affinity
+        # on hostname fully resolves in one batch (topology_test.go:1764)
+        s = Scheduler([make_nodepool()], {"default": CATALOG})
+        res = s.solve(
+            [
+                make_pod(
+                    cpu=1.0,
+                    labels={"app": "aa"},
+                    anti_affinity_to={"app": "aa"},
+                    affinity_key=L.LABEL_HOSTNAME,
+                )
+                for _ in range(4)
+            ]
+        )
+        assert res.all_pods_scheduled(), res.pod_errors
+        assert res.node_count() == 4
+        assert all(len(c.pods) == 1 for c in res.new_node_claims)
+
+    def test_zone_anti_affinity_late_committal(self):
+        # a zone-anti pod's claim could land in any zone, so it blocks all of
+        # them for this batch: only one of three schedules
+        # (topology_test.go:2132 "pod anti-affinity with a zone topology")
+        s = Scheduler([three_zone_pool()], {"default": CATALOG})
+        res = s.solve(
+            [
+                make_pod(
+                    cpu=1.0, labels={"app": "aa"}, anti_affinity_to={"app": "aa"}
+                )
+                for _ in range(3)
+            ]
+        )
+        assert len(res.pod_errors) == 2
+        assert "anti-affinity" in next(iter(res.pod_errors.values()))
+
+    def test_zone_anti_affinity_committed_zones_resolve(self):
+        # when each anti pod pins its own zone, all three schedule distinctly
+        s = Scheduler([three_zone_pool()], {"default": CATALOG})
+        res = s.solve(
+            [
+                make_pod(
+                    cpu=1.0,
+                    labels={"app": "aa"},
+                    anti_affinity_to={"app": "aa"},
+                    zone_in=[z],
+                )
+                for z in ["zone-a", "zone-b", "zone-c"]
+            ]
+        )
+        assert res.all_pods_scheduled(), res.pod_errors
+        assert set(zone_counts(res)) == {"zone-a", "zone-b", "zone-c"}
+
+    def test_inverse_anti_affinity_blocks_new_pod(self):
+        # an EXISTING pod with anti-affinity to app=web parks in zone-a; a new
+        # app=web pod must land elsewhere even though it has no constraints
+        # (topology.go:224-269 inverse topologies)
+        pool = three_zone_pool()
+        existing_node = SimNode(
+            name="existing-a",
+            labels={
+                L.NODEPOOL_LABEL_KEY: "default",
+                L.LABEL_TOPOLOGY_ZONE: "zone-a",
+            },
+            taints=[],
+            available={"cpu": 16.0, "memory": 32 * GIB, "pods": 100.0},
+        )
+        guard = make_pod(
+            cpu=1.0, labels={"app": "guard"}, anti_affinity_to={"app": "web"}
+        )
+        guard.node_name = "existing-a"
+        guard.phase = "Running"
+        topo = Topology(
+            domains=domain_universe([pool], {"default": CATALOG}, [existing_node]),
+            existing_pods=[(guard, dict(existing_node.labels), "existing-a")],
+        )
+        s = Scheduler(
+            [pool], {"default": CATALOG},
+            existing_nodes=[existing_node], topology=topo,
+        )
+        res = s.solve([make_pod(cpu=1.0, labels={"app": "web"})])
+        assert res.all_pods_scheduled(), res.pod_errors
+        # placed on a new claim whose admissible zones exclude zone-a
+        assert not res.existing_nodes[0].pods
+        (claim,) = res.new_node_claims
+        assert not claim.requirements.get(L.LABEL_TOPOLOGY_ZONE).has("zone-a")
+
+
+class TestRelaxation:
+    def test_schedule_anyway_spread_relaxes(self):
+        # 1-zone pool, ScheduleAnyway zone spread with impossible skew across
+        # registered domains relaxes away (preferences.go:38-57)
+        pool = make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(L.LABEL_TOPOLOGY_ZONE, "In", ("zone-a",))
+            ]
+        )
+        s = Scheduler([pool], {"default": CATALOG})
+        pods = []
+        for _ in range(3):
+            p = make_pod(cpu=1.0, spread_zone=True)
+            pods.append(p)
+        # make the constraint soft
+        for p in pods:
+            p.topology_spread_constraints = [
+                type(p.topology_spread_constraints[0])(
+                    max_skew=1,
+                    topology_key=L.LABEL_TOPOLOGY_ZONE,
+                    when_unsatisfiable="ScheduleAnyway",
+                    label_selector=p.topology_spread_constraints[0].label_selector,
+                )
+            ]
+        res = s.solve(pods)
+        assert res.all_pods_scheduled(), res.pod_errors
+
+
+class TestDeviceParityTopology:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_diverse_topology_mix(self, seed):
+        import copy
+
+        pods = make_diverse_pods(60, seed=seed, with_topology=True)
+        g = Scheduler([three_zone_pool()], {"default": CATALOG})
+        rg = g.solve(copy.deepcopy(pods))
+        d = DeviceScheduler([three_zone_pool()], {"default": CATALOG}, max_slots=64)
+        rd = d.solve(copy.deepcopy(pods))
+        assert set(rg.pod_errors) == set(rd.pod_errors), (
+            rg.pod_errors,
+            rd.pod_errors,
+        )
+        if rg.node_count():
+            assert abs(rd.node_count() - rg.node_count()) <= max(
+                2, 0.15 * rg.node_count()
+            )
