@@ -12,13 +12,11 @@ force CPU with JAX_PLATFORM_NAME=cpu).
 from __future__ import annotations
 
 import json
-import sys
+import os
 import time
 
-sys.path.insert(0, "/root/repo")
-
-N_PODS = int(__import__("os").environ.get("BENCH_PODS", "5000"))
-N_TYPES = int(__import__("os").environ.get("BENCH_TYPES", "400"))
+N_PODS = int(os.environ.get("BENCH_PODS", "5000"))
+N_TYPES = int(os.environ.get("BENCH_TYPES", "400"))
 GIB = 2.0**30
 
 

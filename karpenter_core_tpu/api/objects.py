@@ -288,6 +288,20 @@ class Pod:
 
 
 # ---------------------------------------------------------------------------
+# DaemonSet (enough surface for daemon-overhead accounting,
+# reference: pkg/controllers/provisioning/provisioner.go:409-434)
+
+@dataclass
+class DaemonSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    pod_template: Optional["Pod"] = None
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+# ---------------------------------------------------------------------------
 # Node
 
 @dataclass

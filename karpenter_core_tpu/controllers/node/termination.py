@@ -1,0 +1,73 @@
+"""Node graceful teardown: taint → drain → instance terminated → finalizer
+removed (reference: pkg/controllers/node/termination/controller.go:67-176,
+terminator/terminator.go:55-165).
+"""
+from __future__ import annotations
+
+from karpenter_core_tpu.api import labels as apilabels
+from karpenter_core_tpu.api.objects import Node
+from karpenter_core_tpu.cloudprovider.types import NodeClaimNotFoundError
+from karpenter_core_tpu.kube.store import NotFoundError
+from karpenter_core_tpu.scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
+from karpenter_core_tpu.utils import pod as podutil
+
+
+class NodeTermination:
+    def __init__(self, kube, cluster, cloud_provider, clock):
+        self.kube = kube
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.clock = clock
+
+    def reconcile(self, node: Node) -> None:
+        if node.metadata.deletion_timestamp is None:
+            return
+        if apilabels.TERMINATION_FINALIZER not in node.metadata.finalizers:
+            return
+
+        # delete owning NodeClaims first (controller.go:178-188)
+        claims = [
+            c
+            for c in self.kube.list_nodeclaims()
+            if c.status.provider_id == node.provider_id
+        ]
+        for c in claims:
+            if c.metadata.deletion_timestamp is None:
+                self.kube.delete(c)
+
+        # taint so nothing schedules during the drain (terminator.go:55)
+        if not any(
+            t.key == DISRUPTED_NO_SCHEDULE_TAINT.key for t in node.taints
+        ):
+            node.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
+            self.kube.update(node)
+
+        # drain: non-daemon, evictable pods first; priority grouping is moot
+        # with a synchronous eviction stand-in (terminator.go:96-138)
+        remaining = [
+            p
+            for p in self.cluster.pods_on_node(node.name)
+            if podutil.is_evictable(p) and not p.is_daemonset
+        ]
+        for p in remaining:
+            self.kube.evict(p)
+        if any(
+            not p.is_daemonset
+            for p in self.cluster.pods_on_node(node.name)
+        ):
+            return  # wait for drain to finish
+
+        # ensure the instance is gone (claims' finalizers handle provider
+        # delete; cover unmanaged/orphan nodes too)
+        for c in claims:
+            try:
+                self.cloud_provider.delete(c)
+            except NodeClaimNotFoundError:
+                pass
+
+        if apilabels.TERMINATION_FINALIZER in node.metadata.finalizers:
+            node.metadata.finalizers.remove(apilabels.TERMINATION_FINALIZER)
+            try:
+                self.kube.update(node)
+            except NotFoundError:
+                pass  # provider delete already removed the node object

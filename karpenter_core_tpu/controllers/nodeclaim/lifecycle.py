@@ -1,0 +1,127 @@
+"""NodeClaim lifecycle: Launch → Registration → Initialization, plus
+liveness TTL and finalizer-driven teardown
+(reference: pkg/controllers/nodeclaim/lifecycle/{controller,launch,
+registration,initialization,liveness}.go).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_core_tpu.api import labels as apilabels
+from karpenter_core_tpu.api.nodeclaim import (
+    COND_INITIALIZED,
+    COND_INSTANCE_TERMINATING,
+    COND_LAUNCHED,
+    COND_REGISTERED,
+    NodeClaim,
+)
+from karpenter_core_tpu.api.objects import Node
+from karpenter_core_tpu.cloudprovider.types import (
+    CloudProviderError,
+    InsufficientCapacityError,
+    NodeClaimNotFoundError,
+)
+from karpenter_core_tpu.scheduling.taints import UNREGISTERED_NO_EXECUTE_TAINT
+
+REGISTRATION_TTL = 15 * 60.0  # liveness.go:41
+
+
+class NodeClaimLifecycle:
+    def __init__(self, kube, cluster, cloud_provider, clock):
+        self.kube = kube
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.clock = clock
+
+    def reconcile(self, claim: NodeClaim) -> None:
+        if claim.metadata.deletion_timestamp is not None:
+            self._finalize(claim)
+            return
+        if apilabels.TERMINATION_FINALIZER not in claim.metadata.finalizers:
+            claim.metadata.finalizers.append(apilabels.TERMINATION_FINALIZER)
+            self.kube.update(claim)
+        if not claim.is_launched():
+            self._launch(claim)
+        if claim.is_launched() and not claim.is_registered():
+            self._register(claim)
+        if claim.is_registered() and not claim.is_initialized():
+            self._initialize(claim)
+
+    # -- launch (launch.go:45) --------------------------------------------
+
+    def _launch(self, claim: NodeClaim) -> None:
+        try:
+            self.cloud_provider.create(claim)
+        except InsufficientCapacityError:
+            # terminal for this claim: delete so the provisioner retries
+            # with the offering marked unavailable (launch.go error path)
+            self.kube.delete(claim)
+            return
+        except CloudProviderError:
+            return  # retried next reconcile
+        self.kube.update(claim)
+
+    # -- registration (registration.go:43) --------------------------------
+
+    def _register(self, claim: NodeClaim) -> None:
+        node = self.kube.get_node_by_provider_id(claim.status.provider_id)
+        if node is None:
+            # liveness: claims whose machine never joined are reaped
+            if self.clock.since(claim.metadata.creation_timestamp) > REGISTRATION_TTL:
+                self.kube.delete(claim)
+            return
+        node.taints = [
+            t
+            for t in node.taints
+            if not (
+                t.key == UNREGISTERED_NO_EXECUTE_TAINT.key
+                and t.effect == UNREGISTERED_NO_EXECUTE_TAINT.effect
+            )
+        ]
+        for taint in list(claim.spec.taints) + list(claim.spec.startup_taints):
+            if not any(
+                t.key == taint.key and t.effect == taint.effect
+                for t in node.taints
+            ):
+                node.taints.append(taint)
+        node.metadata.labels.update(claim.metadata.labels)
+        node.metadata.labels[apilabels.NODE_REGISTERED_LABEL_KEY] = "true"
+        if apilabels.TERMINATION_FINALIZER not in node.metadata.finalizers:
+            node.metadata.finalizers.append(apilabels.TERMINATION_FINALIZER)
+        self.kube.update(node)
+        claim.status.node_name = node.name
+        claim.conditions.set_true(COND_REGISTERED, "Registered")
+        self.kube.update(claim)
+
+    # -- initialization (initialization.go:47) -----------------------------
+
+    def _initialize(self, claim: NodeClaim) -> None:
+        node = self.kube.get(Node, claim.status.node_name)
+        if node is None or not node.ready():
+            return
+        # startup taints must clear and registered resources must be present
+        startup = list(claim.spec.startup_taints)
+        if any(
+            any(t.key == s.key and t.effect == s.effect for s in startup)
+            for t in node.taints
+        ):
+            return
+        if not node.status.allocatable:
+            return
+        node.metadata.labels[apilabels.NODE_INITIALIZED_LABEL_KEY] = "true"
+        self.kube.update(node)
+        claim.conditions.set_true(COND_INITIALIZED, "Initialized")
+        self.kube.update(claim)
+
+    # -- teardown (lifecycle/controller.go:111-285) ------------------------
+
+    def _finalize(self, claim: NodeClaim) -> None:
+        if apilabels.TERMINATION_FINALIZER not in claim.metadata.finalizers:
+            return
+        try:
+            self.cloud_provider.delete(claim)
+        except NodeClaimNotFoundError:
+            pass  # instance already gone
+        claim.conditions.set_true(COND_INSTANCE_TERMINATING, "Terminating")
+        claim.metadata.finalizers.remove(apilabels.TERMINATION_FINALIZER)
+        self.kube.update(claim)

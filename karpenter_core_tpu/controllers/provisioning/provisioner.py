@@ -1,0 +1,161 @@
+"""The provisioning reconciler: pending pods → scheduler solve → NodeClaims
+(reference: pkg/controllers/provisioning/provisioner.go:74-516).
+
+`schedule()` assembles exactly the inputs the reference does — ready
+NodePools in weight order, per-pool instance types, the topology domain
+universe, live-cluster SimNodes, daemonset overhead — and runs the selected
+solver (`greedy` host FFD or the `tpu` device solver). `provision()` then
+materializes NodeClaims (limits-checked, instance types truncated to the 60
+cheapest) and returns the pod→target nomination map the binder consumes.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_core_tpu.api import labels as apilabels
+from karpenter_core_tpu.api.nodepool import NodePool
+from karpenter_core_tpu.api.objects import Pod
+from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+    Results,
+    Scheduler,
+)
+from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+    Topology,
+    domain_universe,
+)
+from karpenter_core_tpu.utils import pod as podutil
+from karpenter_core_tpu.utils import resources as resutil
+
+
+class Provisioner:
+    def __init__(
+        self,
+        kube,
+        cluster,
+        cloud_provider,
+        clock,
+        solver: str = "greedy",
+        device_scheduler_opts: Optional[dict] = None,
+    ):
+        self.kube = kube
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.clock = clock
+        self.solver = solver
+        self.device_scheduler_opts = device_scheduler_opts or {}
+
+    # -- input assembly ----------------------------------------------------
+
+    def pending_pods(self) -> List[Pod]:
+        return [p for p in self.kube.list_pods() if podutil.is_provisionable(p)]
+
+    def deleting_node_pods(self) -> List[Pod]:
+        """Reschedulable pods on deleting nodes re-enter the solve
+        (provisioner.go:159-177)."""
+        out = []
+        for sn in self.cluster.nodes():
+            if not (sn.deleting() or sn.marked_for_deletion):
+                continue
+            for p in self.cluster.pods_on_node(sn.name):
+                if podutil.is_reschedulable(p):
+                    out.append(p)
+        return out
+
+    def ready_nodepools(self) -> List[NodePool]:
+        pools = [
+            np
+            for np in self.kube.list_nodepools()
+            if np.metadata.deletion_timestamp is None
+        ]
+        pools.sort(key=lambda n: (-n.spec.weight, n.name))
+        return pools
+
+    def daemonset_pods(self) -> List[Pod]:
+        out = []
+        for ds in self.kube.list_daemonsets():
+            if ds.pod_template is not None:
+                p = ds.pod_template
+                p.is_daemonset = True
+                out.append(p)
+        return out
+
+    # -- the solve ---------------------------------------------------------
+
+    def new_scheduler(self, pods: List[Pod]):
+        nodepools = self.ready_nodepools()
+        instance_types = {
+            np.name: self.cloud_provider.get_instance_types(np)
+            for np in nodepools
+        }
+        sim_nodes = self.cluster.sim_nodes()
+        topology = Topology(
+            domains=domain_universe(nodepools, instance_types, sim_nodes),
+            existing_pods=self.cluster.existing_pod_triples(),
+            excluded_pod_uids={p.uid for p in pods},
+        )
+        common = dict(
+            nodepools=nodepools,
+            instance_types=instance_types,
+            existing_nodes=sim_nodes,
+            daemonset_pods=self.daemonset_pods(),
+        )
+        if self.solver == "tpu":
+            from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+            return DeviceScheduler(
+                topology=topology, **common, **self.device_scheduler_opts
+            )
+        return Scheduler(topology=topology, **common)
+
+    def schedule(self) -> Tuple[Results, List[Pod]]:
+        pods = self.pending_pods() + self.deleting_node_pods()
+        if not pods:
+            return Results([], [], {}), []
+        scheduler = self.new_scheduler(pods)
+        return scheduler.solve(pods), pods
+
+    # -- output: NodeClaims + nominations ----------------------------------
+
+    def provision(self) -> Dict[str, str]:
+        """One reconcile: solve and create NodeClaims. Returns nominations:
+        pod key → existing node name or new NodeClaim name."""
+        results, _ = self.schedule()
+        nominations: Dict[str, str] = {}
+
+        for sim in results.existing_nodes:
+            for p in sim.pods:
+                nominations[p.key()] = sim.name
+
+        usage_by_pool = self._usage_by_nodepool()
+        pools = {np.name: np for np in self.kube.list_nodepools()}
+        for claim in results.new_node_claims:
+            pool = pools.get(claim.template.nodepool_name)
+            if pool is not None and pool.spec.limits:
+                # pessimistic max-capacity check (provisioner.go:354-392)
+                max_cap = resutil.cmp_max(
+                    *(it.capacity for it in claim.instance_type_options)
+                )
+                usage = usage_by_pool.get(pool.name, {})
+                projected = resutil.merge(usage, max_cap)
+                errs = pool.spec.limits.exceeded_by(projected)
+                if errs:
+                    continue  # skip launch; pods stay pending
+                usage_by_pool[pool.name] = projected
+            nc = claim.template.to_node_claim(
+                claim.requirements, claim.instance_type_options, claim.requests
+            )
+            nc.metadata.finalizers.append(apilabels.TERMINATION_FINALIZER)
+            self.kube.create(nc)
+            for p in claim.pods:
+                nominations[p.key()] = nc.name
+        return nominations
+
+    def _usage_by_nodepool(self) -> Dict[str, dict]:
+        """In-use capacity per pool (the nodepool.counter aggregation,
+        reference pkg/controllers/nodepool/counter)."""
+        usage: Dict[str, dict] = {}
+        for sn in self.cluster.nodes():
+            pool = sn.nodepool_name
+            if pool:
+                usage[pool] = resutil.merge(usage.get(pool, {}), sn.capacity())
+        return usage

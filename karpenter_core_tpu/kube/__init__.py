@@ -1,0 +1,3 @@
+from karpenter_core_tpu.kube.store import KubeStore
+
+__all__ = ["KubeStore"]

@@ -133,7 +133,12 @@ class DeviceScheduler:
         daemonset_pods: Optional[List[Pod]] = None,
         max_slots: int = 256,
         validate: bool = False,
+        topology: Optional[Topology] = None,
     ):
+        # a supplied Topology carries cluster context (existing pods,
+        # exclusions); its groups are rebuilt fresh each solve round, so only
+        # the constructor inputs are kept
+        self._topology_context = topology
         self.nodepools = sorted(nodepools, key=lambda n: (-n.spec.weight, n.name))
         self.instance_types = instance_types
         # initialized nodes first, then by name (scheduler.go:344-354) —
@@ -234,8 +239,16 @@ class DeviceScheduler:
 
         # one Topology per solve round; every pod's groups are (re)built so
         # relaxed specs take effect (topology.go NewTopology:60-86)
+        ctx = self._topology_context
         topo = Topology(
-            domains={k: set(v) for k, v in self.domains_universe.items()}
+            domains={
+                k: set(v)
+                for k, v in (
+                    ctx.domains if ctx is not None else self.domains_universe
+                ).items()
+            },
+            existing_pods=ctx.existing_pods if ctx is not None else None,
+            excluded_pod_uids=ctx.excluded_pods if ctx is not None else (),
         )
         for p in pods:
             topo.update(p)
