@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import math
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -50,12 +51,14 @@ class Budget:
         return now - fired < (self.duration or 0.0)
 
     def allowed_disruptions(self, total_nodes: int, now: Optional[float] = None) -> int:
-        """Nodes this budget allows disrupting (nodepool.go:305-351)."""
+        """Nodes this budget allows disrupting (nodepool.go:305-351).
+        Percentages round UP, matching GetScaledValueFromIntOrPercent(.., true)
+        — 5% of 10 nodes allows 1 rather than blocking everything."""
         if not self.is_active(now):
-            return total_nodes  # inactive budgets don't constrain
+            return 1 << 31  # inactive budgets don't constrain
         if self.nodes.endswith("%"):
             pct = float(self.nodes[:-1]) / 100.0
-            return int(pct * total_nodes)
+            return math.ceil(pct * total_nodes - 1e-9)
         return int(self.nodes)
 
 

@@ -13,8 +13,8 @@ from __future__ import annotations
 
 import itertools
 import re
-import time
 from dataclasses import dataclass, field
+
 from typing import Optional
 
 # ---------------------------------------------------------------------------
@@ -97,7 +97,9 @@ class ObjectMeta:
     annotations: dict = field(default_factory=dict)
     finalizers: list = field(default_factory=list)
     owner_references: list = field(default_factory=list)
-    creation_timestamp: float = field(default_factory=time.time)
+    # 0.0 = unset; the kube store stamps it from ITS clock on create, so
+    # multiple stores/operators with different clocks never cross-contaminate
+    creation_timestamp: float = 0.0
     deletion_timestamp: Optional[float] = None
     resource_version: int = 0
     generation: int = 1

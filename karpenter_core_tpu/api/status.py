@@ -1,8 +1,9 @@
 """Status conditions (the operatorpkg condition model the reference relies on)."""
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
+
+from karpenter_core_tpu.utils import timesource
 from typing import Optional
 
 CONDITION_TRUE = "True"
@@ -16,7 +17,7 @@ class Condition:
     status: str = CONDITION_UNKNOWN
     reason: str = ""
     message: str = ""
-    last_transition_time: float = field(default_factory=time.time)
+    last_transition_time: float = field(default_factory=timesource.now)
 
 
 class ConditionSet:
@@ -30,24 +31,42 @@ class ConditionSet:
         return self._conditions.get(cond_type)
 
     def set(
-        self, cond_type: str, status: str, reason: str = "", message: str = ""
+        self,
+        cond_type: str,
+        status: str,
+        reason: str = "",
+        message: str = "",
+        now: Optional[float] = None,
     ) -> bool:
-        """Returns True if the condition transitioned."""
+        """Returns True if the condition transitioned. Controllers pass
+        ``now`` from their injected clock; the timesource default covers
+        ad-hoc construction."""
         existing = self._conditions.get(cond_type)
         if existing is not None and existing.status == status:
             existing.reason = reason
             existing.message = message
             return False
-        self._conditions[cond_type] = Condition(
+        cond = Condition(
             type=cond_type, status=status, reason=reason, message=message
         )
+        if now is not None:
+            cond.last_transition_time = now
+        self._conditions[cond_type] = cond
         return True
 
-    def set_true(self, cond_type: str, reason: str = "") -> bool:
-        return self.set(cond_type, CONDITION_TRUE, reason)
+    def set_true(
+        self, cond_type: str, reason: str = "", now: Optional[float] = None
+    ) -> bool:
+        return self.set(cond_type, CONDITION_TRUE, reason, now=now)
 
-    def set_false(self, cond_type: str, reason: str = "", message: str = "") -> bool:
-        return self.set(cond_type, CONDITION_FALSE, reason, message)
+    def set_false(
+        self,
+        cond_type: str,
+        reason: str = "",
+        message: str = "",
+        now: Optional[float] = None,
+    ) -> bool:
+        return self.set(cond_type, CONDITION_FALSE, reason, message, now=now)
 
     def clear(self, cond_type: str) -> bool:
         return self._conditions.pop(cond_type, None) is not None

@@ -190,7 +190,9 @@ class KwokCloudProvider(CloudProvider):
             }
         )
         node_claim.metadata.labels = labels
-        node_claim.conditions.set_true(COND_LAUNCHED, "Launched")
+        node_claim.conditions.set_true(
+            COND_LAUNCHED, "Launched", now=self.kube.clock.now()
+        )
 
         # Materialize the fake Node with the unregistered taint; the
         # registration controller adopts it (kwok cloudprovider.go:53-64).

@@ -1,0 +1,190 @@
+"""Disruption controller: method precedence, command execution, and the
+orchestration queue waiting on replacements
+(reference: pkg/controllers/disruption/controller.go:54-247,
+orchestration/queue.go:108-249).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from karpenter_core_tpu.api import labels as apilabels
+from karpenter_core_tpu.api.nodeclaim import NodeClaim
+from karpenter_core_tpu.api.objects import Node
+from karpenter_core_tpu.controllers.disruption.helpers import (
+    build_disruption_budget_mapping,
+    get_candidates,
+)
+from karpenter_core_tpu.controllers.disruption.methods import (
+    Drift,
+    Emptiness,
+    MultiNodeConsolidation,
+    SingleNodeConsolidation,
+)
+from karpenter_core_tpu.controllers.disruption.types import Command
+from karpenter_core_tpu.kube.store import NotFoundError
+from karpenter_core_tpu.scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
+
+COMMAND_TIMEOUT = 10 * 60.0  # orchestration/queue.go:53
+
+
+@dataclass
+class DisruptionContext:
+    """What every method needs to see (stand-in for the Go struct embeds)."""
+
+    kube: object
+    cluster: object
+    provisioner: object
+    cloud_provider: object
+    clock: object
+    feature_gates: Dict[str, bool] = field(default_factory=dict)
+
+
+@dataclass
+class InFlightCommand:
+    command: Command
+    replacement_names: List[str]
+    created_at: float
+
+
+class DisruptionController:
+    def __init__(
+        self,
+        kube,
+        cluster,
+        provisioner,
+        cloud_provider,
+        clock,
+        feature_gates: Optional[Dict[str, bool]] = None,
+    ):
+        self.kube = kube
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.clock = clock
+        ctx = DisruptionContext(
+            kube=kube,
+            cluster=cluster,
+            provisioner=provisioner,
+            cloud_provider=cloud_provider,
+            clock=clock,
+            feature_gates=dict(feature_gates or {}),
+        )
+        self.ctx = ctx
+        # method precedence (controller.go:84-93)
+        self.methods = [
+            Drift(ctx),
+            Emptiness(ctx),
+            MultiNodeConsolidation(ctx),
+            SingleNodeConsolidation(ctx),
+        ]
+        self.in_flight: List[InFlightCommand] = []
+
+    # -- the 10s poll body (controller.go:104-197) -------------------------
+
+    def reconcile(self) -> Optional[Command]:
+        self._reconcile_orchestration()
+        if self.in_flight:
+            # one graceful command at a time keeps validation simple and
+            # mirrors the serial executeCommand flow
+            return None
+        for method in self.methods:
+            candidates = get_candidates(
+                self.clock,
+                self.cluster,
+                self.kube,
+                self.cloud_provider,
+                method.should_disrupt,
+            )
+            if not candidates:
+                continue
+            budgets = build_disruption_budget_mapping(
+                self.clock, self.cluster, self.kube
+            )
+            command = method.compute_command(budgets, candidates)
+            if command.decision == "no-op":
+                continue
+            self._execute(command)
+            return command
+        self.cluster.mark_consolidated()
+        return None
+
+    # -- execution (controller.go:203-247) ---------------------------------
+
+    def _execute(self, command: Command) -> None:
+        # taint + mark so the provisioner stops using the candidates
+        for c in command.candidates:
+            node = self.kube.get(Node, c.name)
+            if node is None:
+                continue
+            if not any(
+                t.key == DISRUPTED_NO_SCHEDULE_TAINT.key for t in node.taints
+            ):
+                node.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
+                self.kube.update(node)
+            c.state_node.marked_for_deletion = True
+
+        replacement_names = []
+        for claim in command.replacements:
+            nc = claim.template.to_node_claim(
+                claim.requirements, claim.instance_type_options, claim.requests
+            )
+            nc.metadata.finalizers.append(apilabels.TERMINATION_FINALIZER)
+            self.kube.create(nc)
+            replacement_names.append(nc.name)
+
+        self.in_flight.append(
+            InFlightCommand(
+                command=command,
+                replacement_names=replacement_names,
+                created_at=self.clock.now(),
+            )
+        )
+
+    # -- orchestration (orchestration/queue.go:163-249) --------------------
+
+    def _reconcile_orchestration(self) -> None:
+        remaining = []
+        for cmd in self.in_flight:
+            if self._finished(cmd):
+                continue
+            if self.clock.since(cmd.created_at) > COMMAND_TIMEOUT:
+                self._rollback(cmd)
+                continue
+            remaining.append(cmd)
+        self.in_flight = remaining
+
+    def _finished(self, cmd: InFlightCommand) -> bool:
+        # all replacements must be initialized before candidates die
+        # (waitOrTerminate, orchestration/queue.go:221-249)
+        for name in cmd.replacement_names:
+            claim = self.kube.get(NodeClaim, name)
+            if claim is None:
+                # replacement failed (e.g. insufficient capacity): abort the
+                # whole command and roll back (queue.go:181-209)
+                self._rollback(cmd)
+                return True
+            if not claim.is_initialized():
+                return False
+        for c in cmd.command.candidates:
+            node = self.kube.get(Node, c.name)
+            if node is not None and node.metadata.deletion_timestamp is None:
+                try:
+                    self.kube.delete(node)
+                except NotFoundError:
+                    pass
+        # command completes when every candidate node is gone
+        return all(
+            self.kube.get(Node, c.name) is None for c in cmd.command.candidates
+        )
+
+    def _rollback(self, cmd: InFlightCommand) -> None:
+        for c in cmd.command.candidates:
+            node = self.kube.get(Node, c.name)
+            if node is not None and node.metadata.deletion_timestamp is None:
+                node.taints = [
+                    t
+                    for t in node.taints
+                    if t.key != DISRUPTED_NO_SCHEDULE_TAINT.key
+                ]
+                self.kube.update(node)
+            c.state_node.marked_for_deletion = False

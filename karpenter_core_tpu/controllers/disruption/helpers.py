@@ -1,0 +1,146 @@
+"""Disruption helpers: the scheduling-simulation bridge into L4, candidate
+collection, and budget math (reference: pkg/controllers/disruption/
+helpers.go:49-245)."""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set
+
+from karpenter_core_tpu.api import labels as apilabels
+from karpenter_core_tpu.api.nodepool import REASON_ALL
+from karpenter_core_tpu.controllers.disruption.types import (
+    Candidate,
+    CandidateError,
+    new_candidate,
+)
+from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+    Results,
+)
+
+
+def simulate_scheduling(
+    provisioner,
+    cluster,
+    candidates: List[Candidate],
+) -> Results:
+    """Re-enter the full provisioning scheduler with the candidates' nodes
+    removed and their pods queued (helpers.go:49-113). The solver strategy
+    (greedy|tpu) rides the provisioner's configuration."""
+    excluded = {c.name for c in candidates}
+    sim_nodes = [
+        n for n in cluster.sim_nodes() if n.name not in excluded
+    ]
+    pods = provisioner.pending_pods() + provisioner.deleting_node_pods()
+    for c in candidates:
+        pods.extend(c.reschedulable_pods)
+
+    nodepools = provisioner.ready_nodepools()
+    instance_types = {
+        np.name: provisioner.cloud_provider.get_instance_types(np)
+        for np in nodepools
+    }
+    from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+        Topology,
+        domain_universe,
+    )
+
+    topology = Topology(
+        domains=domain_universe(nodepools, instance_types, sim_nodes),
+        existing_pods=[
+            (p, labels, name)
+            for (p, labels, name) in cluster.existing_pod_triples()
+            if name not in excluded
+        ],
+        excluded_pod_uids={p.uid for p in pods},
+    )
+    common = dict(
+        nodepools=nodepools,
+        instance_types=instance_types,
+        existing_nodes=sim_nodes,
+        daemonset_pods=provisioner.daemonset_pods(),
+        topology=topology,
+    )
+    if provisioner.solver == "tpu":
+        from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+        scheduler = DeviceScheduler(
+            **common, **provisioner.device_scheduler_opts
+        )
+    else:
+        from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+            Scheduler,
+        )
+
+        scheduler = Scheduler(**common)
+    return scheduler.solve(pods)
+
+
+def get_candidates(
+    clock,
+    cluster,
+    kube,
+    cloud_provider,
+    should_disrupt: Callable[[Candidate], bool],
+) -> List[Candidate]:
+    """(helpers.go:144-161)"""
+    nodepools = {np.name: np for np in kube.list_nodepools()}
+    instance_types = {
+        name: cloud_provider.get_instance_types(np)
+        for name, np in nodepools.items()
+    }
+    out = []
+    for sn in cluster.nodes():
+        try:
+            c = new_candidate(clock, cluster, sn, nodepools, instance_types)
+        except CandidateError:
+            continue
+        if should_disrupt(c):
+            out.append(c)
+    return out
+
+
+class BudgetMapping:
+    """Allowed disruptions per (nodepool, reason) minus nodes already
+    disrupting (helpers.go:197-245)."""
+
+    def __init__(self, allowed: Dict[str, Dict[str, int]]):
+        self.allowed = allowed
+
+    def remaining(self, nodepool_name: str, reason: str) -> int:
+        pool = self.allowed.get(nodepool_name, {})
+        if reason in pool:
+            return pool[reason]
+        return pool.get(REASON_ALL, 1 << 30)
+
+    def consume(self, nodepool_name: str, reason: str, n: int = 1) -> None:
+        pool = self.allowed.setdefault(nodepool_name, {})
+        for r in (reason, REASON_ALL):
+            if r in pool:
+                pool[r] = max(pool[r] - n, 0)
+
+
+def build_disruption_budget_mapping(clock, cluster, kube) -> BudgetMapping:
+    allowed: Dict[str, Dict[str, int]] = {}
+    now = clock.now()
+    for np in kube.list_nodepools():
+        totals = 0
+        disrupting = 0
+        for sn in cluster.nodes():
+            if sn.nodepool_name != np.name:
+                continue
+            if not sn.initialized():
+                continue
+            totals += 1
+            # draining nodes consume budget until they're gone
+            # (helpers.go:197-245 counts MarkedForDeletion)
+            if sn.marked_for_deletion or sn.deleting():
+                disrupting += 1
+        per_reason: Dict[str, int] = {}
+        for budget in np.spec.disruption.budgets:
+            budget_reasons = budget.reasons or [REASON_ALL]
+            cap = budget.allowed_disruptions(totals, now)
+            for r in budget_reasons:
+                per_reason[r] = min(per_reason.get(r, 1 << 30), cap)
+        for r in list(per_reason):
+            per_reason[r] = max(per_reason[r] - disrupting, 0)
+        allowed[np.name] = per_reason
+    return BudgetMapping(allowed)

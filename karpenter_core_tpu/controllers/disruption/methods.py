@@ -1,0 +1,343 @@
+"""Disruption methods: Emptiness, Drift, Single/Multi-node consolidation
+(reference: pkg/controllers/disruption/{emptiness,drift,consolidation,
+singlenodeconsolidation,multinodeconsolidation}.go).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_core_tpu.api import labels as apilabels
+from karpenter_core_tpu.api.nodepool import (
+    REASON_DRIFTED,
+    REASON_EMPTY,
+    REASON_UNDERUTILIZED,
+)
+from karpenter_core_tpu.controllers.disruption.helpers import (
+    BudgetMapping,
+    simulate_scheduling,
+)
+from karpenter_core_tpu.controllers.disruption.types import (
+    Candidate,
+    Command,
+    is_consolidatable,
+    is_drifted,
+)
+from karpenter_core_tpu.controllers.provisioning.scheduling.nodeclaimtemplate import (
+    filter_instance_types,
+)
+from karpenter_core_tpu.cloudprovider.types import order_by_price, satisfies_min_values
+from karpenter_core_tpu.scheduling import Requirement
+
+MULTI_NODE_CONSOLIDATION_CANDIDATE_CAP = 100  # multinodeconsolidation.go:81
+MIN_INSTANCE_TYPES_FOR_SPOT_TO_SPOT = 15  # consolidation.go:48-49
+
+
+def filter_replacement_by_price(claim, max_price: float) -> None:
+    """RemoveInstanceTypeOptionsByPriceAndMinValues (nodeclaim.go:136-145):
+    keep instance types whose worst launch price under the claim's
+    requirements is strictly cheaper than max_price; then re-check
+    minValues. Mutates the in-flight claim's options."""
+    kept = [
+        it
+        for it in claim.instance_type_options
+        if 0.0
+        < it.offerings.available().compatible(claim.requirements).worst_launch_price(
+            claim.requirements
+        )
+        < max_price
+    ]
+    if claim.requirements.has_min_values():
+        _, err = satisfies_min_values(kept, claim.requirements)
+        if err is not None:
+            kept = []
+    claim.instance_type_options = kept
+
+
+class Emptiness:
+    """Zero reschedulable pods + Consolidatable: delete, no simulation
+    (emptiness.go:44-122)."""
+
+    reason = REASON_EMPTY
+    consolidation_type = "empty"
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def should_disrupt(self, c: Candidate) -> bool:
+        if c.nodepool.spec.disruption.consolidate_after.is_never:
+            return False
+        return not c.reschedulable_pods and is_consolidatable(c)
+
+    def compute_command(
+        self, budgets: BudgetMapping, candidates: List[Candidate]
+    ) -> Command:
+        fits = []
+        for c in sorted(candidates, key=lambda c: c.disruption_cost):
+            if budgets.remaining(c.nodepool.name, self.reason) > 0:
+                budgets.consume(c.nodepool.name, self.reason)
+                fits.append(c)
+        return Command(candidates=fits, reason=self.reason)
+
+
+class Drift:
+    """Drifted condition, oldest first; empties free, others must fully
+    reschedule (drift.go:54-115)."""
+
+    reason = REASON_DRIFTED
+    consolidation_type = "drift"
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def should_disrupt(self, c: Candidate) -> bool:
+        return is_drifted(c)
+
+    def compute_command(
+        self, budgets: BudgetMapping, candidates: List[Candidate]
+    ) -> Command:
+        def drift_time(c: Candidate) -> float:
+            cond = c.node_claim.conditions.get("Drifted")
+            return cond.last_transition_time if cond else 0.0
+
+        candidates = sorted(candidates, key=drift_time)
+        # empty drifted candidates batch together, consuming budget as the
+        # batch builds (drift.go:66-80)
+        empty = []
+        for c in candidates:
+            if c.reschedulable_pods:
+                continue
+            if budgets.remaining(c.nodepool.name, self.reason) > 0:
+                budgets.consume(c.nodepool.name, self.reason)
+                empty.append(c)
+        if empty:
+            return Command(candidates=empty, reason=self.reason)
+        allowed = [
+            c
+            for c in candidates
+            if budgets.remaining(c.nodepool.name, self.reason) > 0
+        ]
+        for c in allowed:
+            results = simulate_scheduling(
+                self.ctx.provisioner, self.ctx.cluster, [c]
+            )
+            if not results.all_pods_scheduled():
+                continue
+            budgets.consume(c.nodepool.name, self.reason)
+            return Command(
+                candidates=[c],
+                replacements=results.new_node_claims,
+                reason=self.reason,
+            )
+        return Command()
+
+
+class _ConsolidationBase:
+    """Shared simulate→price-filter pipeline (consolidation.go:133-304)."""
+
+    reason = REASON_UNDERUTILIZED
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def should_disrupt(self, c: Candidate) -> bool:
+        if c.instance_type is None:
+            return False
+        if apilabels.CAPACITY_TYPE_LABEL_KEY not in c.state_node.labels:
+            return False
+        if apilabels.LABEL_TOPOLOGY_ZONE not in c.state_node.labels:
+            return False
+        if c.nodepool.spec.disruption.consolidation_policy == "WhenEmpty":
+            return not c.reschedulable_pods and is_consolidatable(c)
+        return is_consolidatable(c)
+
+    def compute_consolidation(
+        self, candidates: List[Candidate]
+    ) -> Tuple[Command, object]:
+        """(consolidation.go:133-230)"""
+        results = simulate_scheduling(
+            self.ctx.provisioner, self.ctx.cluster, candidates
+        )
+        if not results.all_pods_scheduled():
+            return Command(), results
+        if len(results.new_node_claims) == 0:
+            return Command(candidates=candidates, reason=self.reason), results
+        if len(results.new_node_claims) != 1:
+            return Command(), results
+
+        replacement = results.new_node_claims[0]
+        candidate_price = sum(c.price() for c in candidates)
+        all_spot = all(
+            c.capacity_type == apilabels.CAPACITY_TYPE_SPOT for c in candidates
+        )
+        replacement.instance_type_options = order_by_price(
+            replacement.instance_type_options, replacement.requirements
+        )
+
+        ct_req = replacement.requirements.get(apilabels.CAPACITY_TYPE_LABEL_KEY)
+        if all_spot and ct_req.has(apilabels.CAPACITY_TYPE_SPOT):
+            return self._spot_to_spot(candidates, results, candidate_price)
+
+        filter_replacement_by_price(replacement, candidate_price)
+        if not replacement.instance_type_options:
+            return Command(), results
+
+        # OD -> [OD, spot]: force spot so insufficient spot capacity fails the
+        # launch instead of replacing with pricier on-demand
+        # (consolidation.go:211-218)
+        if ct_req.has(apilabels.CAPACITY_TYPE_SPOT) and ct_req.has(
+            apilabels.CAPACITY_TYPE_ON_DEMAND
+        ):
+            replacement.requirements.add(
+                Requirement.new(
+                    apilabels.CAPACITY_TYPE_LABEL_KEY,
+                    "In",
+                    [apilabels.CAPACITY_TYPE_SPOT],
+                )
+            )
+        return (
+            Command(
+                candidates=candidates,
+                replacements=[replacement],
+                reason=self.reason,
+            ),
+            results,
+        )
+
+    def _spot_to_spot(
+        self, candidates: List[Candidate], results, candidate_price: float
+    ) -> Tuple[Command, object]:
+        """(consolidation.go:226-304)"""
+        if not self.ctx.feature_gates.get("SpotToSpotConsolidation", False):
+            return Command(), results
+        replacement = results.new_node_claims[0]
+        replacement.requirements.add(
+            Requirement.new(
+                apilabels.CAPACITY_TYPE_LABEL_KEY,
+                "In",
+                [apilabels.CAPACITY_TYPE_SPOT],
+            )
+        )
+        replacement.instance_type_options = filter_instance_types(
+            replacement.instance_type_options, replacement.requirements, {}
+        ).remaining
+        filter_replacement_by_price(replacement, candidate_price)
+        if not replacement.instance_type_options:
+            return Command(), results
+        if len(candidates) > 1:
+            return (
+                Command(
+                    candidates=candidates,
+                    replacements=[replacement],
+                    reason=self.reason,
+                ),
+                results,
+            )
+        # single-node: require 15 cheaper options, truncate to 15 so the
+        # launched type stays inside the set (no consolidation churn)
+        if len(replacement.instance_type_options) < MIN_INSTANCE_TYPES_FOR_SPOT_TO_SPOT:
+            return Command(), results
+        cap = MIN_INSTANCE_TYPES_FOR_SPOT_TO_SPOT
+        if replacement.requirements.has_min_values():
+            n, _ = satisfies_min_values(
+                replacement.instance_type_options, replacement.requirements
+            )
+            cap = max(cap, n or 0)
+        replacement.instance_type_options = replacement.instance_type_options[:cap]
+        return (
+            Command(
+                candidates=candidates,
+                replacements=[replacement],
+                reason=self.reason,
+            ),
+            results,
+        )
+
+    def _budget_filter(
+        self, budgets: BudgetMapping, candidates: List[Candidate]
+    ) -> List[Candidate]:
+        out = []
+        used: Dict[str, int] = {}
+        for c in candidates:
+            pool = c.nodepool.name
+            if budgets.remaining(pool, self.reason) - used.get(pool, 0) > 0:
+                used[pool] = used.get(pool, 0) + 1
+                out.append(c)
+        return out
+
+
+class SingleNodeConsolidation(_ConsolidationBase):
+    """One candidate at a time (singlenodeconsolidation.go:44-101)."""
+
+    consolidation_type = "single"
+
+    def compute_command(
+        self, budgets: BudgetMapping, candidates: List[Candidate]
+    ) -> Command:
+        candidates = self._budget_filter(
+            budgets, sorted(candidates, key=lambda c: c.disruption_cost)
+        )
+        for c in candidates:
+            cmd, _ = self.compute_consolidation([c])
+            if cmd.decision != "no-op":
+                budgets.consume(c.nodepool.name, self.reason)
+                return cmd
+        return Command()
+
+
+class MultiNodeConsolidation(_ConsolidationBase):
+    """Binary search for the largest consolidatable prefix
+    (multinodeconsolidation.go:46-162). The device-batched variant
+    evaluates all prefixes in one call (models/consolidation milestone)."""
+
+    consolidation_type = "multi"
+
+    def compute_command(
+        self, budgets: BudgetMapping, candidates: List[Candidate]
+    ) -> Command:
+        candidates = self._budget_filter(
+            budgets, sorted(candidates, key=lambda c: c.disruption_cost)
+        )[:MULTI_NODE_CONSOLIDATION_CANDIDATE_CAP]
+        if len(candidates) < 2:
+            return Command()
+        lo, hi = 1, len(candidates)
+        best = Command()
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            prefix = candidates[:mid]
+            cmd, _ = self.compute_consolidation(prefix)
+            ok = cmd.decision == "delete"
+            if cmd.decision == "replace":
+                self._filter_out_same_type(cmd.replacements[0], prefix)
+                ok = bool(cmd.replacements[0].instance_type_options)
+            if ok:
+                best = cmd
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        if best.decision != "no-op":
+            for c in best.candidates:
+                budgets.consume(c.nodepool.name, self.reason)
+        return best
+
+    @staticmethod
+    def _filter_out_same_type(replacement, consolidate: List[Candidate]) -> None:
+        """If the replacement's options include a type being removed, cap the
+        price below the cheapest same-type candidate
+        (multinodeconsolidation.go:164-217)."""
+        existing = set()
+        price_by_type: Dict[str, float] = {}
+        for c in consolidate:
+            if c.instance_type is None:
+                continue
+            existing.add(c.instance_type.name)
+            p = c.price()
+            if p > 0:
+                price_by_type[c.instance_type.name] = min(
+                    price_by_type.get(c.instance_type.name, math.inf), p
+                )
+        max_price = math.inf
+        for it in replacement.instance_type_options:
+            if it.name in existing and it.name in price_by_type:
+                max_price = min(max_price, price_by_type[it.name])
+        filter_replacement_by_price(replacement, max_price)

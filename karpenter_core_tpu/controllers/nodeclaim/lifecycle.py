@@ -90,7 +90,7 @@ class NodeClaimLifecycle:
             node.metadata.finalizers.append(apilabels.TERMINATION_FINALIZER)
         self.kube.update(node)
         claim.status.node_name = node.name
-        claim.conditions.set_true(COND_REGISTERED, "Registered")
+        claim.conditions.set_true(COND_REGISTERED, "Registered", now=self.clock.now())
         self.kube.update(claim)
 
     # -- initialization (initialization.go:47) -----------------------------
@@ -110,7 +110,7 @@ class NodeClaimLifecycle:
             return
         node.metadata.labels[apilabels.NODE_INITIALIZED_LABEL_KEY] = "true"
         self.kube.update(node)
-        claim.conditions.set_true(COND_INITIALIZED, "Initialized")
+        claim.conditions.set_true(COND_INITIALIZED, "Initialized", now=self.clock.now())
         self.kube.update(claim)
 
     # -- teardown (lifecycle/controller.go:111-285) ------------------------
@@ -122,6 +122,6 @@ class NodeClaimLifecycle:
             self.cloud_provider.delete(claim)
         except NodeClaimNotFoundError:
             pass  # instance already gone
-        claim.conditions.set_true(COND_INSTANCE_TERMINATING, "Terminating")
+        claim.conditions.set_true(COND_INSTANCE_TERMINATING, "Terminating", now=self.clock.now())
         claim.metadata.finalizers.remove(apilabels.TERMINATION_FINALIZER)
         self.kube.update(claim)

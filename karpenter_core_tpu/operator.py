@@ -22,7 +22,14 @@ from typing import Dict, List, Optional
 from karpenter_core_tpu.api.nodeclaim import NodeClaim
 from karpenter_core_tpu.api.objects import Node, Pod
 from karpenter_core_tpu.cloudprovider.kwok import KwokCloudProvider
+from karpenter_core_tpu.controllers.disruption.controller import (
+    DisruptionController,
+)
 from karpenter_core_tpu.controllers.node.termination import NodeTermination
+from karpenter_core_tpu.controllers.nodeclaim.disruption import (
+    NodeClaimDisruption,
+    PodEvents,
+)
 from karpenter_core_tpu.controllers.nodeclaim.lifecycle import NodeClaimLifecycle
 from karpenter_core_tpu.controllers.provisioning.provisioner import Provisioner
 from karpenter_core_tpu.kube.store import KubeStore
@@ -53,6 +60,11 @@ class Operator:
         instance_types=None,
     ):
         self.clock = clock or Clock()
+        # object timestamps (creation, condition transitions) follow the
+        # operator's clock so fake-clock tests are fully deterministic
+        from karpenter_core_tpu.utils import timesource
+
+        timesource.set_source(self.clock.now)
         self.kube = kube or KubeStore(self.clock)
         self.options = options or Options()
         self.cloud_provider = cloud_provider or KwokCloudProvider(
@@ -73,26 +85,41 @@ class Operator:
         self.termination = NodeTermination(
             self.kube, self.cluster, self.cloud_provider, self.clock
         )
+        self.nodeclaim_disruption = NodeClaimDisruption(
+            self.kube, self.cloud_provider, self.clock
+        )
+        self.pod_events = PodEvents(self.kube, self.cluster, self.clock)
+        self.disruption = DisruptionController(
+            self.kube,
+            self.cluster,
+            self.provisioner,
+            self.cloud_provider,
+            self.clock,
+            feature_gates=self.options.feature_gates,
+        )
         # claim/node name -> pod keys awaiting bind
         self.nominations: Dict[str, List[str]] = {}
 
     # -- one pass ----------------------------------------------------------
 
-    def reconcile_once(self) -> None:
+    def reconcile_once(self, disrupt: bool = True) -> None:
         for claim in list(self.kube.list_nodeclaims()):
             self.lifecycle.reconcile(claim)
+            self.nodeclaim_disruption.reconcile(claim)
         for node in list(self.kube.list_nodes()):
             self.termination.reconcile(node)
         self._bind_nominated()
         if any(podutil.is_provisionable(p) for p in self.kube.list_pods()):
             self._provision()
+        if disrupt:
+            self.disruption.reconcile()
 
-    def run_until_idle(self, max_iters: int = 100) -> int:
+    def run_until_idle(self, max_iters: int = 100, disrupt: bool = True) -> int:
         """Reconcile until the store stops changing; returns passes used."""
         for i in range(max_iters):
             before = self.kube.mutations
-            self.reconcile_once()
-            if self.kube.mutations == before:
+            self.reconcile_once(disrupt=disrupt)
+            if self.kube.mutations == before and not self.disruption.in_flight:
                 return i + 1
         return max_iters
 

@@ -1,0 +1,37 @@
+"""Disruption cost model (reference: pkg/utils/disruption/disruption.go:37-79)."""
+from __future__ import annotations
+
+from typing import List
+
+from karpenter_core_tpu.api.objects import Pod
+
+POD_DELETION_COST_ANNOTATION = "controller.kubernetes.io/pod-deletion-cost"
+
+
+def lifetime_remaining(clock, nodepool, node_claim) -> float:
+    """Fraction of node lifetime left in [0,1]; expiring-soon nodes are
+    cheaper to disrupt (disruption.go:37-47)."""
+    expire = node_claim.spec.expire_after.seconds
+    if expire is None or expire <= 0:
+        return 1.0
+    age = clock.since(node_claim.metadata.creation_timestamp)
+    return min(max((expire - age) / expire, 0.0), 1.0)
+
+
+def eviction_cost(pod: Pod) -> float:
+    """Base 1.0 + deletion-cost/2^27 + priority/2^25, clamped to [-10, 10]
+    (disruption.go:49-70)."""
+    cost = 1.0
+    raw = pod.metadata.annotations.get(POD_DELETION_COST_ANNOTATION)
+    if raw is not None:
+        try:
+            cost += float(raw) / 2.0**27
+        except ValueError:
+            pass
+    if pod.priority:
+        cost += float(pod.priority) / 2.0**25
+    return min(max(cost, -10.0), 10.0)
+
+
+def rescheduling_cost(pods: List[Pod]) -> float:
+    return sum(eviction_cost(p) for p in pods)
