@@ -35,6 +35,29 @@ class IncompatibleError(Exception):
     pass
 
 
+_MAX_ALLOC_MEMO: dict = {}
+
+
+def _max_allocatable(instance_types: List[InstanceType]) -> dict:
+    """Elementwise max allocatable across options — the roomiest any single
+    node from this set could be. Memoized on the option identity tuple;
+    the memo value keeps a strong reference to the option objects so their
+    ids can't be recycled while the entry lives (bounded, then cleared)."""
+    key = tuple(id(it) for it in instance_types)
+    hit = _MAX_ALLOC_MEMO.get(key)
+    if hit is not None:
+        return hit[1]
+    out: dict = {}
+    for it in instance_types:
+        for name, qty in it.allocatable().items():
+            if qty > out.get(name, 0.0):
+                out[name] = qty
+    if len(_MAX_ALLOC_MEMO) > 4096:
+        _MAX_ALLOC_MEMO.clear()
+    _MAX_ALLOC_MEMO[key] = (tuple(instance_types), out)
+    return out
+
+
 class InFlightNodeClaim:
     """A node being hypothesized during the solve (nodeclaim.go:35-64)."""
 
@@ -58,6 +81,7 @@ class InFlightNodeClaim:
         self.pods: List[Pod] = []
         self.topology = topology
         self.host_port_usage = HostPortUsage()
+        self._max_alloc_cache: Optional[dict] = None
 
     def add(self, pod: Pod, pod_requests: dict) -> None:
         """Raises IncompatibleError when the pod cannot join (nodeclaim.go:67-122)."""
@@ -68,6 +92,13 @@ class InFlightNodeClaim:
         conflict = self.host_port_usage.conflicts(pod, pod.host_ports)
         if conflict:
             raise IncompatibleError(conflict)
+
+        # cheap reject before any requirement copying: if the cumulative
+        # requests exceed even the roomiest remaining option, no instance
+        # type can fit (dominates when a fallback pod scans many claims)
+        requests = resutil.merge(self.requests, pod_requests)
+        if not resutil.fits(requests, self._max_alloc()):
+            raise IncompatibleError("no instance type has enough resources")
 
         claim_requirements = self.requirements.copy()
         pod_requirements = Requirements.from_pod(pod)
@@ -95,8 +126,6 @@ class InFlightNodeClaim:
         if errs:
             raise IncompatibleError(f"incompatible topology, {errs}")
         claim_requirements.add(*topology_requirements.values())
-
-        requests = resutil.merge(self.requests, pod_requests)
         filtered = filter_instance_types(
             self.instance_type_options, claim_requirements, requests
         )
@@ -108,11 +137,64 @@ class InFlightNodeClaim:
             )
 
         self.pods.append(pod)
+        if len(filtered.remaining) != len(self.instance_type_options):
+            self._max_alloc_cache = None
         self.instance_type_options = filtered.remaining
         self.requests = requests
         self.requirements = claim_requirements
         self.topology.record(pod, claim_requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)
         self.host_port_usage.add(pod, pod.host_ports)
+
+    def _max_alloc(self) -> dict:
+        if self._max_alloc_cache is None:
+            self._max_alloc_cache = _max_allocatable(self.instance_type_options)
+        return self._max_alloc_cache
+
+    def add_group(self, pods: List[Pod], per_pod_requests: dict) -> None:
+        """Batch-add k IDENTICAL pods in one pass of the host algebra.
+
+        Equivalent to k sequential add() calls when (a) the pods share one
+        spec (same requirements/tolerations/requests — a solver equivalence
+        class), (b) no topology groups are active, and (c) no host ports:
+        the requirement intersection is idempotent after the first add and
+        resource narrowing is monotone, so one filter at the cumulative
+        requests equals the k-th sequential filter. The decode path guards
+        those preconditions and falls back to per-pod adds otherwise."""
+        pod = pods[0]
+        errs = Taints(self.template.taints).tolerates(pod)
+        if errs:
+            raise IncompatibleError("; ".join(errs))
+
+        claim_requirements = self.requirements.copy()
+        pod_requirements = Requirements.from_pod(pod)
+        errs = claim_requirements.compatible(
+            pod_requirements, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+        )
+        if errs:
+            raise IncompatibleError(f"incompatible requirements, {errs}")
+        claim_requirements.add(*pod_requirements.values())
+
+        requests = resutil.merge(
+            self.requests, resutil.scale(per_pod_requests, len(pods))
+        )
+        if not resutil.fits(requests, self._max_alloc()):
+            raise IncompatibleError("no instance type has enough resources")
+        filtered = filter_instance_types(
+            self.instance_type_options, claim_requirements, requests
+        )
+        if not filtered.remaining:
+            total = resutil.merge(self.daemon_resources, per_pod_requests)
+            raise IncompatibleError(
+                f"no instance type satisfied resources {resutil.to_string(total)}"
+                f" x{len(pods)} and requirements ({filtered.failure_reason()})"
+            )
+
+        self.pods.extend(pods)
+        if len(filtered.remaining) != len(self.instance_type_options):
+            self._max_alloc_cache = None
+        self.instance_type_options = filtered.remaining
+        self.requests = requests
+        self.requirements = claim_requirements
 
     def destroy(self) -> None:
         self.topology.unregister(apilabels.LABEL_HOSTNAME, self.hostname)
@@ -205,3 +287,28 @@ class ExistingNodeSim:
         self.requirements = node_requirements
         self.topology.record(pod, node_requirements)
         self.host_port_usage.add(pod, pod.host_ports)
+
+    def add_group(self, pods: List[Pod], per_pod_requests: dict) -> None:
+        """Batch-add k identical pods; same preconditions as
+        InFlightNodeClaim.add_group."""
+        pod = pods[0]
+        errs = Taints(self.cached_taints).tolerates(pod)
+        if errs:
+            raise IncompatibleError("; ".join(errs))
+
+        requests = resutil.merge(
+            self.requests, resutil.scale(per_pod_requests, len(pods))
+        )
+        if not resutil.fits(requests, self.cached_available):
+            raise IncompatibleError("exceeds node resources")
+
+        node_requirements = self.requirements.copy()
+        pod_requirements = Requirements.from_pod(pod)
+        errs = node_requirements.compatible(pod_requirements)
+        if errs:
+            raise IncompatibleError(f"incompatible requirements, {errs}")
+        node_requirements.add(*pod_requirements.values())
+
+        self.pods.extend(pods)
+        self.requests = requests
+        self.requirements = node_requirements

@@ -27,6 +27,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -120,6 +121,17 @@ class _Prepared:
     existing_sims: List[ExistingNodeSim]
     n_slots: int
     topo: Topology
+    # numpy twins for the vectorized decode
+    it_alloc64: np.ndarray  # [pad_T, R] float64
+    class_requests64: np.ndarray  # [C, R] float64
+    tmpl_overhead64: np.ndarray  # [pad_S, R] float64
+    off_avail_np: np.ndarray  # [pad_T, Z, CT] bool
+    tmpl_it_np: np.ndarray  # [pad_S, pad_T] bool
+    tmpl_mask_np: np.ndarray  # [pad_S, K, V] bool
+    zone_kid: int
+    ct_kid: int
+    n_zones: int
+    n_cts: int
 
 
 class DeviceScheduler:
@@ -268,13 +280,17 @@ class DeviceScheduler:
             self._class_steps(prep),
             prep.statics,
         )
-        if bool(state.overflow):
+        # one device->host transfer for everything decode reads
+        overflow, takes, unplaced, slot_template = jax.device_get(
+            (state.overflow, takes, unplaced, state.template)
+        )
+        if bool(overflow):
             return None
         claims, existing_sims, failed = self._decode(
             prep,
             np.asarray(takes),
             np.asarray(unplaced),
-            np.asarray(state.template),
+            np.asarray(slot_template),
         )
 
         constrained_requests = {
@@ -321,14 +337,29 @@ class DeviceScheduler:
         vocab = Vocab()
         for cls in classes:
             vocab.observe_requirements(cls.requirements)
-        for it in catalog:
-            vocab.observe_requirements(it.requirements)
-            for off in it.offerings:
-                vocab.observe_requirements(off.requirements)
         for t in self.templates:
             vocab.observe_requirements(t.requirements)
         for r in exist_label_reqs:
             vocab.observe_requirements(r)
+        for it in catalog:
+            for off in it.offerings:
+                vocab.observe_requirements(off.requirements)
+        # Catalog instance types contribute VALUES only for keys some other
+        # entity mentions. An 800-type catalog otherwise pushes V to 800 via
+        # the instance-type name key and bloats every [N,K,V] slot plane;
+        # instance-type narrowing rides the dedicated [N,T] itmask instead.
+        # Exactness: keys only the catalog defines never meet a non-catalog
+        # requirement in any shared-key comparison, and class/template-vs-IT
+        # compat stays correct because an unobserved IT value yields an
+        # all-false mask — empty intersection — exactly when the other side's
+        # explicit values differ (closed-world argument in solver/vocab.py).
+        mentioned = set(vocab.keys)
+        for it in catalog:
+            for key, req in it.requirements.items():
+                vocab.key_id(key)
+                if key in mentioned:
+                    for v in req.values:
+                        vocab.value_id(key, v)
         frozen = vocab.finalize()
         well_known = np.array(
             [k in apilabels.WELL_KNOWN_LABELS for k in frozen.key_names], dtype=bool
@@ -340,6 +371,10 @@ class DeviceScheduler:
                 ["cpu", "memory", "pods", "ephemeral-storage"]
                 + [n for c in classes for n in c.requests]
                 + [n for it in catalog for n in it.allocatable()]
+                # daemon overhead joins every fresh claim's requests, so its
+                # resource names must be on the axis or the vectorized fit
+                # check would silently drop them
+                + [n for o in self.daemon_overhead for n in o]
             )
         )
         R = len(resource_names)
@@ -370,13 +405,25 @@ class DeviceScheduler:
         )
 
         C = len(classes)
+        def rvec64(rl: dict) -> np.ndarray:
+            return np.array(
+                [rl.get(n, 0.0) for n in resource_names], dtype=np.float64
+            )
+
         class_requests = np.stack(
             [rvec(resutil.requests_for_pods(c.pods[0])) for c in classes]
         ) if classes else np.zeros((0, R), dtype=np.float32)
+        # float64 twins: the vectorized decode must match the host algebra's
+        # float64 arithmetic exactly
+        class_requests64 = np.stack(
+            [rvec64(resutil.requests_for_pods(c.pods[0])) for c in classes]
+        ) if classes else np.zeros((0, R), dtype=np.float64)
 
         it_alloc = np.zeros((pad_T, R), dtype=np.float32)
+        it_alloc64 = np.zeros((pad_T, R), dtype=np.float64)
         for ti, it in enumerate(catalog):
             it_alloc[ti] = rvec(it.allocatable())
+            it_alloc64[ti] = rvec64(it.allocatable())
 
         # offerings tensor [T, Z, CT] over the zone/ct vocab rows
         zone_kid = frozen.keys.get(apilabels.LABEL_TOPOLOGY_ZONE, 0)
@@ -431,6 +478,9 @@ class DeviceScheduler:
         tmpl_overhead = np.stack(
             [rvec(o) for o in self.daemon_overhead]
         ) if S else np.zeros((pad_S, R), dtype=np.float32)
+        tmpl_overhead64 = np.stack(
+            [rvec64(o) for o in self.daemon_overhead]
+        ) if S else np.zeros((pad_S, R), dtype=np.float64)
 
         # fresh-node viability + kstar per class (first template wins)
         new_template = np.full((C,), -1, dtype=np.int32)
@@ -559,6 +609,16 @@ class DeviceScheduler:
             existing_sims=existing_sims,
             n_slots=N,
             topo=topo,
+            it_alloc64=it_alloc64,
+            class_requests64=class_requests64,
+            tmpl_overhead64=tmpl_overhead64,
+            off_avail_np=off_avail,
+            tmpl_it_np=tmpl_it,
+            tmpl_mask_np=tmpl_masks.mask,
+            zone_kid=zone_kid,
+            ct_kid=ct_kid,
+            n_zones=Z,
+            n_cts=CT,
         )
 
     def _class_steps(self, prep: _Prepared) -> ClassStep:
@@ -633,15 +693,23 @@ class DeviceScheduler:
         claims: List[InFlightNodeClaim] = []
         topo = prep.topo
         pod_cursor = {ci: 0 for ci in range(C)}
+        # group-add is exact only when no topology group could observe these
+        # pods (decode sees topology-free pods, but inverse anti-affinity
+        # groups from the cluster can still select them by label)
+        can_group = not topo.topologies and not topo.inverse_topologies
 
         for n in sorted(assigned):
             groups = assigned[n]
             if n < E:
                 target = prep.existing_sims[n]
-                add = target.add
             else:
                 si = int(slot_template[n])
                 template = prep.templates[si]
+                if can_group and self._decode_fresh_vectorized(
+                    prep, si, template, groups, pod_cursor, topo,
+                    claims, divergent,
+                ):
+                    continue
                 target = InFlightNodeClaim(
                     template,
                     topo,
@@ -649,16 +717,23 @@ class DeviceScheduler:
                     template.instance_type_options,
                 )
                 claims.append(target)
-                add = target.add
             for ci, k in groups:
                 cls = prep.classes[ci]
                 start = pod_cursor[ci]
                 pods = cls.pods[start : start + k]
                 pod_cursor[ci] = start + k
-                req = resutil.requests_for_pods(pods[0]) if pods else {}
+                if not pods:
+                    continue
+                req = resutil.requests_for_pods(pods[0])
+                if can_group and not pods[0].host_ports:
+                    try:
+                        target.add_group(pods, req)
+                        continue
+                    except IncompatibleError:
+                        pass  # re-place pod-by-pod below
                 for p in pods:
                     try:
-                        add(p, req)
+                        target.add(p, req)
                     except IncompatibleError:
                         divergent.append(p)
         for p in divergent:
@@ -666,7 +741,7 @@ class DeviceScheduler:
             if err is not None:
                 failed.append((p, err))
         # drop empty claims (all groups failed), releasing their placeholder
-        # hostnames from the shared per-round topology
+        # hostnames from the shared per-round topology (see below)
         kept = []
         for c in claims:
             if c.pods:
@@ -674,6 +749,90 @@ class DeviceScheduler:
             else:
                 c.destroy()
         return kept, prep.existing_sims, failed
+
+    def _decode_fresh_vectorized(
+        self,
+        prep: _Prepared,
+        si: int,
+        template,
+        groups: List[Tuple[int, int]],
+        pod_cursor: Dict[int, int],
+        topo: Topology,
+        claims: List[InFlightNodeClaim],
+        divergent: List[Pod],
+    ) -> bool:
+        """Materialize a fresh slot's claim straight from the prep tensors.
+
+        The per-group viability mask — template ITs ∧ class requirement
+        compat (class_it, the same kernels the FFD scan used, property-tested
+        against the host algebra) ∧ float64 resource fit ∧ offering
+        availability under the joined zone/capacity-type masks — replaces
+        the O(groups × instance-types) Python filter. Requirements and
+        request dicts are still folded through the host algebra once per
+        class, so the returned claim is indistinguishable from the
+        add()-built one. Returns False to fall back wholesale (min-values or
+        host ports in play), leaving pod cursors untouched."""
+        if template.requirements.has_min_values():
+            return False
+        for ci, _k in groups:
+            cls = prep.classes[ci]
+            if cls.pods and (
+                cls.pods[0].host_ports or cls.requirements.has_min_values()
+            ):
+                return False
+
+        Z, CT = prep.n_zones, prep.n_cts
+        cm = prep.class_masks
+        T = len(prep.catalog)
+        mask = prep.tmpl_it_np[si].copy()
+        req_vec = prep.tmpl_overhead64[si].copy()
+        zmask = prep.tmpl_mask_np[si, prep.zone_kid, :Z].copy()
+        ctmask = prep.tmpl_mask_np[si, prep.ct_kid, :CT].copy()
+        requests = dict(self.daemon_overhead[si])
+        pods_all: List[Pod] = []
+        committed: List[int] = []
+
+        for ci, k in groups:
+            cls = prep.classes[ci]
+            start = pod_cursor[ci]
+            pods = cls.pods[start : start + k]
+            pod_cursor[ci] = start + k
+            if not pods:
+                continue
+            trial_req = req_vec + k * prep.class_requests64[ci]
+            trial_z = zmask & cm.mask[ci, prep.zone_kid, :Z]
+            trial_ct = ctmask & cm.mask[ci, prep.ct_kid, :CT]
+            fits = (trial_req[None, :] <= prep.it_alloc64).all(axis=1)
+            off_ok = (
+                prep.off_avail_np
+                & trial_z[None, :, None]
+                & trial_ct[None, None, :]
+            ).any(axis=(1, 2))
+            trial = mask & prep.class_it[ci] & fits & off_ok
+            if not trial.any():
+                divergent.extend(pods)
+                continue
+            mask, req_vec, zmask, ctmask = trial, trial_req, trial_z, trial_ct
+            requests = resutil.merge(
+                requests,
+                resutil.scale(resutil.requests_for_pods(pods[0]), k),
+            )
+            pods_all.extend(pods)
+            committed.append(ci)
+
+        if pods_all:
+            options = [prep.catalog[i] for i in np.nonzero(mask[:T])[0]]
+            claim = InFlightNodeClaim(
+                template, topo, self.daemon_overhead[si], options
+            )
+            for ci in committed:
+                claim.requirements.add(
+                    *(r.copy() for r in prep.classes[ci].requirements.values())
+                )
+            claim.pods = pods_all
+            claim.requests = requests
+            claims.append(claim)
+        return True
 
     def _host_fallback_add(
         self,

@@ -42,6 +42,10 @@ def requests_for_pods(*pods: Pod) -> dict:
     return out
 
 
+def scale(rl: dict, k: float) -> dict:
+    return {name: qty * k for name, qty in rl.items()}
+
+
 def fits(candidate: dict, total: dict) -> bool:
     """candidate <= total pointwise; any negative total never fits
     (resources.go:217-231)."""
