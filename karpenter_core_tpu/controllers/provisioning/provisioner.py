@@ -62,10 +62,19 @@ class Provisioner:
         return out
 
     def ready_nodepools(self) -> List[NodePool]:
+        """Non-deleting pools whose validation/nodeclass conditions aren't
+        False, weight-ordered (provisioner.go:215-234)."""
+        from karpenter_core_tpu.api.nodepool import (
+            COND_NODEPOOL_NODECLASS_READY,
+            COND_NODEPOOL_VALIDATION_SUCCEEDED,
+        )
+
         pools = [
             np
             for np in self.kube.list_nodepools()
             if np.metadata.deletion_timestamp is None
+            and not np.conditions.is_false(COND_NODEPOOL_VALIDATION_SUCCEEDED)
+            and not np.conditions.is_false(COND_NODEPOOL_NODECLASS_READY)
         ]
         pools.sort(key=lambda n: (-n.spec.weight, n.name))
         return pools

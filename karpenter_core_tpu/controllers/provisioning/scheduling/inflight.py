@@ -174,8 +174,8 @@ class InFlightNodeClaim:
             raise IncompatibleError(f"incompatible requirements, {errs}")
         claim_requirements.add(*pod_requirements.values())
 
-        requests = resutil.merge(
-            self.requests, resutil.scale(per_pod_requests, len(pods))
+        requests = resutil.merge_repeated(
+            self.requests, per_pod_requests, len(pods)
         )
         if not resutil.fits(requests, self._max_alloc()):
             raise IncompatibleError("no instance type has enough resources")
@@ -296,8 +296,8 @@ class ExistingNodeSim:
         if errs:
             raise IncompatibleError("; ".join(errs))
 
-        requests = resutil.merge(
-            self.requests, resutil.scale(per_pod_requests, len(pods))
+        requests = resutil.merge_repeated(
+            self.requests, per_pod_requests, len(pods)
         )
         if not resutil.fits(requests, self.cached_available):
             raise IncompatibleError("exceeds node resources")

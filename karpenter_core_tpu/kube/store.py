@@ -69,6 +69,7 @@ class KubeStore:
 
         self.clock = clock or Clock()
         self._objects: Dict[str, Dict[str, object]] = {k: {} for k in _KINDS.values()}
+        self._nodes_by_pid: Dict[str, Node] = {}
         self._rv = itertools.count(1)
         self._watchers: List[Callable[[str, str, object], None]] = []
         self.mutations = 0  # cheap idle detection for reconcile loops
@@ -80,6 +81,11 @@ class KubeStore:
         self._watchers.append(fn)
 
     def _notify(self, event: str, kind: str, obj) -> None:
+        if kind == "Node" and getattr(obj, "provider_id", ""):
+            if event == DELETED:
+                self._nodes_by_pid.pop(obj.provider_id, None)
+            else:
+                self._nodes_by_pid[obj.provider_id] = obj
         self.mutations += 1
         for fn in self._watchers:
             fn(event, kind, obj)
@@ -162,10 +168,7 @@ class KubeStore:
         return list(self._objects["DaemonSet"].values())
 
     def get_node_by_provider_id(self, provider_id: str) -> Optional[Node]:
-        for node in self._objects["Node"].values():
-            if node.provider_id == provider_id:
-                return node
-        return None
+        return self._nodes_by_pid.get(provider_id)
 
     # -- pod verbs --------------------------------------------------------
 

@@ -1,0 +1,9 @@
+from karpenter_core_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    REGISTRY,
+)
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "REGISTRY"]

@@ -269,6 +269,7 @@ class DeviceScheduler:
         # semantics); the device FFD batches the topology-free mass
         simple = [p for p in pods if not has_topology_constraints(p)]
         constrained = [p for p in pods if has_topology_constraints(p)]
+        self._final_filter_cache: Dict[tuple, list] = {}
 
         try:
             prep = self._prepare(simple, max_slots, topo)
@@ -503,7 +504,8 @@ class DeviceScheduler:
                 r = class_requests[ci]
                 with np.errstate(divide="ignore", invalid="ignore"):
                     per_dim = np.where(r[None, :] > 0, head / np.where(r > 0, r, 1.0), np.inf)
-                k_it = np.floor(per_dim.min(axis=1))
+                # same conservative margin as the device kernel (ffd.K_MARGIN)
+                k_it = np.floor(per_dim.min(axis=1) - 1e-4)
                 k_it = np.where(viable & off_ok, k_it, -1)
                 if k_it.max() >= 1:
                     new_template[ci] = si
@@ -791,6 +793,7 @@ class DeviceScheduler:
         requests = dict(self.daemon_overhead[si])
         pods_all: List[Pod] = []
         committed: List[int] = []
+        counts: List[int] = []
 
         for ci, k in groups:
             cls = prep.classes[ci]
@@ -799,7 +802,10 @@ class DeviceScheduler:
             pod_cursor[ci] = start + k
             if not pods:
                 continue
-            trial_req = req_vec + k * prep.class_requests64[ci]
+            # repeated addition, matching the host merge-per-pod rounding
+            trial_req = req_vec.copy()
+            for _ in range(k):
+                trial_req += prep.class_requests64[ci]
             trial_z = zmask & cm.mask[ci, prep.zone_kid, :Z]
             trial_ct = ctmask & cm.mask[ci, prep.ct_kid, :CT]
             fits = (trial_req[None, :] <= prep.it_alloc64).all(axis=1)
@@ -813,12 +819,12 @@ class DeviceScheduler:
                 divergent.extend(pods)
                 continue
             mask, req_vec, zmask, ctmask = trial, trial_req, trial_z, trial_ct
-            requests = resutil.merge(
-                requests,
-                resutil.scale(resutil.requests_for_pods(pods[0]), k),
+            requests = resutil.merge_repeated(
+                requests, resutil.requests_for_pods(pods[0]), k
             )
             pods_all.extend(pods)
             committed.append(ci)
+            counts.append(k)
 
         if pods_all:
             options = [prep.catalog[i] for i in np.nonzero(mask[:T])[0]]
@@ -829,6 +835,24 @@ class DeviceScheduler:
                 claim.requirements.add(
                     *(r.copy() for r in prep.classes[ci].requirements.values())
                 )
+            # the per-group mask narrows pairwise (class_it per class); one
+            # final host filter against the JOINED requirements makes the
+            # option list exactly what sequential add() would leave (classes
+            # can be pairwise-IT-compatible yet jointly narrower). Identical
+            # fill shapes share the result — hundreds of slots repeat a
+            # handful of compositions.
+            shape = (si, tuple(zip(committed, counts)))
+            remaining = self._final_filter_cache.get(shape)
+            if remaining is None:
+                remaining = filter_instance_types(
+                    options, claim.requirements, requests
+                ).remaining
+                self._final_filter_cache[shape] = remaining
+            if not remaining:
+                claim.destroy()
+                divergent.extend(pods_all)
+                return True
+            claim.instance_type_options = list(remaining)
             claim.pods = pods_all
             claim.requests = requests
             claims.append(claim)

@@ -25,13 +25,26 @@ from karpenter_core_tpu.cloudprovider.kwok import KwokCloudProvider
 from karpenter_core_tpu.controllers.disruption.controller import (
     DisruptionController,
 )
+from karpenter_core_tpu.controllers.node.health import NodeHealth
 from karpenter_core_tpu.controllers.node.termination import NodeTermination
 from karpenter_core_tpu.controllers.nodeclaim.disruption import (
     NodeClaimDisruption,
     PodEvents,
 )
+from karpenter_core_tpu.controllers.nodeclaim.gc import (
+    Consistency,
+    Expiration,
+    GarbageCollection,
+)
 from karpenter_core_tpu.controllers.nodeclaim.lifecycle import NodeClaimLifecycle
+from karpenter_core_tpu.controllers.nodepool.controllers import (
+    Counter,
+    Hash,
+    Readiness,
+    Validation,
+)
 from karpenter_core_tpu.controllers.provisioning.provisioner import Provisioner
+from karpenter_core_tpu.events import Recorder
 from karpenter_core_tpu.kube.store import KubeStore
 from karpenter_core_tpu.state.cluster import Cluster
 from karpenter_core_tpu.utils import pod as podutil
@@ -97,17 +110,45 @@ class Operator:
             self.clock,
             feature_gates=self.options.feature_gates,
         )
+        self.recorder = Recorder(self.clock)
+        self.expiration = Expiration(self.kube, self.clock)
+        self.garbage_collection = GarbageCollection(
+            self.kube, self.cloud_provider, self.clock
+        )
+        self.consistency = Consistency(self.kube, self.recorder, self.clock)
+        self.nodepool_counter = Counter(self.kube, self.cluster)
+        self.nodepool_hash = Hash(self.kube)
+        self.nodepool_readiness = Readiness(
+            self.kube, self.cloud_provider, self.clock
+        )
+        self.nodepool_validation = Validation(self.kube, self.clock)
+        self.node_health = NodeHealth(
+            self.kube,
+            self.cluster,
+            self.cloud_provider,
+            self.clock,
+            enabled=self.options.feature_gates.get("NodeRepair", False),
+        )
         # claim/node name -> pod keys awaiting bind
         self.nominations: Dict[str, List[str]] = {}
 
     # -- one pass ----------------------------------------------------------
 
     def reconcile_once(self, disrupt: bool = True) -> None:
+        for pool in list(self.kube.list_nodepools()):
+            self.nodepool_hash.reconcile(pool)
+            self.nodepool_validation.reconcile(pool)
+            self.nodepool_readiness.reconcile(pool)
+            self.nodepool_counter.reconcile(pool)
         for claim in list(self.kube.list_nodeclaims()):
             self.lifecycle.reconcile(claim)
             self.nodeclaim_disruption.reconcile(claim)
+            self.expiration.reconcile(claim)
+            self.consistency.reconcile(claim)
+        self.garbage_collection.reconcile()
         for node in list(self.kube.list_nodes()):
             self.termination.reconcile(node)
+            self.node_health.reconcile(node)
         self._bind_nominated()
         if any(podutil.is_provisionable(p) for p in self.kube.list_pods()):
             self._provision()

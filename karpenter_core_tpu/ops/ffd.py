@@ -142,6 +142,14 @@ def _offering_ok(statics: FFDStatics, joined_valmask):
     return joint > 0
 
 
+# Conservative floor margin: float32 division overestimates exact-boundary
+# fits (head = 112.0000076 where float64 says 111.9999...), and every such
+# overestimate costs a host-fallback pod at decode. Shaving the margin
+# under-places at most one pod per slot at exact boundaries; the leftover
+# opens a fresh slot on device instead.
+K_MARGIN = 1e-4
+
+
 def _k_max(state: SlotState, c: ClassStep, statics: FFDStatics, viable_it):
     """Max pods of the class each slot can absorb. [N]"""
     r = c.requests  # [R]
@@ -149,13 +157,13 @@ def _k_max(state: SlotState, c: ClassStep, statics: FFDStatics, viable_it):
     # new slots: per viable instance type
     head = (statics.it_alloc[None, :, :] - state.requests[:, None, :]) / safe_r
     head = jnp.where(r[None, None, :] > 0, head, BIG)
-    k_it = jnp.floor(jnp.min(head, axis=-1))  # [N, T]
+    k_it = jnp.floor(jnp.min(head, axis=-1) - K_MARGIN)  # [N, T]
     k_it = jnp.where(viable_it, k_it, -1.0)
     k_new = jnp.max(k_it, axis=-1)  # [N]
     # existing slots: fixed available capacity
     head_e = (state.capacity - state.requests) / safe_r
     head_e = jnp.where(r[None, :] > 0, head_e, BIG)
-    k_exist = jnp.floor(jnp.min(head_e, axis=-1))  # [N]
+    k_exist = jnp.floor(jnp.min(head_e, axis=-1) - K_MARGIN)  # [N]
     k = jnp.where(state.kind == 1, k_exist, k_new)
     return jnp.clip(k, 0.0, 2**30).astype(jnp.int32)
 

@@ -51,22 +51,6 @@ class PodClass:
         return len(self.pods)
 
 
-def _requirements_signature(reqs: Requirements) -> tuple:
-    return tuple(
-        sorted(
-            (
-                key,
-                r.complement,
-                tuple(sorted(r.values)),
-                r.greater_than,
-                r.less_than,
-                r.min_values,
-            )
-            for key, r in reqs.items()
-        )
-    )
-
-
 def _spec_signature(pod: Pod) -> tuple:
     """Raw-spec equivalence key. Strictly finer than (or equal to) the
     requirement-level signature — two pods with identical selector/affinity/

@@ -42,8 +42,16 @@ def requests_for_pods(*pods: Pod) -> dict:
     return out
 
 
-def scale(rl: dict, k: float) -> dict:
-    return {name: qty * k for name, qty in rl.items()}
+def merge_repeated(dest: dict, src: dict, k: int) -> dict:
+    """dest folded with src k times by repeated addition, NOT dest + k*src:
+    group-add paths must land on the same float64 sums the sequential
+    merge-per-pod path produces, or exact-boundary fits flake between the
+    two."""
+    out = dict(dest)
+    for _ in range(int(k)):
+        for name, qty in src.items():
+            out[name] = out.get(name, 0.0) + qty
+    return out
 
 
 def fits(candidate: dict, total: dict) -> bool:

@@ -1,0 +1,191 @@
+"""Periphery controllers: expiration, GC, consistency, nodepool
+counter/hash/readiness/validation, node health, events, metrics
+(reference: SURVEY.md §2.8-2.9 inventory)."""
+import pytest
+
+from tests.helpers import GIB, make_nodepool, make_pod
+from tests.test_e2e import new_operator, replicated
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.api.duration import NillableDuration
+from karpenter_core_tpu.api.nodeclaim import COND_CONSISTENT_STATE_FOUND, NodeClaim
+from karpenter_core_tpu.api.nodepool import (
+    COND_NODEPOOL_VALIDATION_SUCCEEDED,
+    NodePool,
+)
+from karpenter_core_tpu.api.objects import (
+    Node,
+    NodeSelectorRequirement,
+    Pod,
+    Taint,
+)
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_core_tpu.cloudprovider.types import RepairPolicy
+from karpenter_core_tpu.events import Event, Recorder
+from karpenter_core_tpu.kube.store import KubeStore
+from karpenter_core_tpu.metrics import Registry
+from karpenter_core_tpu.operator import Operator, Options
+from karpenter_core_tpu.utils.clock import FakeClock
+
+
+class TestExpiration:
+    def test_expired_claim_replaced(self):
+        op = new_operator()
+        pool = make_nodepool()
+        pool.spec.template.expire_after = NillableDuration(3600.0)
+        op.kube.create(pool)
+        op.kube.create(replicated(make_pod(cpu=1.0, name="p0")))
+        op.run_until_idle(disrupt=False)
+        (claim,) = op.kube.list_nodeclaims()
+        op.clock.step(3601.0)
+        op.run_until_idle(disrupt=False)
+        claims = op.kube.list_nodeclaims()
+        assert all(c.name != claim.name for c in claims)
+        # pod rescheduled onto the replacement
+        assert op.kube.get(Pod, "p0").node_name
+
+    def test_never_expires_by_default(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=1.0, name="p0")))
+        op.run_until_idle(disrupt=False)
+        op.clock.step(365 * 24 * 3600.0)
+        op.run_until_idle(disrupt=False)
+        assert len(op.kube.list_nodeclaims()) == 1
+
+
+class TestGarbageCollection:
+    def test_claim_with_vanished_instance_removed(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=1.0, name="p0")))
+        op.run_until_idle(disrupt=False)
+        (claim,) = op.kube.list_nodeclaims()
+        # instance vanishes behind karpenter's back
+        node = op.kube.get_node_by_provider_id(claim.status.provider_id)
+        node.metadata.finalizers = []
+        op.kube.delete(node)
+        op.clock.step(121.0)  # next 2-minute GC sweep
+        op.run_until_idle(disrupt=False)
+        assert all(
+            c.name != claim.name for c in op.kube.list_nodeclaims()
+        )
+
+
+class TestConsistency:
+    def test_shrunk_capacity_flagged(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=1.0, name="p0")))
+        op.run_until_idle(disrupt=False)
+        (claim,) = op.kube.list_nodeclaims()
+        node = op.kube.get(Node, claim.status.node_name)
+        node.status.capacity["cpu"] = node.status.capacity["cpu"] / 2
+        op.reconcile_once(disrupt=False)
+        assert claim.conditions.is_false(COND_CONSISTENT_STATE_FOUND)
+        assert op.recorder.with_reason("FailedConsistencyCheck")
+
+
+class TestNodePoolControllers:
+    def test_counter_aggregates_capacity(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=1.0, name="p0")))
+        op.run_until_idle(disrupt=False)
+        pool = op.kube.list_nodepools()[0]
+        assert pool.status.resources.get("nodes") == 1.0
+        assert pool.status.resources.get("cpu", 0) > 0
+
+    def test_hash_annotation_maintained(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.reconcile_once(disrupt=False)
+        pool = op.kube.list_nodepools()[0]
+        assert (
+            pool.metadata.annotations[L.NODEPOOL_HASH_ANNOTATION_KEY]
+            == pool.static_hash()
+        )
+
+    def test_invalid_pool_not_provisioned_from(self):
+        op = new_operator()
+        pool = make_nodepool(
+            requirements=[NodeSelectorRequirement("team", "In", ())]  # invalid
+        )
+        op.kube.create(pool)
+        op.kube.create(make_pod(cpu=1.0, name="p0"))
+        op.run_until_idle(disrupt=False)
+        assert pool.conditions.is_false(COND_NODEPOOL_VALIDATION_SUCCEEDED)
+        assert not op.kube.list_nodes()
+
+
+class TestNodeHealth:
+    def test_unhealthy_node_repaired_after_toleration(self):
+        op = new_operator()
+        op.options.feature_gates["NodeRepair"] = True
+        op.node_health.enabled = True
+        op.cloud_provider.repair_policies = lambda: [
+            RepairPolicy(
+                condition_type="Ready",
+                condition_status="False",
+                toleration_duration=600.0,
+            )
+        ]
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=1.0, name="p0")))
+        op.run_until_idle(disrupt=False)
+        (node,) = op.kube.list_nodes()
+        node.status.conditions = [("Ready", "False")]
+        op.reconcile_once(disrupt=False)  # first observation starts the window
+        assert op.kube.get(Node, node.name) is not None
+        op.clock.step(601.0)
+        op.run_until_idle(disrupt=False)
+        # node + claim torn down; replacement comes up for the pod
+        assert node.name not in {n.name for n in op.kube.list_nodes()}
+
+    def test_circuit_breaker_blocks_mass_repair(self):
+        op = new_operator()
+        op.node_health.enabled = True
+        op.cloud_provider.repair_policies = lambda: [
+            RepairPolicy(
+                condition_type="Ready",
+                condition_status="False",
+                toleration_duration=0.0,
+            )
+        ]
+        op.kube.create(make_nodepool())
+        for i in range(4):
+            op.kube.create(replicated(make_pod(cpu=7.0, name=f"p{i}")))
+        op.run_until_idle(disrupt=False)
+        nodes = op.kube.list_nodes()
+        assert len(nodes) >= 2
+        # everything goes unhealthy at once: systemic, don't repair
+        for n in nodes:
+            n.status.conditions = [("Ready", "False")]
+        op.clock.step(1.0)
+        op.reconcile_once(disrupt=False)
+        op.reconcile_once(disrupt=False)
+        assert len(op.kube.list_nodes()) == len(nodes)
+
+
+class TestEventsAndMetrics:
+    def test_recorder_dedupes_within_ttl(self):
+        clock = FakeClock()
+        r = Recorder(clock)
+        e = dict(involved_object="Node/n1", type="Normal", reason="X", message="m")
+        r.publish(Event(**e))
+        r.publish(Event(**e))
+        assert len(r.events) == 1
+        clock.step(121.0)
+        r.publish(Event(**e))
+        assert len(r.events) == 2
+
+    def test_metrics_registry_renders(self):
+        reg = Registry()
+        c = reg.counter("pods_scheduled_total", "total pods scheduled")
+        c.inc({"nodepool": "default"}, by=3)
+        h = reg.histogram("scheduling_duration_seconds")
+        h.observe(0.3)
+        text = reg.render()
+        assert 'karpenter_pods_scheduled_total{nodepool="default"} 3' in text
+        assert "karpenter_scheduling_duration_seconds_bucket" in text
+        assert h.percentile(0.5) == 0.5
