@@ -286,9 +286,11 @@ class SingleNodeConsolidation(_ConsolidationBase):
 
 
 class MultiNodeConsolidation(_ConsolidationBase):
-    """Binary search for the largest consolidatable prefix
-    (multinodeconsolidation.go:46-162). The device-batched variant
-    evaluates all prefixes in one call (models/consolidation milestone)."""
+    """Largest consolidatable prefix. With the tpu solver the whole prefix
+    ladder is evaluated in ONE vmapped device call
+    (models/consolidation.py); the reference's binary search of full
+    scheduling simulations (multinodeconsolidation.go:110-162) is the
+    host fallback."""
 
     consolidation_type = "multi"
 
@@ -300,25 +302,70 @@ class MultiNodeConsolidation(_ConsolidationBase):
         )[:MULTI_NODE_CONSOLIDATION_CANDIDATE_CAP]
         if len(candidates) < 2:
             return Command()
-        lo, hi = 1, len(candidates)
         best = Command()
-        while lo <= hi:
-            mid = (lo + hi) // 2
-            prefix = candidates[:mid]
-            cmd, _ = self.compute_consolidation(prefix)
-            ok = cmd.decision == "delete"
-            if cmd.decision == "replace":
-                self._filter_out_same_type(cmd.replacements[0], prefix)
-                ok = bool(cmd.replacements[0].instance_type_options)
-            if ok:
-                best = cmd
-                lo = mid + 1
-            else:
-                hi = mid - 1
+        frontier_sizes = None
+        if self.ctx.provisioner.solver == "tpu":
+            frontier_sizes = self._device_frontier(candidates)
+        if frontier_sizes:
+            # host-exact validation (price filters, spot rules) at the
+            # device frontier, stepping down on price-infeasibility
+            for size in frontier_sizes:
+                ok, cmd = self._host_validate(candidates, size)
+                if ok:
+                    best = cmd
+                    break
+        if best.decision == "no-op" and frontier_sizes != []:
+            # no frontier available, or the tried frontier sizes all failed
+            # host validation (price filters may pass at smaller untried
+            # sizes): reference binary search
+            # (multinodeconsolidation.go:110-162). frontier == [] means the
+            # device proved NO prefix reschedules everything — price filters
+            # only shrink feasibility, so skip the search entirely.
+            lo, hi = 1, len(candidates)
+            while lo <= hi:
+                mid = (lo + hi) // 2
+                ok, cmd = self._host_validate(candidates, mid)
+                if ok:
+                    best = cmd
+                    lo = mid + 1
+                else:
+                    hi = mid - 1
         if best.decision != "no-op":
             for c in best.candidates:
                 budgets.consume(c.nodepool.name, self.reason)
         return best
+
+    def _host_validate(
+        self, candidates: List[Candidate], size: int
+    ) -> Tuple[bool, Command]:
+        prefix = candidates[:size]
+        cmd, _ = self.compute_consolidation(prefix)
+        ok = cmd.decision == "delete"
+        if cmd.decision == "replace":
+            self._filter_out_same_type(cmd.replacements[0], prefix)
+            ok = bool(cmd.replacements[0].instance_type_options)
+        return ok, cmd
+
+    def _device_frontier(self, candidates: List[Candidate]):
+        """Prefix sizes to try, largest-first, from the one-call device
+        evaluation; None -> fall back to binary search."""
+        from karpenter_core_tpu.models.consolidation import (
+            schedulability_frontier,
+        )
+
+        frontier = schedulability_frontier(
+            self.ctx.provisioner, self.ctx.cluster, candidates
+        )
+        if frontier is None:
+            return None
+        # viable prefixes: everything reschedules into at most one new node
+        sizes = [
+            p + 1
+            for p, (ok, n_new) in enumerate(frontier)
+            if ok and n_new <= 1
+        ]
+        sizes.sort(reverse=True)
+        return sizes[:4]  # frontier + a few step-downs for price filtering
 
     @staticmethod
     def _filter_out_same_type(replacement, consolidate: List[Candidate]) -> None:

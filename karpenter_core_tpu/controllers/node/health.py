@@ -35,6 +35,11 @@ class NodeHealth:
         policies = self.cloud_provider.repair_policies()
         if not policies:
             return
+        # prune windows for nodes other controllers deleted, so a later
+        # name-reuse never inherits an expired toleration window
+        live = {n.name for n in self.kube.list_nodes()}
+        for key in [k for k in self._first_seen if k[0] not in live]:
+            del self._first_seen[key]
         hit = self._unhealthy_policy(node, policies)
         if hit is None:
             # healthy: clear any tracked windows for this node

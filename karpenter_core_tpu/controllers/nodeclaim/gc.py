@@ -55,18 +55,20 @@ class GarbageCollection:
         claimed_ids = {
             c.status.provider_id for c in claims if c.status.provider_id
         }
+        cloud_claims = self.cloud_provider.list()
+        live_ids = {
+            cc.status.provider_id for cc in cloud_claims if cc.status.provider_id
+        }
         # direction 1: claims pointing at vanished instances
         for claim in claims:
             if not claim.is_launched() or not claim.status.provider_id:
                 continue
             if claim.metadata.deletion_timestamp is not None:
                 continue
-            try:
-                self.cloud_provider.get(claim.status.provider_id)
-            except NodeClaimNotFoundError:
+            if claim.status.provider_id not in live_ids:
                 self.kube.delete(claim)
         # direction 2: cloud instances with no claim (leaked)
-        for cloud_claim in self.cloud_provider.list():
+        for cloud_claim in cloud_claims:
             pid = cloud_claim.status.provider_id
             if pid and pid not in claimed_ids:
                 try:

@@ -1,0 +1,149 @@
+"""Batched multi-node consolidation prefix evaluation — hot loop #2.
+
+The reference binary-searches the largest candidate prefix whose removal
+still schedules everything (multinodeconsolidation.go:110-162): ~log2(100)
+full Scheduler.Solve() simulations, each over the whole cluster. Here every
+prefix is evaluated in ONE device call: the FFD scan is vmapped over a
+prefix axis where
+
+* candidate slots are masked out per prefix (kind=0 — the scan never
+  places onto them), and
+* the removed candidates' reschedulable pods join the pod classes with
+  per-prefix counts,
+
+so prefix p's scan sees exactly the cluster SimulateScheduling would build
+for candidates[:p]. The returned schedulability frontier (all pods placed,
+new-node count) is the quantity the binary search was probing; the exact
+host pipeline (price filters, spot rules) then runs once at the frontier.
+
+Pods with topology constraints take the host path (callers fall back to
+binary search when any candidate carries them — round-1 scope).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+    Topology,
+    has_topology_constraints,
+)
+from karpenter_core_tpu.models.provisioner import DeviceScheduler, _SlotOverflow
+from karpenter_core_tpu.ops.ffd import ClassStep, SlotState, ffd_step
+from karpenter_core_tpu.solver.snapshot import _spec_signature
+
+
+def _ffd_scan(state, classes, statics):
+    final, (takes, unplaced) = jax.lax.scan(
+        lambda st, c: ffd_step(st, c, statics), state, classes
+    )
+    return final.next_free, jnp.sum(unplaced), final.overflow
+
+
+@jax.jit
+def _prefix_scan(state: SlotState, classes: ClassStep, statics, kind_batch, count_batch):
+    """vmap the FFD scan over the prefix axis: only the slot kinds and the
+    class counts vary per prefix; masks/capacities/statics are shared."""
+
+    def one(kind, counts):
+        st = state._replace(kind=kind)
+        cl = classes._replace(count=counts)
+        return _ffd_scan(st, cl, statics)
+
+    return jax.vmap(one)(kind_batch, count_batch)
+
+
+def schedulability_frontier(
+    provisioner,
+    cluster,
+    candidates: List,
+    max_slots: int = 1024,
+) -> Optional[List[Tuple[bool, int]]]:
+    """Per-prefix (all pods scheduled, new nodes needed) for prefixes
+    1..len(candidates). None when the batched path can't represent the
+    problem (topology-coupled pods) — callers binary-search instead."""
+    base_pods = provisioner.pending_pods() + provisioner.deleting_node_pods()
+    if any(has_topology_constraints(p) for p in base_pods):
+        return None
+    for c in candidates:
+        if any(has_topology_constraints(p) for p in c.reschedulable_pods):
+            return None
+
+    excluded = {c.name for c in candidates}
+    keep_nodes = [n for n in cluster.sim_nodes() if n.name not in excluded]
+    cand_nodes = []
+    for c in candidates:
+        for n in cluster.sim_nodes():
+            if n.name == c.name:
+                cand_nodes.append(n)
+                break
+    if len(cand_nodes) != len(candidates):
+        return None
+
+    nodepools = provisioner.ready_nodepools()
+    instance_types = {
+        np_.name: provisioner.cloud_provider.get_instance_types(np_)
+        for np_ in nodepools
+    }
+    all_pods = list(base_pods)
+    for c in candidates:
+        all_pods.extend(c.reschedulable_pods)
+
+    # candidate slots first so prefix p masks slots [0, p)
+    sched = DeviceScheduler(
+        nodepools,
+        instance_types,
+        existing_nodes=cand_nodes + keep_nodes,
+        daemonset_pods=provisioner.daemonset_pods(),
+        max_slots=max_slots,
+    )
+    # DeviceScheduler sorts existing nodes; force candidate-first order back
+    sched.existing_nodes = cand_nodes + keep_nodes
+    try:
+        prep = sched._prepare(all_pods, max_slots, Topology())
+    except _SlotOverflow:
+        return None  # cluster wider than the slot array: binary search
+
+    P = len(candidates)
+    C = len(prep.classes)
+    N = prep.n_slots
+    E = len(sched.existing_nodes)
+
+    base_kind = np.asarray(prep.init_state.kind)
+    kind_batch = np.tile(base_kind, (P, 1))
+    for p in range(P):
+        kind_batch[p, : p + 1] = 0  # remove candidates [0, p]
+
+    # per-prefix class counts: base pods always count; candidate i's pods
+    # count in prefixes p >= i
+    sig_to_ci = {}
+    for ci, cls in enumerate(prep.classes):
+        sig_to_ci[_spec_signature(cls.pods[0])] = ci
+    base_counts = np.zeros((C,), dtype=np.int32)
+    for pod in base_pods:
+        base_counts[sig_to_ci[_spec_signature(pod)]] += 1
+    count_batch = np.tile(base_counts, (P, 1))
+    for i, c in enumerate(candidates):
+        for pod in c.reschedulable_pods:
+            ci = sig_to_ci[_spec_signature(pod)]
+            count_batch[i:, ci] += 1
+
+    next_free, unplaced, overflow = _prefix_scan(
+        prep.init_state,
+        sched._class_steps(prep),
+        prep.statics,
+        jnp.asarray(kind_batch),
+        jnp.asarray(count_batch),
+    )
+    next_free = np.asarray(next_free)
+    unplaced = np.asarray(unplaced)
+    overflow = np.asarray(overflow)
+    # an overflowed prefix silently counted spilled pods as placed — it is
+    # NOT schedulable evidence
+    return [
+        (int(unplaced[p]) == 0 and not bool(overflow[p]), int(next_free[p]) - E)
+        for p in range(P)
+    ]

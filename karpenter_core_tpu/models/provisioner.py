@@ -65,6 +65,7 @@ from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
 from karpenter_core_tpu.ops import masks as mops
 from karpenter_core_tpu.ops.ffd import (
     BIG,
+    K_MARGIN,
     ClassStep,
     FFDStatics,
     SlotState,
@@ -504,8 +505,8 @@ class DeviceScheduler:
                 r = class_requests[ci]
                 with np.errstate(divide="ignore", invalid="ignore"):
                     per_dim = np.where(r[None, :] > 0, head / np.where(r > 0, r, 1.0), np.inf)
-                # same conservative margin as the device kernel (ffd.K_MARGIN)
-                k_it = np.floor(per_dim.min(axis=1) - 1e-4)
+                # same conservative margin as the device kernel
+                k_it = np.floor(per_dim.min(axis=1) - K_MARGIN)
                 k_it = np.where(viable & off_ok, k_it, -1)
                 if k_it.max() >= 1:
                     new_template[ci] = si

@@ -1,0 +1,112 @@
+"""Device-batched multi-node consolidation: the vmapped prefix evaluation
+must agree with per-prefix host simulation (BASELINE config 4 shape)."""
+import pytest
+
+from tests.helpers import make_nodepool, make_pod
+from tests.test_disruption import new_operator, od_nodepool, replicated
+
+from karpenter_core_tpu.api.objects import Pod
+from karpenter_core_tpu.cloudprovider.kwok import KwokCloudProvider, build_catalog
+from karpenter_core_tpu.controllers.disruption.helpers import (
+    get_candidates,
+    simulate_scheduling,
+)
+from karpenter_core_tpu.kube.store import KubeStore
+from karpenter_core_tpu.models.consolidation import schedulability_frontier
+from karpenter_core_tpu.operator import Operator, Options
+from karpenter_core_tpu.utils.clock import FakeClock
+
+CATALOG = build_catalog(cpu_grid=[1, 2, 4, 8, 16], mem_factors=[2, 4])
+
+
+def underutilized_fleet(n_candidates: int, solver: str = "tpu"):
+    """Build a fleet of underutilized nodes: big pods provisioned then
+    swapped for small ones."""
+    clock = FakeClock()
+    kube = KubeStore(clock)
+    op = Operator(
+        kube=kube,
+        cloud_provider=KwokCloudProvider(kube, CATALOG),
+        clock=clock,
+        options=Options(solver=solver),
+    )
+    op.kube.create(od_nodepool())
+    for i in range(n_candidates):
+        op.kube.create(replicated(make_pod(cpu=7.0, name=f"big{i}")))
+        op.kube.create(replicated(make_pod(cpu=7.0, name=f"big{i}b")))
+    op.run_until_idle(disrupt=False)
+    for i in range(n_candidates):
+        for name in (f"big{i}", f"big{i}b"):
+            p = op.kube.get(Pod, name)
+            p.metadata.owner_references = []
+            op.kube.delete(p)
+        op.kube.create(replicated(make_pod(cpu=0.2, name=f"small{i}")))
+    op.run_until_idle(disrupt=False)
+    return op
+
+
+class TestFrontierParity:
+    @pytest.mark.parametrize("n", [3, 6])
+    def test_frontier_matches_host_simulation(self, n):
+        op = underutilized_fleet(n)
+        candidates = get_candidates(
+            op.clock,
+            op.cluster,
+            op.kube,
+            op.cloud_provider,
+            lambda c: True,
+        )
+        candidates.sort(key=lambda c: c.disruption_cost)
+        assert len(candidates) >= 2
+        frontier = schedulability_frontier(
+            op.provisioner, op.cluster, candidates
+        )
+        assert frontier is not None
+        for p, (ok_device, n_new) in enumerate(frontier):
+            results = simulate_scheduling(
+                op.provisioner, op.cluster, candidates[: p + 1]
+            )
+            ok_host = results.all_pods_scheduled()
+            assert ok_device == ok_host, (p, results.pod_errors)
+            if ok_host:
+                assert n_new == results.node_count(), p
+
+    def test_topology_pods_fall_back(self):
+        op = underutilized_fleet(2)
+        # pin a spread pod onto the cluster: batched path must decline
+        op.kube.create(
+            replicated(make_pod(cpu=0.2, name="spready", spread_zone=True))
+        )
+        op.run_until_idle(disrupt=False)
+        candidates = get_candidates(
+            op.clock, op.cluster, op.kube, op.cloud_provider, lambda c: True
+        )
+        if candidates:
+            assert (
+                schedulability_frontier(op.provisioner, op.cluster, candidates)
+                is None
+            )
+
+
+class TestEndToEndBatched:
+    def test_tpu_solver_consolidates_fleet(self):
+        op = underutilized_fleet(6, solver="tpu")
+        cap_before = sum(
+            n.status.capacity.get("cpu", 0) for n in op.kube.list_nodes()
+        )
+        op.run_until_idle(max_iters=200)
+        assert all(p.node_name for p in op.kube.list_pods())
+        cap_after = sum(
+            n.status.capacity.get("cpu", 0) for n in op.kube.list_nodes()
+        )
+        assert cap_after < cap_before / 2, (cap_before, cap_after)
+
+    def test_matches_greedy_solver_outcome(self):
+        op_t = underutilized_fleet(4, solver="tpu")
+        op_g = underutilized_fleet(4, solver="greedy")
+        for op in (op_t, op_g):
+            op.run_until_idle(max_iters=200)
+        cap = lambda op: sum(
+            n.status.capacity.get("cpu", 0) for n in op.kube.list_nodes()
+        )
+        assert cap(op_t) == cap(op_g)
