@@ -1,0 +1,18 @@
+"""Device mesh + sharding of the solve across ICI.
+
+The solve's data-parallel axis is the slot axis (candidate nodes /
+in-flight claims): feasibility masks, capacity arithmetic, and the
+requirement-state merge are embarrassingly parallel across slots, while
+the first-fit prefix sum (an int32 cumsum — exact under any reduction
+order) and the class scan are handled by XLA collectives. Consolidation's
+prefix sweep adds a second, fully independent batch axis (the candidate
+prefix), sharded the same way.
+"""
+from karpenter_core_tpu.parallel.mesh import (
+    batch_sharding,
+    replicated,
+    slot_mesh,
+    slot_shardings,
+)
+
+__all__ = ["batch_sharding", "replicated", "slot_mesh", "slot_shardings"]

@@ -1,0 +1,42 @@
+"""Mesh construction and sharding specs for the FFD solve.
+
+One copy of the "leading axis == n_slots -> shard over 'slots', else
+replicate" rule, shared by the driver entry (__graft_entry__.py), the
+sharded-parity tests, and any multi-chip deployment of the solver.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def slot_mesh(n_devices: int, axis: str = "slots") -> Mesh:
+    """1-D mesh over the first n_devices JAX devices."""
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} devices, have {len(devices)} ({devices})"
+        )
+    return Mesh(np.array(devices[:n_devices]), (axis,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def slot_shardings(mesh: Mesh, state, n_slots: int, axis: str = "slots"):
+    """Shardings pytree for a SlotState: leaves leading with the slot axis
+    (dim 0 == n_slots) shard over the mesh; scalars/others replicate."""
+
+    def spec(leaf):
+        if hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] == n_slots:
+            return NamedSharding(mesh, P(axis, *([None] * (leaf.ndim - 1))))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(spec, state)
+
+
+def batch_sharding(mesh: Mesh, ndim: int, axis: str = "slots") -> NamedSharding:
+    """Shard a batch-leading array (e.g. the consolidation prefix axis)."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))

@@ -1,0 +1,54 @@
+"""Force a virtual multi-device CPU mesh before first JAX backend use.
+
+One copy of the box-specific bootstrap shared by tests/conftest.py and
+__graft_entry__.dryrun_multichip: this machine's axon sitecustomize imports
+jax and programmatically selects the axon TPU platform at interpreter
+start, so env vars alone are too late — the working override is
+``jax.config.update("jax_platforms", "cpu")`` after import but before the
+first backend use. XLA reads ``--xla_force_host_platform_device_count``
+at backend init, which has not happened yet at that point.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_virtual_cpu_mesh(n_devices: int) -> None:
+    """Guarantee >= ``n_devices`` JAX devices on the CPU platform.
+
+    Must run before any JAX backend use (jax.devices(), jit dispatch, ...);
+    asserts loudly if the backend was already initialized on another
+    platform rather than silently proceeding on it.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
+    if m:
+        count = max(int(m.group(1)), n_devices)
+        flags = flags.replace(m.group(0), f"{_COUNT_FLAG}={count}")
+    else:
+        flags = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # too late — the checks below report the actual state
+
+    if jax.default_backend() != "cpu":
+        raise RuntimeError(
+            f"could not force the CPU platform (backend is "
+            f"{jax.default_backend()!r}); force_virtual_cpu_mesh must run "
+            f"before any JAX backend use"
+        )
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} virtual CPU devices, have {jax.devices()} — "
+            f"the backend initialized before this call, so the device-count "
+            f"flag could not take effect; force_virtual_cpu_mesh({n_devices}) "
+            f"must run before any JAX backend use"
+        )

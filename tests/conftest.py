@@ -1,29 +1,21 @@
 """Test configuration: force an 8-device virtual CPU mesh.
 
 Real TPU hardware (one chip under axon) is reserved for bench.py; the test
-suite exercises the multi-chip sharding paths on a virtual CPU mesh the same
-way the driver's dryrun does.
-
-This box's axon sitecustomize imports jax and programmatically selects the
-axon platform at interpreter start, so env vars (JAX_PLATFORMS /
-JAX_PLATFORM_NAME) set here are too late — the working override is
-jax.config.update after import, before first backend use.
+suite exercises the multi-chip sharding paths on a virtual CPU mesh the
+same way the driver's dryrun does. The shared bootstrap (and the why) lives
+in karpenter_core_tpu/utils/jaxenv.py.
 """
-import os
+from karpenter_core_tpu.utils.jaxenv import force_virtual_cpu_mesh
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+force_virtual_cpu_mesh(8)
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-
 
 def pytest_configure(config):
-    assert jax.default_backend() == "cpu", (
-        f"tests must run on the virtual CPU mesh, got {jax.default_backend()}"
+    # force_virtual_cpu_mesh already raised if this doesn't hold; re-assert
+    # here so a future conftest edit that drops the forcing fails loudly
+    assert jax.default_backend() == "cpu" and len(jax.devices()) >= 8, (
+        f"tests must run on the >=8-device virtual CPU mesh, got "
+        f"{jax.default_backend()} with {jax.devices()}"
     )
-    assert len(jax.devices()) == 8, f"expected 8 CPU devices, got {jax.devices()}"

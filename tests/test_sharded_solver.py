@@ -1,0 +1,183 @@
+"""Multi-chip sharding parity tests on the 8-device virtual CPU mesh.
+
+VERDICT r1 gap: nothing in tests/ actually sharded. These tests run the two
+flagship device programs — the provisioning FFD solve (ops/ffd.py, the
+batched Scheduler.Solve of scheduler.go:208-266) and the consolidation
+prefix scan (models/consolidation.py, the batched binary search of
+multinodeconsolidation.go:110-162) — with real `NamedSharding`s over the
+conftest-forced 8-device CPU mesh at realistic size (>=1k slots, >=100 pod
+classes) and assert *bit-exact* equality with single-device execution.
+
+Exactness is a design property, not luck: the only cross-slot reduction in
+the solve is the int32 first-fit prefix sum; everything else is elementwise
+per slot, so resharding cannot reorder float accumulations.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tests.helpers import GIB, make_nodepool, make_pod
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.cloudprovider.kwok import bench_catalog, build_catalog
+from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import SimNode
+from karpenter_core_tpu.controllers.provisioning.scheduling.topology import Topology
+from karpenter_core_tpu.models.consolidation import _prefix_scan
+from karpenter_core_tpu.models.provisioner import DeviceScheduler
+from karpenter_core_tpu.ops.ffd import ffd_solve
+from karpenter_core_tpu.parallel import (
+    batch_sharding,
+    replicated,
+    slot_mesh,
+    slot_shardings,
+)
+
+MAX_SLOTS = 1024
+N_DEVICES = 8
+
+
+def _existing_nodes(n: int, cpu: float = 8.0):
+    return [
+        SimNode(
+            name=f"existing-{i}",
+            labels={
+                L.LABEL_ARCH: "amd64",
+                L.LABEL_OS: "linux",
+                L.LABEL_TOPOLOGY_ZONE: "zone-a",
+                L.NODEPOOL_LABEL_KEY: "default",
+                L.LABEL_INSTANCE_TYPE: "s-8x-amd64-linux",
+            },
+            taints=[],
+            available={"cpu": cpu, "memory": 16 * GIB, "pods": 200.0},
+            capacity={"cpu": cpu, "memory": 16 * GIB, "pods": 210.0},
+        )
+        for i in range(n)
+    ]
+
+
+def _problem(n_pods: int, n_types: int, n_existing: int = 0):
+    """>=100 pod equivalence classes (16 cpu shapes x 12 mem shapes)."""
+    catalog = (
+        bench_catalog(n_types) if n_types > 144 else build_catalog()[:n_types]
+    )
+    pods = [
+        make_pod(
+            cpu=0.1 * (1 + i % 16),
+            memory_gib=0.25 * (1 + (i // 16) % 12),
+            name=f"p{i}",
+        )
+        for i in range(n_pods)
+    ]
+    sched = DeviceScheduler(
+        [make_nodepool()],
+        {"default": catalog},
+        existing_nodes=_existing_nodes(n_existing),
+        max_slots=MAX_SLOTS,
+    )
+    prep = sched._prepare(pods, MAX_SLOTS, Topology())
+    assert len(prep.classes) >= 100, len(prep.classes)
+    return sched, prep
+
+
+def _assert_trees_equal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+class TestShardedFFDSolve:
+    def test_slot_sharded_solve_bit_exact(self):
+        sched, prep = _problem(n_pods=3000, n_types=160)
+        classes = sched._class_steps(prep)
+
+        ref_final, ref_takes, ref_unplaced = jax.jit(ffd_solve)(
+            prep.init_state, classes, prep.statics
+        )
+        jax.block_until_ready(ref_takes)
+        assert int(np.asarray(ref_unplaced).sum()) == 0
+
+        mesh = slot_mesh(N_DEVICES)
+        state_sh = slot_shardings(mesh, prep.init_state, MAX_SLOTS)
+        repl = replicated(mesh)
+        class_sh = jax.tree.map(lambda _: repl, classes)
+        static_sh = jax.tree.map(lambda _: repl, prep.statics)
+
+        state = jax.device_put(prep.init_state, state_sh)
+        cls = jax.device_put(classes, class_sh)
+        statics = jax.device_put(prep.statics, static_sh)
+
+        step = jax.jit(
+            ffd_solve,
+            in_shardings=(state_sh, class_sh, static_sh),
+            out_shardings=(state_sh, repl, repl),
+        )
+        final, takes, unplaced = step(state, cls, statics)
+        jax.block_until_ready(takes)
+
+        # output really was computed under the slot sharding
+        kind_sh = final.kind.sharding
+        assert kind_sh.is_equivalent_to(
+            NamedSharding(mesh, P("slots")), final.kind.ndim
+        )
+
+        _assert_trees_equal(final, ref_final)
+        np.testing.assert_array_equal(np.asarray(takes), np.asarray(ref_takes))
+        np.testing.assert_array_equal(
+            np.asarray(unplaced), np.asarray(ref_unplaced)
+        )
+
+
+class TestShardedPrefixScan:
+    def test_prefix_sharded_consolidation_bit_exact(self):
+        n_prefixes = 8
+        sched, prep = _problem(
+            n_pods=1500, n_types=96, n_existing=n_prefixes * 2
+        )
+        classes = sched._class_steps(prep)
+        C = len(prep.classes)
+
+        base_kind = np.asarray(prep.init_state.kind)
+        kind_batch = np.tile(base_kind, (n_prefixes, 1))
+        for p in range(n_prefixes):
+            kind_batch[p, : p + 1] = 0  # mask candidates [0, p]
+
+        base_counts = np.asarray(classes.count)
+        count_batch = np.tile(base_counts, (n_prefixes, 1))
+        for p in range(n_prefixes):
+            # prefix p reschedules p+1 candidates' pods: bump a few classes
+            count_batch[p, (p * 7) % C] += 3
+            count_batch[p, (p * 13 + 1) % C] += 2
+
+        args = (
+            prep.init_state,
+            classes,
+            prep.statics,
+            jnp.asarray(kind_batch),
+            jnp.asarray(count_batch),
+        )
+        ref = _prefix_scan(*args)
+        jax.block_until_ready(ref)
+
+        mesh = slot_mesh(N_DEVICES, axis="prefixes")
+        repl = replicated(mesh)
+        pref = batch_sharding(mesh, 1, axis="prefixes")
+        pref2 = batch_sharding(mesh, 2, axis="prefixes")
+        in_sh = (
+            jax.tree.map(lambda _: repl, prep.init_state),
+            jax.tree.map(lambda _: repl, classes),
+            jax.tree.map(lambda _: repl, prep.statics),
+            pref2,
+            pref2,
+        )
+        step = jax.jit(
+            lambda st, cl, sx, kb, cb: _prefix_scan(st, cl, sx, kb, cb),
+            in_shardings=in_sh,
+            out_shardings=(pref, pref, pref),
+        )
+        sharded = step(*jax.device_put(args, in_sh))
+        jax.block_until_ready(sharded)
+
+        assert sharded[0].sharding.is_equivalent_to(pref, 1)
+        _assert_trees_equal(sharded, ref)
