@@ -180,6 +180,12 @@ class KwokCloudProvider(CloudProvider):
         node_claim.status.allocatable = dict(it.allocatable())
         node_claim.status.image_id = "kwok-ami"
         labels = dict(node_claim.metadata.labels)
+        # derived single-value requirement labels — including well-known keys
+        # like region that only the provider may inject (reference kwok
+        # addInstanceLabels, cloudprovider.go:200-205)
+        for req in node_claim.spec.requirements:
+            if req.operator == "In" and len(req.values) == 1:
+                labels[req.key] = req.values[0]
         labels.update(
             {
                 apilabels.LABEL_INSTANCE_TYPE: it.name,

@@ -314,25 +314,44 @@ class MultiNodeConsolidation(_ConsolidationBase):
                 if ok:
                     best = cmd
                     break
-        if best.decision == "no-op" and frontier_sizes != []:
-            # no frontier available, or the tried frontier sizes all failed
-            # host validation (price filters may pass at smaller untried
-            # sizes): reference binary search
-            # (multinodeconsolidation.go:110-162). frontier == [] means the
-            # device proved NO prefix reschedules everything — price filters
-            # only shrink feasibility, so skip the search entirely.
-            lo, hi = 1, len(candidates)
-            while lo <= hi:
-                mid = (lo + hi) // 2
-                ok, cmd = self._host_validate(candidates, mid)
+        if best.decision == "no-op":
+            if frontier_sizes == []:
+                # the device proved no prefix schedulable, but its FFD is
+                # conservative (K_MARGIN under-placement, first-fit rather
+                # than emptiest-first), so probe the easiest host prefix
+                # once; under the monotonicity the binary search itself
+                # assumes (larger prefixes only harder), a failed size-2
+                # probe means nothing larger passes — steady-state cycles
+                # pay ONE sim, not log2(n)
+                ok, cmd = self._host_validate(candidates, 2)
                 if ok:
                     best = cmd
-                    lo = mid + 1
-                else:
-                    hi = mid - 1
+                    best = self._binary_search(candidates, 3, best)
+            else:
+                # no frontier available, or the tried frontier sizes all
+                # failed host validation (price filters may pass at smaller
+                # untried sizes): reference binary search
+                # (multinodeconsolidation.go:110-162)
+                best = self._binary_search(candidates, 1, best)
         if best.decision != "no-op":
             for c in best.candidates:
                 budgets.consume(c.nodepool.name, self.reason)
+        return best
+
+    def _binary_search(
+        self, candidates: List[Candidate], lo: int, best: Command
+    ) -> Command:
+        """Largest host-valid prefix in [lo, len(candidates)]
+        (multinodeconsolidation.go:110-162)."""
+        hi = len(candidates)
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            ok, cmd = self._host_validate(candidates, mid)
+            if ok:
+                best = cmd
+                lo = mid + 1
+            else:
+                hi = mid - 1
         return best
 
     def _host_validate(

@@ -74,12 +74,14 @@ class NodeClaimDisruption:
         if claim_hash is not None and claim_hash != pool.static_hash():
             return DRIFT_REASON_NODEPOOL_STATIC
         # requirements drift: the claim's committed labels must still satisfy
-        # the pool's requirements (drift.go areRequirementsDrifted)
+        # the pool's requirements (drift.go:144-154 uses Compatible, whose
+        # undefined-key rule also drifts claims when the pool adds a
+        # requirement on a key the claim's labels never defined)
         pool_reqs = Requirements.from_node_selector_requirements_with_min_values(
             pool.spec.template.requirements
         )
         claim_labels = Requirements.from_labels(claim.metadata.labels)
-        if claim_labels.intersects(pool_reqs):
+        if claim_labels.compatible(pool_reqs):
             return DRIFT_REASON_REQUIREMENTS
         # instance type vanished from the provider catalog
         it_name = claim.metadata.labels.get(apilabels.LABEL_INSTANCE_TYPE)

@@ -21,6 +21,7 @@ from karpenter_core_tpu.cloudprovider.types import (
     InsufficientCapacityError,
     NodeClaimNotFoundError,
 )
+from karpenter_core_tpu.scheduling import Requirements
 from karpenter_core_tpu.scheduling.taints import UNREGISTERED_NO_EXECUTE_TAINT
 
 REGISTRATION_TTL = 15 * 60.0  # liveness.go:41
@@ -50,6 +51,7 @@ class NodeClaimLifecycle:
     # -- launch (launch.go:45) --------------------------------------------
 
     def _launch(self, claim: NodeClaim) -> None:
+        user_labels = dict(claim.metadata.labels)
         try:
             self.cloud_provider.create(claim)
         except InsufficientCapacityError:
@@ -59,6 +61,16 @@ class NodeClaimLifecycle:
             return
         except CloudProviderError:
             return  # retried next reconcile
+        # PopulateNodeClaimDetails (launch.go:122-133): provider-resolved
+        # labels < single-value requirement labels < user-defined labels
+        req_labels = Requirements.from_node_selector_requirements_with_min_values(
+            claim.spec.requirements
+        ).to_labels()
+        claim.metadata.labels = {
+            **claim.metadata.labels,
+            **req_labels,
+            **user_labels,
+        }
         self.kube.update(claim)
 
     # -- registration (registration.go:43) --------------------------------

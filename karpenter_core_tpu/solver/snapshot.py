@@ -69,6 +69,10 @@ def _spec_signature(pod: Pod) -> tuple:
         tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)),
         tuple(sorted(pod.resource_requests.items())),
         tuple(pod.topology_spread_constraints),
+        # hostPort pods must form their own class so the decode path always
+        # runs per-pod HostPortUsage conflict checks (nodeclaim.go add path);
+        # sharing a class with port-free twins would skip them
+        tuple(sorted(pod.host_ports)),
     )
 
 

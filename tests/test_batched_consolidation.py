@@ -110,3 +110,26 @@ class TestEndToEndBatched:
             n.status.capacity.get("cpu", 0) for n in op.kube.list_nodes()
         )
         assert cap(op_t) == cap(op_g)
+
+
+class TestFrontierFallback:
+    def test_empty_frontier_still_binary_searches(self, monkeypatch):
+        """The device FFD is conservative (K_MARGIN, first-fit), so an empty
+        frontier must NOT suppress the host binary search (ADVICE r1 #3)."""
+        from karpenter_core_tpu.controllers.disruption import methods
+
+        op = underutilized_fleet(4, solver="tpu")
+        monkeypatch.setattr(
+            methods.MultiNodeConsolidation,
+            "_device_frontier",
+            lambda self, candidates: [],
+        )
+        cap_before = sum(
+            n.status.capacity.get("cpu", 0) for n in op.kube.list_nodes()
+        )
+        op.run_until_idle(max_iters=200)
+        assert all(p.node_name for p in op.kube.list_pods())
+        cap_after = sum(
+            n.status.capacity.get("cpu", 0) for n in op.kube.list_nodes()
+        )
+        assert cap_after < cap_before / 2, (cap_before, cap_after)

@@ -232,6 +232,51 @@ class TestDrift:
         assert claim.conditions.is_true(COND_DRIFTED)
 
 
+    def test_requirements_drift_on_new_pool_key(self):
+        """Adding a requirement on a key the claim's labels never defined
+        must mark the claim RequirementsDrifted (drift.go:144-154 uses
+        Compatible's undefined-key rule, not just shared-key overlap)."""
+        from karpenter_core_tpu.api.objects import NodeSelectorRequirement
+
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=1.0, name="p0")))
+        op.run_until_idle(disrupt=False)
+        pool = op.kube.list_nodepools()[0]
+        pool.spec.template.requirements.append(
+            NodeSelectorRequirement("example.com/team", "In", ("ml",))
+        )
+        op.kube.update(pool)
+        (claim,) = op.kube.list_nodeclaims()
+        op.nodeclaim_disruption.reconcile(claim)
+        assert claim.conditions.is_true(COND_DRIFTED)
+
+
+    def test_well_known_requirement_does_not_churn(self):
+        """A pool requirement on a well-known label the provider resolves
+        (e.g. region) must NOT drift freshly-launched claims: launch stamps
+        single-value requirement labels onto the claim (launch.go:122-133,
+        kwok addInstanceLabels), so strict Compatible finds them defined."""
+        from karpenter_core_tpu.api.objects import NodeSelectorRequirement
+
+        op = new_operator()
+        op.kube.create(
+            make_nodepool(
+                requirements=[
+                    NodeSelectorRequirement(
+                        L.LABEL_TOPOLOGY_REGION, "In", ("us-east1",)
+                    )
+                ]
+            )
+        )
+        op.kube.create(replicated(make_pod(cpu=1.0, name="p0")))
+        op.run_until_idle(disrupt=False)
+        (claim,) = op.kube.list_nodeclaims()
+        assert claim.metadata.labels.get(L.LABEL_TOPOLOGY_REGION) == "us-east1"
+        op.nodeclaim_disruption.reconcile(claim)
+        assert not claim.conditions.is_true(COND_DRIFTED)
+
+
 class TestDoNotDisrupt:
     def test_do_not_disrupt_pod_blocks_consolidation(self):
         op = new_operator()
