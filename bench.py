@@ -1,13 +1,21 @@
-"""Round bench: device-solver scheduling throughput on the kwok catalog.
+"""Round bench: device-solver performance on the kwok catalog.
 
-Scenario = BASELINE.json config 1 scaled to this round: cpu/mem-request-only
-pending pods, single NodePool, kwok instance catalog (reference harness:
+Primary metric = BASELINE.json north star: Scheduler.Solve() throughput at
+**50k pending pods x 800 instance types** (reference harness:
 scheduling_benchmark_test.go:75-95 grid, 100 pods/sec CI floor at :53).
-Prints ONE JSON line; vs_baseline is pods/sec over the reference's enforced
-100 pods/sec floor.
+Secondary lines (reported in `detail`):
 
-Runs on whatever backend JAX selects (real TPU chip under the driver;
-force CPU with JAX_PLATFORM_NAME=cpu).
+  cfg1_5k400      the reference benchmark grid's largest point (5k x 400)
+  cfg2_masked     + nodeSelector / taints+tolerations / pool requirements
+  cfg4_consol     MultiNodeConsolidation sweep: 2k-node cluster, the
+                  100-candidate cap evaluated as ONE vmapped device call
+                  (vs log2(100) full host simulations upstream)
+
+cfg3 (topology) joins once device-side topology lands. Prints ONE JSON
+line; vs_baseline is pods/sec over the reference's enforced 100 pods/sec
+floor. Runs on whatever backend JAX selects (real TPU chip under the
+driver). Env knobs: BENCH_PODS / BENCH_TYPES (primary config),
+BENCH_FAST=1 (primary only).
 """
 from __future__ import annotations
 
@@ -15,64 +23,261 @@ import json
 import os
 import time
 
-N_PODS = int(os.environ.get("BENCH_PODS", "5000"))
-N_TYPES = int(os.environ.get("BENCH_TYPES", "400"))
+N_PODS = int(os.environ.get("BENCH_PODS", "50000"))
+N_TYPES = int(os.environ.get("BENCH_TYPES", "800"))
+FAST = os.environ.get("BENCH_FAST", "") == "1"
 GIB = 2.0**30
 
 
-def build():
-    from karpenter_core_tpu.api.objects import ObjectMeta, Pod
+def _pool(name="default", taints=None, requirements=None):
     from karpenter_core_tpu.api.nodepool import NodePool, NodePoolSpec
-    from karpenter_core_tpu.cloudprovider.kwok import bench_catalog
-    from karpenter_core_tpu.models.provisioner import DeviceScheduler
+    from karpenter_core_tpu.api.objects import ObjectMeta
 
-    catalog = bench_catalog(N_TYPES)
-    pool = NodePool(metadata=ObjectMeta(name="default"))
+    pool = NodePool(metadata=ObjectMeta(name=name))
     pool.spec = NodePoolSpec()
-    # diverse cpu/mem shapes -> many pod equivalence classes (the FFD scan
-    # length); mirrors the benchmark's diverse pod mix minus topology
-    pods = [
+    if taints:
+        pool.spec.template.taints = list(taints)
+    if requirements:
+        pool.spec.template.requirements = list(requirements)
+    return pool
+
+
+def _plain_pods(n, shapes=(16, 12)):
+    """Diverse cpu/mem shapes -> many pod equivalence classes (the FFD scan
+    length); mirrors the benchmark's diverse pod mix minus topology."""
+    from karpenter_core_tpu.api.objects import ObjectMeta, Pod
+
+    a, b = shapes
+    return [
         Pod(
             metadata=ObjectMeta(name=f"p{i}"),
             resource_requests={
-                "cpu": 0.1 * (1 + i % 16),
-                "memory": 0.25 * GIB * (1 + i % 12),
+                "cpu": 0.1 * (1 + i % a),
+                "memory": 0.25 * GIB * (1 + (i // a) % b),
             },
         )
-        for i in range(N_PODS)
+        for i in range(n)
     ]
-    sched = DeviceScheduler([pool], {"default": catalog}, max_slots=1024)
-    return sched, pods
 
 
-def main():
-    sched, pods = build()
+def _masked_pods(n):
+    """BASELINE config 2: 1/3 plain, 1/3 nodeSelector+zone-affinity, 1/3
+    toleration-gated onto a tainted pool (requirement/taint mask paths)."""
+    from karpenter_core_tpu.api import labels as L
+    from karpenter_core_tpu.api.objects import (
+        Affinity,
+        NodeAffinity,
+        NodeSelectorRequirement,
+        NodeSelectorTerm,
+        ObjectMeta,
+        Pod,
+        Toleration,
+    )
+
+    pods = []
+    for i in range(n):
+        kind = i % 3
+        requests = {
+            "cpu": 0.1 * (1 + i % 8),
+            "memory": 0.25 * GIB * (1 + (i // 8) % 6),
+        }
+        if kind == 0:
+            pods.append(
+                Pod(metadata=ObjectMeta(name=f"m{i}"), resource_requests=requests)
+            )
+        elif kind == 1:
+            pods.append(
+                Pod(
+                    metadata=ObjectMeta(name=f"m{i}"),
+                    resource_requests=requests,
+                    node_selector={L.LABEL_OS: "linux"},
+                    affinity=Affinity(
+                        node_affinity=NodeAffinity(
+                            required=[
+                                NodeSelectorTerm(
+                                    match_expressions=(
+                                        NodeSelectorRequirement(
+                                            L.LABEL_TOPOLOGY_ZONE,
+                                            "In",
+                                            ("zone-a", "zone-b"),
+                                        ),
+                                    )
+                                )
+                            ]
+                        )
+                    ),
+                )
+            )
+        else:
+            pods.append(
+                Pod(
+                    metadata=ObjectMeta(name=f"m{i}"),
+                    resource_requests=requests,
+                    node_selector={"pool": "batch"},
+                    tolerations=[
+                        Toleration(key="batch", operator="Exists", effect="NoSchedule")
+                    ],
+                )
+            )
+    return pods
+
+
+def _solve_bench(pods, nodepools, catalog, max_slots=1024, repeats=5):
+    from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+    its = {p.name: list(catalog) for p in nodepools}
+    sched = DeviceScheduler(nodepools, its, max_slots=max_slots)
 
     t0 = time.perf_counter()
-    res = sched.solve(pods)  # cold: includes jit compile
+    res = sched.solve(pods)
     cold = time.perf_counter() - t0
     assert res.all_pods_scheduled(), list(res.pod_errors.items())[:3]
 
     times = []
-    for _ in range(3):
+    for _ in range(repeats):
         t0 = time.perf_counter()
         res = sched.solve(pods)
         times.append(time.perf_counter() - t0)
     p50 = sorted(times)[len(times) // 2]
-    pods_per_sec = N_PODS / p50
+    return {
+        "p50_solve_s": round(p50, 3),
+        "cold_solve_s": round(cold, 3),
+        "pods_per_sec": round(len(pods) / p50, 1),
+        "nodes": res.node_count(),
+    }
 
+
+def _consolidation_bench(n_nodes=2000, n_candidates=100, repeats=3):
+    """BASELINE config 4: the multi-node consolidation frontier over a
+    2k-node cluster — all `n_candidates` prefixes in one vmapped call
+    (models/consolidation.py) instead of the reference's binary search of
+    full scheduling simulations (multinodeconsolidation.go:110-162)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from karpenter_core_tpu.api import labels as L
+    from karpenter_core_tpu.cloudprovider.kwok import bench_catalog
+    from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import (
+        SimNode,
+    )
+    from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+        Topology,
+    )
+    from karpenter_core_tpu.models.consolidation import _prefix_scan, prefix_batches
+    from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+    catalog = bench_catalog(400)
+    nodes = [
+        SimNode(
+            name=f"n{i}",
+            labels={
+                L.LABEL_ARCH: "amd64",
+                L.LABEL_OS: "linux",
+                L.LABEL_TOPOLOGY_ZONE: f"zone-{'abcd'[i % 4]}",
+                L.NODEPOOL_LABEL_KEY: "default",
+                L.LABEL_INSTANCE_TYPE: "s-8x-amd64-linux",
+            },
+            taints=[],
+            # candidates (the first n_candidates) are under-utilized
+            available={"cpu": 7.0 if i < n_candidates else 1.0,
+                       "memory": 14 * GIB if i < n_candidates else 2 * GIB,
+                       "pods": 200.0},
+            capacity={"cpu": 8.0, "memory": 16 * GIB, "pods": 210.0},
+        )
+        for i in range(n_nodes)
+    ]
+    # each candidate carries 2 small reschedulable pods
+    resched = _plain_pods(2 * n_candidates, shapes=(4, 3))
+
+    sched = DeviceScheduler(
+        [_pool()], {"default": catalog}, existing_nodes=nodes,
+        max_slots=2560,
+    )
+    sched.existing_nodes = nodes  # candidate-first order
+    prep = sched._prepare(resched, 2560, Topology())
+    classes = sched._class_steps(prep)
+
+    kind_batch, count_batch = prefix_batches(
+        prep,
+        base_pods=[],
+        candidate_pods=[resched[2 * i : 2 * i + 2] for i in range(n_candidates)],
+    )
+
+    args = (
+        prep.init_state,
+        classes,
+        prep.statics,
+        jnp.asarray(kind_batch),
+        jnp.asarray(count_batch),
+    )
+    import jax
+
+    t0 = time.perf_counter()
+    out = _prefix_scan(*args)
+    jax.block_until_ready(out)
+    cold = time.perf_counter() - t0
+
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = _prefix_scan(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    p50 = sorted(times)[len(times) // 2]
+    unplaced = np.asarray(out[1])
+    return {
+        "p50_sweep_s": round(p50, 3),
+        "cold_sweep_s": round(cold, 3),
+        "prefixes": n_candidates,
+        "cluster_nodes": n_nodes,
+        "schedulable_prefixes": int((unplaced == 0).sum()),
+    }
+
+
+def main():
+    from karpenter_core_tpu.cloudprovider.kwok import bench_catalog
+    from karpenter_core_tpu.api.objects import Taint
+
+    catalog = bench_catalog(N_TYPES)
+
+    primary = _solve_bench(_plain_pods(N_PODS), [_pool()], catalog)
+    detail = {"primary": primary}
+
+    if not FAST:
+        detail["cfg1_5k400"] = _solve_bench(
+            _plain_pods(5000), [_pool()], bench_catalog(400)
+        )
+        from karpenter_core_tpu.api import labels as L
+        from karpenter_core_tpu.api.objects import NodeSelectorRequirement
+
+        masked_pools = [
+            _pool("default"),
+            _pool(
+                "batch",
+                taints=[Taint(key="batch", value="", effect="NoSchedule")],
+                # pool-requirement mask path: the batch pool only offers
+                # amd64/linux instance types
+                requirements=[
+                    NodeSelectorRequirement(L.LABEL_ARCH, "In", ("amd64",)),
+                    NodeSelectorRequirement(L.LABEL_OS, "In", ("linux",)),
+                ],
+            ),
+        ]
+        masked_pools[1].spec.template.labels["pool"] = "batch"
+        detail["cfg2_masked"] = _solve_bench(
+            _masked_pods(N_PODS), masked_pools, catalog
+        )
+        detail["cfg4_consol"] = _consolidation_bench()
+
+    pods_per_sec = primary["pods_per_sec"]
     print(
         json.dumps(
             {
                 "metric": f"solve_throughput_{N_PODS}pods_{N_TYPES}types",
-                "value": round(pods_per_sec, 1),
+                "value": pods_per_sec,
                 "unit": "pods/sec",
                 "vs_baseline": round(pods_per_sec / 100.0, 2),
-                "detail": {
-                    "p50_solve_s": round(p50, 3),
-                    "cold_solve_s": round(cold, 3),
-                    "nodes": res.node_count(),
-                },
+                "detail": detail,
             }
         )
     )

@@ -56,6 +56,36 @@ def _prefix_scan(state: SlotState, classes: ClassStep, statics, kind_batch, coun
     return jax.vmap(one)(kind_batch, count_batch)
 
 
+def prefix_batches(
+    prep, base_pods: List, candidate_pods: List[List]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-prefix slot kinds and class counts for the vmapped sweep.
+
+    Prefix p removes candidate slots [0, p] (kind=0) and adds candidates
+    0..p's reschedulable pods to the class counts; base pods always count.
+    Candidate slots must occupy the first len(candidate_pods) positions of
+    prep.init_state (candidate-first existing-node order)."""
+    P = len(candidate_pods)
+    C = len(prep.classes)
+
+    base_kind = np.asarray(prep.init_state.kind)
+    kind_batch = np.tile(base_kind, (P, 1))
+    for p in range(P):
+        kind_batch[p, : p + 1] = 0
+
+    sig_to_ci = {
+        _spec_signature(cls.pods[0]): ci for ci, cls in enumerate(prep.classes)
+    }
+    base_counts = np.zeros((C,), dtype=np.int32)
+    for pod in base_pods:
+        base_counts[sig_to_ci[_spec_signature(pod)]] += 1
+    count_batch = np.tile(base_counts, (P, 1))
+    for i, pods in enumerate(candidate_pods):
+        for pod in pods:
+            count_batch[i:, sig_to_ci[_spec_signature(pod)]] += 1
+    return kind_batch, count_batch
+
+
 def schedulability_frontier(
     provisioner,
     cluster,
@@ -108,28 +138,10 @@ def schedulability_frontier(
         return None  # cluster wider than the slot array: binary search
 
     P = len(candidates)
-    C = len(prep.classes)
-    N = prep.n_slots
     E = len(sched.existing_nodes)
-
-    base_kind = np.asarray(prep.init_state.kind)
-    kind_batch = np.tile(base_kind, (P, 1))
-    for p in range(P):
-        kind_batch[p, : p + 1] = 0  # remove candidates [0, p]
-
-    # per-prefix class counts: base pods always count; candidate i's pods
-    # count in prefixes p >= i
-    sig_to_ci = {}
-    for ci, cls in enumerate(prep.classes):
-        sig_to_ci[_spec_signature(cls.pods[0])] = ci
-    base_counts = np.zeros((C,), dtype=np.int32)
-    for pod in base_pods:
-        base_counts[sig_to_ci[_spec_signature(pod)]] += 1
-    count_batch = np.tile(base_counts, (P, 1))
-    for i, c in enumerate(candidates):
-        for pod in c.reschedulable_pods:
-            ci = sig_to_ci[_spec_signature(pod)]
-            count_batch[i:, ci] += 1
+    kind_batch, count_batch = prefix_batches(
+        prep, base_pods, [c.reschedulable_pods for c in candidates]
+    )
 
     next_free, unplaced, overflow = _prefix_scan(
         prep.init_state,
