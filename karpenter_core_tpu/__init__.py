@@ -8,8 +8,10 @@ consolidation candidate sweep
 (``pkg/controllers/disruption/multinodeconsolidation.go:110``) — are
 reformulated as batched pod-class × InstanceType tensor assignment in JAX,
 executed on TPU, while the surrounding control plane (cluster state,
-controllers, cloud-provider abstraction, lifecycle) is a Python asyncio
-rebuild of the reference's Go reconcilers.
+controllers, cloud-provider abstraction, lifecycle) is a synchronous,
+deterministic Python rebuild of the reference's Go reconcilers (the
+determinism is load-bearing: it is what makes the device solver's
+resharding bit-exactness testable).
 
 Layout (mirrors SURVEY.md §7):
   api/            CRD-equivalent object model (NodePool, NodeClaim, Pod, Node)

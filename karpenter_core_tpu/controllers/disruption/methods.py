@@ -330,9 +330,11 @@ class MultiNodeConsolidation(_ConsolidationBase):
             else:
                 # no frontier available, or the tried frontier sizes all
                 # failed host validation (price filters may pass at smaller
-                # untried sizes): reference binary search
-                # (multinodeconsolidation.go:110-162)
-                best = self._binary_search(candidates, 1, best)
+                # untried sizes): reference binary search; lo=2 keeps the
+                # >=2-candidate invariant (multinodeconsolidation.go:111-118
+                # never probes below a 2-candidate prefix — size 1 belongs
+                # to SingleNodeConsolidation)
+                best = self._binary_search(candidates, 2, best)
         if best.decision != "no-op":
             for c in best.candidates:
                 budgets.consume(c.nodepool.name, self.reason)
