@@ -63,9 +63,11 @@ from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
     has_topology_constraints,
 )
 from karpenter_core_tpu.ops import masks as mops
+from karpenter_core_tpu.ops import topoplan
 from karpenter_core_tpu.ops.ffd import (
     BIG,
     K_MARGIN,
+    RANK_NONE,
     ClassStep,
     FFDStatics,
     SlotState,
@@ -73,7 +75,12 @@ from karpenter_core_tpu.ops.ffd import (
 )
 from karpenter_core_tpu.scheduling import Requirements, Taints
 from karpenter_core_tpu.solver.snapshot import PodClass, group_pods
-from karpenter_core_tpu.solver.vocab import EntityMasks, GT_NONE, LT_NONE
+from karpenter_core_tpu.solver.vocab import (
+    EntityMasks,
+    GT_NONE,
+    LT_NONE,
+    decode_requirements,
+)
 from karpenter_core_tpu.utils import resources as resutil
 
 
@@ -122,6 +129,8 @@ class _Prepared:
     existing_sims: List[ExistingNodeSim]
     n_slots: int
     topo: Topology
+    plan: topoplan.TopoPlan
+    smask: np.ndarray  # [C, K, V] strict (pod_domains) value masks
     # numpy twins for the vectorized decode
     it_alloc64: np.ndarray  # [pad_T, R] float64
     class_requests64: np.ndarray  # [C, R] float64
@@ -266,14 +275,14 @@ class DeviceScheduler:
         for p in pods:
             topo.update(p)
 
-        # topology-coupled pods take the host algebra (full spread/affinity
-        # semantics); the device FFD batches the topology-free mass
-        simple = [p for p in pods if not has_topology_constraints(p)]
-        constrained = [p for p in pods if has_topology_constraints(p)]
+        # the topology planner decides which constraint shapes run in-kernel
+        # (device count state) and which fall back to the host algebra
+        classes = self._sorted_classes(pods)
+        plan = topoplan.plan_topology(classes, topo)
         self._final_filter_cache: Dict[tuple, list] = {}
 
         try:
-            prep = self._prepare(simple, max_slots, topo)
+            prep = self._prepare_with_vocab(plan, max_slots, topo)
         except _SlotOverflow:
             return None
 
@@ -282,25 +291,38 @@ class DeviceScheduler:
             self._class_steps(prep),
             prep.statics,
         )
-        # one device->host transfer for everything decode reads
-        overflow, takes, unplaced, slot_template = jax.device_get(
-            (state.overflow, takes, unplaced, state.template)
+        # one device->host transfer for everything decode reads; the slot
+        # planes ride along only when topology decode needs them
+        fetch = dict(
+            overflow=state.overflow,
+            takes=takes,
+            unplaced=unplaced,
+            template=state.template,
         )
-        if bool(overflow):
+        if plan.has_device_topology():
+            fetch.update(
+                valmask=state.valmask,
+                defines=state.defines,
+                complement=state.complement,
+                gt=state.gt,
+                lt=state.lt,
+                itmask=state.itmask,
+                hcount=state.hcount,
+                zcount=state.zcount,
+            )
+        out = jax.device_get(fetch)
+        if bool(out["overflow"]):
             return None
-        claims, existing_sims, failed = self._decode(
-            prep,
-            np.asarray(takes),
-            np.asarray(unplaced),
-            np.asarray(slot_template),
-        )
+        claims, existing_sims, failed = self._decode(prep, out)
 
-        constrained_requests = {
-            p.uid: resutil.requests_for_pods(p) for p in constrained
+        # ineligible topology classes: host loop over the post-device cluster
+        fallback_pods = [p for cls in plan.fallback_classes for p in cls.pods]
+        fallback_requests = {
+            p.uid: resutil.requests_for_pods(p) for p in fallback_pods
         }
-        for p in by_cpu_and_memory_descending(constrained, constrained_requests):
+        for p in by_cpu_and_memory_descending(fallback_pods, fallback_requests):
             err = self._host_fallback_add(
-                p, claims, existing_sims, topo, constrained_requests[p.uid]
+                p, claims, existing_sims, topo, fallback_requests[p.uid]
             )
             if err is not None:
                 failed.append((p, err))
@@ -308,9 +330,7 @@ class DeviceScheduler:
 
     # ------------------------------------------------------------------
 
-    def _prepare(
-        self, pods: List[Pod], max_slots: int, topo: Topology
-    ) -> _Prepared:
+    def _sorted_classes(self, pods: List[Pod]) -> List[PodClass]:
         classes = group_pods(pods)
         # class order = pod queue order lifted to classes (queue.go:76-112)
         classes.sort(
@@ -320,11 +340,22 @@ class DeviceScheduler:
                 min(p.metadata.creation_timestamp for p in c.pods),
             )
         )
-        return self._prepare_with_vocab(classes, max_slots, topo)
+        return classes
 
-    def _prepare_with_vocab(self, classes, max_slots, topo: Topology) -> _Prepared:
+    def _prepare(
+        self, pods: List[Pod], max_slots: int, topo: Topology
+    ) -> _Prepared:
+        """Topology-free prepare entry for the consolidation sweep and the
+        sharded-solver tests (callers guarantee no topology-coupled pods)."""
+        plan = topoplan.plan_topology(self._sorted_classes(pods), topo)
+        return self._prepare_with_vocab(plan, max_slots, topo)
+
+    def _prepare_with_vocab(
+        self, plan: topoplan.TopoPlan, max_slots, topo: Topology
+    ) -> _Prepared:
         from karpenter_core_tpu.solver.vocab import Vocab, encode_requirements_batch
 
+        classes = plan.device_classes
         catalog = self._catalog_union()
         T, S = len(catalog), len(self.templates)
         # T == 0 (existing-capacity-only solve) keeps a dummy never-viable
@@ -362,7 +393,11 @@ class DeviceScheduler:
                 if key in mentioned:
                     for v in req.values:
                         vocab.value_id(key, v)
+        # topology-domain universe joins the closed world (the kernel's
+        # admissibility masks index the label-group keys' value rows)
+        topoplan.observe_domains(plan, vocab)
         frozen = vocab.finalize()
+        topoplan.finalize_arrays(plan, frozen, topo)
         well_known = np.array(
             [k in apilabels.WELL_KNOWN_LABELS for k in frozen.key_names], dtype=bool
         )
@@ -387,6 +422,24 @@ class DeviceScheduler:
         class_masks = _neutralize(
             encode_requirements_batch(frozen, [c.requirements for c in classes])
         )
+        # strict (pod_domains) masks — what topology admissibility consults
+        # (topology.go:166-188 passes strict reqs when preferences exist)
+        from karpenter_core_tpu.scheduling.requirements import (
+            has_preferred_node_affinity,
+        )
+
+        strict_enc = encode_requirements_batch(
+            frozen,
+            [
+                c.strict_requirements
+                if c.pods and has_preferred_node_affinity(c.pods[0])
+                else c.requirements
+                for c in classes
+            ],
+        )
+        smask = np.where(
+            strict_enc.defines[:, :, None], strict_enc.mask, True
+        ) if len(classes) else np.ones((0, frozen.K, frozen.V), dtype=bool)
         it_masks = encode_requirements_batch(frozen, [it.requirements for it in catalog])
         tmpl_masks = _neutralize(
             encode_requirements_batch(frozen, [t.requirements for t in self.templates])
@@ -557,6 +610,20 @@ class DeviceScheduler:
                     cls.tolerations, node.taints
                 )
 
+        # topology count state: hostname-group counts seeded per existing
+        # slot; positive counts on non-slot hostnames only matter for the
+        # affinity bootstrap check (h_possel0)
+        slot_names = [n.name for n in self.existing_nodes]
+        hcount0 = topoplan.initial_hcounts(plan, slot_names, N).T  # [N, Gh]
+        slot_name_set = set(slot_names)
+        h_possel0 = np.zeros((plan.Gh,), dtype=bool)
+        for gi, dg in enumerate(plan.host_groups):
+            h_possel0[gi] = any(
+                cnt > 0
+                for name, cnt in dg.group.domains.items()
+                if name not in slot_name_set
+            )
+
         statics = FFDStatics(
             it_alloc=jnp.asarray(it_alloc),
             off_avail=jnp.asarray(off_avail),
@@ -577,6 +644,15 @@ class DeviceScheduler:
             well_known=jnp.asarray(well_known),
             gt_none=jnp.int32(GT_NONE),
             lt_none=jnp.int32(LT_NONE),
+            h_type=jnp.asarray(plan.h_type),
+            h_skew=jnp.asarray(plan.h_skew),
+            h_possel0=jnp.asarray(h_possel0),
+            z_type=jnp.asarray(plan.z_type),
+            z_skew=jnp.asarray(plan.z_skew),
+            z_key=jnp.asarray(plan.z_key),
+            z_mindom=jnp.asarray(plan.z_mindom),
+            z_domains=jnp.asarray(plan.z_domains),
+            z_rank=jnp.asarray(plan.z_rank),
         )
         init_state = SlotState(
             valmask=jnp.asarray(valmask),
@@ -592,6 +668,9 @@ class DeviceScheduler:
             template=jnp.asarray(template_arr),
             next_free=jnp.int32(E),
             overflow=jnp.asarray(False),
+            hcount=jnp.asarray(hcount0),
+            zcount=jnp.asarray(plan.zcount0),
+            carry=jnp.int32(0),
         )
 
         return _Prepared(
@@ -612,6 +691,8 @@ class DeviceScheduler:
             existing_sims=existing_sims,
             n_slots=N,
             topo=topo,
+            plan=plan,
+            smask=smask,
             it_alloc64=it_alloc64,
             class_requests64=class_requests64,
             tmpl_overhead64=tmpl_overhead64,
@@ -625,22 +706,65 @@ class DeviceScheduler:
         )
 
     def _class_steps(self, prep: _Prepared) -> ClassStep:
+        """Per-STEP scanned arrays: one step per class, except self-selecting
+        label-spread classes which expand to one pinned sub-step per
+        admissible domain (ops/topoplan.py)."""
         cm = prep.class_masks
-        counts = np.array([c.count for c in prep.classes], dtype=np.int32)
+        plan = prep.plan
+        steps = plan.steps
+        V = prep.vocab.V
+        cis = np.array([s.class_idx for s in steps], dtype=np.int32)
+        counts = np.array(
+            [prep.classes[ci].count for ci in cis], dtype=np.int32
+        )
+        J = len(steps)
+        zone_rest = (
+            np.stack(
+                [
+                    s.zone_rest
+                    if s.zone_rest is not None
+                    else np.zeros((V,), dtype=bool)
+                    for s in steps
+                ]
+            )
+            if J
+            else np.zeros((0, V), dtype=bool)
+        )
         return ClassStep(
-            mask=jnp.asarray(cm.mask),
-            defines=jnp.asarray(cm.defines),
-            concrete=jnp.asarray(cm.concrete),
-            negative=jnp.asarray(cm.negative),
-            gt=jnp.asarray(cm.gt),
-            lt=jnp.asarray(cm.lt),
+            mask=jnp.asarray(cm.mask[cis]),
+            defines=jnp.asarray(cm.defines[cis]),
+            concrete=jnp.asarray(cm.concrete[cis]),
+            negative=jnp.asarray(cm.negative[cis]),
+            gt=jnp.asarray(cm.gt[cis]),
+            lt=jnp.asarray(cm.lt[cis]),
             count=jnp.asarray(counts),
-            requests=jnp.asarray(prep.class_requests),
-            class_it=jnp.asarray(prep.class_it),
-            tmpl_ok=jnp.asarray(prep.tmpl_ok),
-            exist_taint_ok=jnp.asarray(prep.exist_taint_ok),
-            new_template=jnp.asarray(prep.new_template),
-            kstar=jnp.asarray(prep.kstar),
+            requests=jnp.asarray(prep.class_requests[cis]),
+            class_it=jnp.asarray(prep.class_it[cis]),
+            tmpl_ok=jnp.asarray(prep.tmpl_ok[cis]),
+            exist_taint_ok=jnp.asarray(prep.exist_taint_ok[cis]),
+            new_template=jnp.asarray(prep.new_template[cis]),
+            kstar=jnp.asarray(prep.kstar[cis]),
+            smask=jnp.asarray(prep.smask[cis]),
+            h_sel=jnp.asarray(plan.h_sel[cis]),
+            h_owner=jnp.asarray(plan.h_owner[cis]),
+            z_sel=jnp.asarray(plan.z_sel[cis]),
+            z_owner=jnp.asarray(plan.z_owner[cis]),
+            sub_value=jnp.asarray(
+                np.array([s.sub_value for s in steps], dtype=np.int32)
+            ),
+            sub_first=jnp.asarray(
+                np.array([s.sub_first for s in steps], dtype=bool)
+            ),
+            sub_last=jnp.asarray(
+                np.array([s.sub_last for s in steps], dtype=bool)
+            ),
+            wf_group=jnp.asarray(
+                np.array([s.wf_group for s in steps], dtype=np.int32)
+            ),
+            wf_key=jnp.asarray(
+                np.array([s.wf_key for s in steps], dtype=np.int32)
+            ),
+            zone_rest=jnp.asarray(zone_rest),
         )
 
     def _catalog_union(self) -> List[InstanceType]:
@@ -662,33 +786,43 @@ class DeviceScheduler:
     # ------------------------------------------------------------------
 
     def _decode(
-        self,
-        prep: _Prepared,
-        takes: np.ndarray,
-        unplaced: np.ndarray,
-        slot_template: np.ndarray,
+        self, prep: _Prepared, out: Dict[str, np.ndarray]
     ) -> Tuple[List[InFlightNodeClaim], List[ExistingNodeSim], list]:
         """Re-materialize device placements through the host algebra.
 
-        Each slot's class groups are merged with the exact reference-semantics
-        machinery (Requirements.add + filter_instance_types), so the returned
-        claims are indistinguishable from greedy-path output. Any pod the
-        host algebra rejects (device/host divergence — e.g. float32 capacity
-        arithmetic saying an exact fit holds where float64 disagrees) is
-        re-placed through the host greedy add; only pods the host path also
-        rejects surface as failures (and re-enter via relaxation)."""
-        C, N = takes.shape
+        Topology-free solves merge each slot's class groups with the exact
+        reference-semantics machinery (Requirements.add +
+        filter_instance_types). Topology solves instead reconstruct each
+        fresh slot's joined requirements straight from the final device
+        planes (decode_requirements — the planes already carry every
+        admissibility tightening the kernel applied) and sync the host
+        groups' domain counters from the device count state. Either way, any
+        placement the host-side checks reject is re-placed through the host
+        greedy add; only pods the host path also rejects surface as failures
+        (and re-enter via relaxation)."""
+        takes = np.asarray(out["takes"])
+        unplaced = np.asarray(out["unplaced"])
+        slot_template = np.asarray(out["template"])
+        plan = prep.plan
+        steps = plan.steps
+        C = len(prep.classes)
+        J = takes.shape[0] if takes.size else 0
         E = len(prep.existing_sims)
         failed: list = []
         divergent: List[Pod] = []
 
-        # distribute per-class pod lists
-        assigned: Dict[int, List[Tuple[int, int]]] = {}  # slot -> [(class, k)]
-        for ci in range(C):
-            cls = prep.classes[ci]
-            for n in np.nonzero(takes[ci])[0]:
-                assigned.setdefault(int(n), []).append((ci, int(takes[ci, n])))
-            k_unplaced = int(unplaced[ci])
+        # merge sub-steps per (slot, class) — pods of a class are
+        # interchangeable — and collect per-class unplaced tails
+        assigned: Dict[int, Dict[int, int]] = {}
+        unplaced_by_class = np.zeros((C,), dtype=np.int64)
+        for j in range(J):
+            ci = steps[j].class_idx
+            unplaced_by_class[ci] += int(unplaced[j])
+            for n in np.nonzero(takes[j])[0]:
+                slot = assigned.setdefault(int(n), {})
+                slot[ci] = slot.get(ci, 0) + int(takes[j, int(n)])
+        for ci, cls in enumerate(prep.classes):
+            k_unplaced = int(unplaced_by_class[ci])
             if k_unplaced:
                 for p in cls.pods[cls.count - k_unplaced :]:
                     failed.append((p, "no nodepool matched pod"))
@@ -696,13 +830,20 @@ class DeviceScheduler:
         claims: List[InFlightNodeClaim] = []
         topo = prep.topo
         pod_cursor = {ci: 0 for ci in range(C)}
+
+        if plan.has_device_topology():
+            return self._decode_topo(
+                prep, out, assigned, slot_template, pod_cursor, claims, failed
+            )
+
+        # ---- topology-free path ------------------------------------------
         # group-add is exact only when no topology group could observe these
         # pods (decode sees topology-free pods, but inverse anti-affinity
         # groups from the cluster can still select them by label)
         can_group = not topo.topologies and not topo.inverse_topologies
 
         for n in sorted(assigned):
-            groups = assigned[n]
+            groups = sorted(assigned[n].items())
             if n < E:
                 target = prep.existing_sims[n]
             else:
@@ -752,6 +893,252 @@ class DeviceScheduler:
             else:
                 c.destroy()
         return kept, prep.existing_sims, failed
+
+    # -- topology decode ---------------------------------------------------
+
+    def _decode_topo(
+        self,
+        prep: _Prepared,
+        out: Dict[str, np.ndarray],
+        assigned: Dict[int, Dict[int, int]],
+        slot_template: np.ndarray,
+        pod_cursor: Dict[int, int],
+        claims: List[InFlightNodeClaim],
+        failed: list,
+    ) -> Tuple[List[InFlightNodeClaim], List[ExistingNodeSim], list]:
+        """Decode with device topology state: bulk commits, then host group
+        count sync, then deferred per-pod replays.
+
+        Ordering is load-bearing: deferred pods must replay through the host
+        algebra AFTER the device counts (minus the deferred contributions)
+        are synced into the host TopologyGroups, or they would place against
+        stale counters."""
+        plan, topo = prep.plan, prep.topo
+        E = len(prep.existing_sims)
+        valmask = np.asarray(out["valmask"])
+        defines = np.asarray(out["defines"])
+        complement = np.asarray(out["complement"])
+        gt = np.asarray(out["gt"])
+        lt = np.asarray(out["lt"])
+        itmask = np.asarray(out["itmask"])
+        hcount = np.asarray(out["hcount"]).astype(np.int64).copy()
+        zcount = np.asarray(out["zcount"]).astype(np.int64).copy()
+
+        deferred: List[Pod] = []
+        # (slot, class, k, slot requirements, hostname) per bulk commit
+        committed: List[tuple] = []
+        slot_hostnames: Dict[int, str] = {}
+
+        def defer(n: int, ci: int, pods: List[Pod]) -> None:
+            self._topo_subtract(
+                plan, valmask, defines, complement, n, ci, len(pods),
+                hcount, zcount,
+            )
+            deferred.extend(pods)
+
+        for n in sorted(assigned):
+            groups = sorted(assigned[n].items())
+            if n < E:
+                target = prep.existing_sims[n]
+                slot_hostnames[n] = target.name
+                for ci, k in groups:
+                    cls = prep.classes[ci]
+                    start = pod_cursor[ci]
+                    pods = cls.pods[start : start + k]
+                    pod_cursor[ci] = start + k
+                    if not pods:
+                        continue
+                    if pods[0].host_ports:
+                        defer(n, ci, pods)
+                        continue
+                    try:
+                        target.add_group(pods, resutil.requests_for_pods(pods[0]))
+                        committed.append(
+                            (n, ci, len(pods), target.requirements, target.name)
+                        )
+                    except IncompatibleError:
+                        defer(n, ci, pods)
+            else:
+                self._commit_fresh_topo(
+                    prep, n, int(slot_template[n]), groups, pod_cursor,
+                    claims, committed, slot_hostnames, defer,
+                    valmask, defines, complement, gt, lt, itmask,
+                )
+
+        self._sync_topo_counts(prep, hcount, zcount, slot_hostnames)
+        self._recount_host_only(prep, committed)
+
+        for p in deferred:
+            err = self._host_fallback_add(p, claims, prep.existing_sims, topo)
+            if err is not None:
+                failed.append((p, err))
+
+        kept = []
+        for c in claims:
+            if c.pods:
+                kept.append(c)
+            else:
+                c.destroy()
+        return kept, prep.existing_sims, failed
+
+    def _commit_fresh_topo(
+        self,
+        prep: _Prepared,
+        n: int,
+        si: int,
+        groups: List[Tuple[int, int]],
+        pod_cursor: Dict[int, int],
+        claims: List[InFlightNodeClaim],
+        committed: List[tuple],
+        slot_hostnames: Dict[int, str],
+        defer,
+        valmask: np.ndarray,
+        defines: np.ndarray,
+        complement: np.ndarray,
+        gt: np.ndarray,
+        lt: np.ndarray,
+        itmask: np.ndarray,
+    ) -> None:
+        """Materialize one fresh topology slot from the final device planes:
+        float64-refit the take against the slot's final viable instance
+        types, rebuild the joined requirements with decode_requirements, and
+        commit in bulk. minValues / hostPort shapes go per-pod instead."""
+        template = prep.templates[si]
+        T = len(prep.catalog)
+        entries: List[Tuple[int, List[Pod]]] = []
+        for ci, k in groups:
+            cls = prep.classes[ci]
+            start = pod_cursor[ci]
+            pods = cls.pods[start : start + k]
+            pod_cursor[ci] = start + k
+            if pods:
+                entries.append((ci, pods))
+        if not entries:
+            return
+        plane_ok = not template.requirements.has_min_values() and all(
+            not pods[0].host_ports
+            and not prep.classes[ci].requirements.has_min_values()
+            for ci, pods in entries
+        )
+        req_vec = prep.tmpl_overhead64[si].copy()
+        requests = dict(self.daemon_overhead[si])
+        for ci, pods in entries:
+            for _ in range(len(pods)):
+                req_vec += prep.class_requests64[ci]
+            requests = resutil.merge_repeated(
+                requests, resutil.requests_for_pods(pods[0]), len(pods)
+            )
+        opt_idx = [
+            int(t)
+            for t in np.nonzero(itmask[n, :T])[0]
+            if np.all(req_vec <= prep.it_alloc64[t])
+        ]
+        if not plane_ok or not opt_idx:
+            for ci, pods in entries:
+                defer(n, ci, pods)
+            return
+        claim = InFlightNodeClaim(
+            template,
+            prep.topo,
+            self.daemon_overhead[si],
+            [prep.catalog[t] for t in opt_idx],
+        )
+        reqs = decode_requirements(
+            prep.vocab, valmask[n], defines[n], complement[n], gt[n], lt[n]
+        )
+        reqs.add(
+            Requirement.new(apilabels.LABEL_HOSTNAME, "In", [claim.hostname])
+        )
+        claim.requirements = reqs
+        claim.pods = [p for _, pods in entries for p in pods]
+        claim.requests = requests
+        claims.append(claim)
+        slot_hostnames[n] = claim.hostname
+        for ci, pods in entries:
+            committed.append((n, ci, len(pods), reqs, claim.hostname))
+
+    @staticmethod
+    def _topo_subtract(
+        plan, valmask, defines, complement, n, ci, k, hcount, zcount
+    ) -> None:
+        """Remove a deferred placement's contributions from the device
+        counts — the mirror of the kernel's count update, evaluated on the
+        final planes (a slot pinned by a LATER class than the deferred one
+        can over-subtract by at most the deferred pod count; deferred slots
+        are divergence repairs, so the drift is bounded and rare)."""
+        if plan.h_sel.size:
+            hcount[n, :] -= k * plan.h_sel[ci].astype(np.int64)
+        for gi in range(len(plan.label_groups)):
+            if not plan.z_sel[ci, gi]:
+                continue
+            kid = int(plan.z_key[gi])
+            if not defines[n, kid] or complement[n, kid]:
+                continue
+            row = valmask[n, kid]
+            if plan.z_type[gi] == 1 or row.sum() == 1:
+                zcount[gi] -= k * row.astype(np.int64)
+
+    def _sync_topo_counts(
+        self, prep: _Prepared, hcount, zcount, slot_hostnames: Dict[int, str]
+    ) -> None:
+        """Overwrite the host TopologyGroups' domain counters with the
+        device truth (counts for untouched slots/domains are unchanged by
+        construction, so only synced entries are written)."""
+        plan = prep.plan
+        for gi, dg in enumerate(plan.host_groups):
+            g = dg.group
+            for n, name in slot_hostnames.items():
+                cnt = max(int(hcount[n, gi]), 0)
+                if name not in g.domains and cnt == 0:
+                    continue
+                g.domains[name] = cnt
+                if cnt > 0:
+                    g.empty_domains.discard(name)
+                else:
+                    g.empty_domains.add(name)
+        for gi, dg in enumerate(plan.label_groups):
+            g = dg.group
+            kid = int(plan.z_key[gi])
+            names = prep.vocab.value_names[kid]
+            for vid in np.nonzero(plan.z_domains[gi])[0]:
+                name = names[vid]
+                cnt = max(int(zcount[gi, vid]), 0)
+                g.domains[name] = cnt
+                if cnt > 0:
+                    g.empty_domains.discard(name)
+                else:
+                    g.empty_domains.add(name)
+
+    def _recount_host_only(self, prep: _Prepared, committed: List[tuple]) -> None:
+        """Groups the device could not model (non-trivial spread node
+        filters) re-count the bulk-committed placements host-side at
+        (class × slot) granularity — their owner classes always run on the
+        host, so these counters only need the device classes' contributions."""
+        plan = prep.plan
+        if not plan.host_only_groups:
+            return
+        from karpenter_core_tpu.scheduling.requirements import (
+            ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+        )
+
+        for g in plan.host_only_groups:
+            for n, ci, k, reqs, hostname in committed:
+                rep = prep.classes[ci].pods[0]
+                if not g.selects(rep):
+                    continue
+                if not g.node_filter.matches_requirements(
+                    reqs, ALLOW_UNDEFINED_WELL_KNOWN_LABELS
+                ):
+                    continue
+                if g.key == apilabels.LABEL_HOSTNAME:
+                    domain = hostname
+                else:
+                    dom_req = reqs.get(g.key)
+                    vals = dom_req.sorted_values()
+                    if dom_req.complement or len(vals) != 1:
+                        continue
+                    domain = vals[0]
+                g.record(*([domain] * k))
 
     def _decode_fresh_vectorized(
         self,

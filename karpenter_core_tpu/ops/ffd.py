@@ -1,10 +1,11 @@
-"""The batched first-fit-decreasing kernel.
+"""The batched first-fit-decreasing kernel, topology-aware.
 
 The reference's hot loop walks pods one at a time through existing nodes,
-in-flight claims, and fresh templates (scheduler.go:208-316). Here the walk
-is a ``lax.scan`` over pod *equivalence classes* (solver/snapshot.py), each
-step placing a whole class with vectorized arithmetic over all open slots at
-once:
+in-flight claims, and fresh templates (scheduler.go:208-316), consulting
+per-group topology domain counters for every pod (topologygroup.go:181-342).
+Here the walk is a ``lax.scan`` over pod *equivalence classes*
+(solver/snapshot.py), each step placing a whole class with vectorized
+arithmetic over all open slots at once:
 
 * slot feasibility — the evolving claim-requirements state is kept as mask
   planes ([N,K,V] value masks + defines/complement/negative/gt/lt planes)
@@ -13,6 +14,14 @@ once:
 * capacity — per-slot take counts ``k_max`` are computed per instance type
   as floor((allocatable - requests) / class_request) and maximized over the
   slot's viable-IT mask; existing nodes use their fixed available vector;
+* topology — per-group count state rides the scan carry: label-keyed groups
+  (zone etc.) as count vectors over the value vocab (``zcount``), hostname-
+  keyed groups as per-slot count planes (``hcount`` — every slot IS a
+  hostname domain). Each step derives admissible-domain masks (spread skew /
+  affinity count>0 / anti-affinity empty-domain rules), per-slot take caps,
+  and — for self-selecting label spreads — a water-fill quota per pinned
+  sub-step, the batched equivalent of the reference's per-pod min-count
+  domain selection (topologygroup.go:181-227);
 * placement — first-fit in slot order via exclusive cumulative sums;
   leftovers open ceil(rem / kstar) identical fresh slots from the class's
   chosen template.
@@ -22,11 +31,15 @@ instance-type value vocabulary never enters the slot planes), and offering
 availability is evaluated against the slot's zone/capacity-type masks each
 step (the claim-requirements-vs-offering check of nodeclaim.go:252).
 
-Known, deliberate round-1 deviations from pod-at-a-time semantics (parity-
-tested in tests/test_device_solver.py): within one class placement is
-first-fit in slot order rather than emptiest-first (scheduler.go:277), and
-same-shape classes are processed class-by-class rather than interleaved —
-both only matter once topology counting lands.
+Known, deliberate batching deviations from pod-at-a-time semantics
+(parity-tested in tests/test_device_solver.py and
+tests/test_device_topology.py): within one class placement is first-fit in
+slot order rather than emptiest-first (scheduler.go:277); same-shape classes
+are processed class-by-class rather than interleaved; a class's pods place
+atomically, so spread skew holds at class boundaries rather than at every
+pod; and non-self-selecting spread placements keep the admissible domain
+SET rather than pinning to the per-pod min-count domain, so such pods only
+feed other groups' counters once something pins their slot.
 """
 from __future__ import annotations
 
@@ -37,6 +50,8 @@ import jax
 import jax.numpy as jnp
 
 BIG = jnp.float32(3.4e38)
+BIGI = 1 << 30
+RANK_NONE = 1 << 30
 
 
 class SlotState(NamedTuple):
@@ -53,6 +68,10 @@ class SlotState(NamedTuple):
     template: jax.Array  # [N] int32 (new slots; -1 otherwise)
     next_free: jax.Array  # [] int32
     overflow: jax.Array  # [] bool
+    # topology count state
+    hcount: jax.Array  # [N, Gh] int32 — hostname-group counts per slot
+    zcount: jax.Array  # [Gz, V] int32 — label-group counts per value
+    carry: jax.Array  # [] int32 — remaining pods of the current wf class
 
 
 class ClassStep(NamedTuple):
@@ -71,6 +90,18 @@ class ClassStep(NamedTuple):
     exist_taint_ok: jax.Array  # [N] bool — tolerates existing slot n's taints
     new_template: jax.Array  # [] int32 — chosen template for fresh nodes (-1 none)
     kstar: jax.Array  # [] int32 — pods per fresh node on the best IT
+    # topology
+    smask: jax.Array  # [K, V] bool — STRICT admissible values (pod_domains)
+    h_sel: jax.Array  # [Gh] bool — hostname groups counting this class
+    h_owner: jax.Array  # [Gh] bool — hostname groups constraining it
+    z_sel: jax.Array  # [Gz] bool
+    z_owner: jax.Array  # [Gz] bool
+    sub_value: jax.Array  # [] int32 — water-fill pinned value id (-1 none)
+    sub_first: jax.Array  # [] bool
+    sub_last: jax.Array  # [] bool
+    wf_group: jax.Array  # [] int32 — label-group index for water-fill (-1)
+    wf_key: jax.Array  # [] int32 — vocab key id of that group
+    zone_rest: jax.Array  # [V] bool — this + later sub-step domains
 
 
 class FFDStatics(NamedTuple):
@@ -91,9 +122,19 @@ class FFDStatics(NamedTuple):
     well_known: jax.Array  # [K] bool
     gt_none: jax.Array  # [] int32
     lt_none: jax.Array  # [] int32
+    # topology group metadata
+    h_type: jax.Array  # [Gh] int32: 0 spread / 1 anti / 2 affinity
+    h_skew: jax.Array  # [Gh] int32
+    h_possel0: jax.Array  # [Gh] bool — positive count on a non-slot hostname
+    z_type: jax.Array  # [Gz] int32
+    z_skew: jax.Array  # [Gz] int32
+    z_key: jax.Array  # [Gz] int32 — vocab key id per label group
+    z_mindom: jax.Array  # [Gz] int32 (-1: no minDomains)
+    z_domains: jax.Array  # [Gz, V] bool — registered domain universe
+    z_rank: jax.Array  # [Gz, V] int32 — sorted-name rank (RANK_NONE outside)
 
 
-def _class_slot_compatible(state: SlotState, c: ClassStep, statics: FFDStatics):
+def _class_slot_compatible(state: SlotState, c, statics: FFDStatics):
     """Requirements.Compatible(class -> slot) vectorized over slots.
 
     Mirrors ops/masks.compatible; the custom-label rule applies with
@@ -168,19 +209,212 @@ def _k_max(state: SlotState, c: ClassStep, statics: FFDStatics, viable_it):
     return jnp.clip(k, 0.0, 2**30).astype(jnp.int32)
 
 
+# ---------------------------------------------------------------------------
+# topology: admissible domains, slot caps, water-fill quota
+
+
+def _label_admissible(state: SlotState, c: ClassStep, statics: FFDStatics):
+    """Lower the class's owned label-group constraints to an effective
+    requirement restriction.
+
+    Returns (restr [K, V] bool, topo_defined [K] bool): restr is AND-folded
+    into the class's value masks; topo_defined marks keys the topology now
+    defines (concrete, non-negative — an In over the admissible set).
+    Domain rules per group type (all over the group's registered universe ∧
+    the class's strict values for the key — pod_domains):
+
+    * spread: count (+1 if self-selecting) - min <= maxSkew, min over the
+      pod-admissible universe with the minDomains zero rule
+      (topologygroup.go:181-249);
+    * anti-affinity: empty domains only (topologygroup.go:316-342);
+    * affinity: count>0 domains; a self-selecting class with none bootstraps
+      on the first sorted admissible domain (topologygroup.go:253-300).
+    """
+    Gz, V = statics.z_domains.shape
+    K = c.mask.shape[0]
+    smask_g = c.smask[statics.z_key]  # [Gz, V]
+    padm = smask_g & statics.z_domains
+    counts = state.zcount
+    cnt = jnp.where(padm, counts, BIGI)
+    minc = jnp.min(cnt, axis=1)  # [Gz]
+    supported = jnp.sum(padm, axis=1)
+    minc = jnp.where(
+        (statics.z_mindom >= 0) & (supported < statics.z_mindom),
+        0,
+        minc,
+    )
+    inc = jnp.where(c.z_sel, 1, 0)
+    delta = counts + inc[:, None] - minc[:, None]
+    adm_spread = padm & (delta <= statics.z_skew[:, None])
+    adm_anti = padm & (counts == 0)
+    pos = padm & (counts > 0)
+    any_pos = jnp.any(pos, axis=1)
+    rank = jnp.where(padm, statics.z_rank, RANK_NONE)
+    boot = (rank == jnp.min(rank, axis=1, keepdims=True)) & padm
+    adm_aff = jnp.where(
+        any_pos[:, None],
+        pos,
+        jnp.where(c.z_sel[:, None], boot, jnp.zeros_like(pos)),
+    )
+    adm = jnp.where(
+        (statics.z_type == 0)[:, None],
+        adm_spread,
+        jnp.where((statics.z_type == 1)[:, None], adm_anti, adm_aff),
+    )
+
+    gidx = jnp.arange(Gz, dtype=jnp.int32)
+    owner = c.z_owner & (gidx != c.wf_group)  # wf group handled via the pin
+    key_oh = jax.nn.one_hot(statics.z_key, K, dtype=jnp.float32)  # [Gz, K]
+    owner_key = key_oh * owner[:, None].astype(jnp.float32)
+    viol = jnp.einsum("gk,gv->kv", owner_key, (~adm).astype(jnp.float32)) > 0
+    restr = ~viol
+    topo_defined = jnp.einsum("gk->k", owner_key) > 0
+
+    # water-fill pin: the sub-step's key row collapses to the pinned value
+    has_wf = c.wf_group >= 0
+    pin_row = (
+        jax.nn.one_hot(jnp.clip(c.sub_value, 0), V, dtype=bool)
+        & (c.sub_value >= 0)
+    )
+    wf_key_oh = jax.nn.one_hot(jnp.clip(c.wf_key, 0), K, dtype=bool) & has_wf
+    restr = restr & (~wf_key_oh[:, None] | pin_row[None, :])
+    topo_defined = topo_defined | wf_key_oh
+    return restr, topo_defined
+
+
+def _host_caps(state: SlotState, c: ClassStep, statics: FFDStatics):
+    """Per-slot take caps from owned hostname-keyed groups.
+
+    Hostname min floats at zero (a fresh node is always creatable,
+    topologygroup.go:235-238), so:
+    * spread, self-selecting: cap = maxSkew - count; else binary on
+      count <= maxSkew;
+    * anti-affinity: empty slots only; cap 1 when self-selecting;
+    * affinity: count>0 slots; a self-selecting class with no positive
+      domain anywhere bootstraps (single-slot placement).
+
+    Returns (slot_cap [N] int32, fresh_cap [] int32, single_slot [] bool).
+    """
+    counts = state.hcount  # [N, Gh]
+    sel = c.h_sel
+    owner = c.h_owner
+    skew = statics.h_skew
+    cap_spread = jnp.where(
+        sel[None, :],
+        skew[None, :] - counts,
+        jnp.where(counts <= skew[None, :], BIGI, 0),
+    )
+    cap_anti = jnp.where(
+        counts == 0, jnp.where(sel, 1, BIGI)[None, :], 0
+    )
+    pos_any = statics.h_possel0 | jnp.any(counts > 0, axis=0)  # [Gh]
+    boot = (~pos_any) & sel & (statics.h_type == 2)
+    cap_aff = jnp.where(counts > 0, BIGI, 0)
+    cap_aff = jnp.where(boot[None, :], BIGI, cap_aff)
+    cap = jnp.where(
+        (statics.h_type == 0)[None, :],
+        cap_spread,
+        jnp.where((statics.h_type == 1)[None, :], cap_anti, cap_aff),
+    )
+    cap = jnp.where(owner[None, :], cap, BIGI)
+    slot_cap = jnp.clip(jnp.min(cap, axis=1), 0)  # [N]
+
+    f_cap_g = jnp.where(
+        statics.h_type == 0,
+        jnp.where(sel, skew, BIGI),
+        jnp.where(
+            statics.h_type == 1,
+            jnp.where(sel, 1, BIGI),
+            jnp.where(boot, BIGI, 0),
+        ),
+    )
+    f_cap_g = jnp.where(owner, f_cap_g, BIGI)
+    fresh_cap = jnp.clip(jnp.min(f_cap_g), 0)
+    single_slot = jnp.any(boot & owner)
+    return slot_cap, fresh_cap, single_slot
+
+
+def _wf_quota(state: SlotState, c: ClassStep, statics: FFDStatics, m):
+    """Water-fill share of the pinned sub-step domain.
+
+    The batched equivalent of the reference's per-pod loop: each pod joins
+    the min-count admissible domain (ties by sorted-name order), which for m
+    identical pods is exactly a water-fill to level L with the remainder
+    going one-each to the lowest-(count, name) domains. Under an unsatisfied
+    minDomains the min is pinned at zero and each domain caps at maxSkew
+    (topologygroup.go:229-249). Later sub-steps recompute over the remaining
+    domains with the carried remainder — jointly identical to one water-fill
+    over all domains. Capacity shortfalls in one domain spill forward into
+    later sub-steps through the carry."""
+    g = jnp.clip(c.wf_group, 0)
+    counts = state.zcount[g]  # [V]
+    padm = c.zone_rest
+    skew = statics.z_skew[g]
+    full_adm = c.smask[statics.z_key[g]] & statics.z_domains[g]
+    supported = jnp.sum(full_adm)
+    mindom = statics.z_mindom[g]
+    mindom_unsat = (mindom >= 0) & (supported < mindom)
+    cap = jnp.where(mindom_unsat, jnp.clip(skew - counts, 0), BIGI)
+
+    def fill_at(L):
+        return jnp.where(padm, jnp.clip(L - counts, 0, cap), 0)
+
+    hi0 = jnp.max(jnp.where(padm, counts, 0)) + m
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi + 1) // 2
+        ok = jnp.sum(fill_at(mid)) <= m
+        return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid - 1)
+
+    L, _ = jax.lax.fori_loop(0, 40, body, (jnp.int32(0), hi0))
+    fill = fill_at(L)
+    r = m - jnp.sum(fill)
+    post = counts + fill
+    elig = padm & (fill < cap) & (post == L)
+    rk = jnp.where(elig, statics.z_rank[g], RANK_NONE)
+    erank = jnp.sum((rk[None, :] < rk[:, None]) & elig[None, :], axis=1)
+    extra = elig & (erank < r)
+    quota = fill + extra
+    return jnp.where(
+        c.sub_value >= 0, quota[jnp.clip(c.sub_value, 0)], 0
+    )
+
+
+# ---------------------------------------------------------------------------
+
+
 def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics):
     """Place one pod class; returns (state', take [N] int32 + unplaced [])."""
     N = state.kind.shape[0]
 
+    # -- topology: effective class requirements + caps + quota -------------
+    restr, topo_defined = _label_admissible(state, c, statics)
+    eff_mask = c.mask & restr
+    eff_defines = c.defines | topo_defined
+    eff_concrete = c.concrete | topo_defined
+    eff_negative = c.negative & ~topo_defined
+    c_eff = c._replace(
+        mask=eff_mask,
+        defines=eff_defines,
+        concrete=eff_concrete,
+        negative=eff_negative,
+    )
+    slot_cap, fresh_cap, single_slot = _host_caps(state, c, statics)
+
+    is_wf = c.wf_group >= 0
+    carry0 = jnp.where(c.sub_first, c.count, state.carry)
+    m = jnp.where(is_wf, _wf_quota(state, c, statics, carry0), c.count)
+
     # -- feasibility on open slots ---------------------------------------
-    req_ok = _class_slot_compatible(state, c, statics)
+    req_ok = _class_slot_compatible(state, c_eff, statics)
     taint_ok = jnp.where(
         state.kind == 1,
         c.exist_taint_ok,
         c.tmpl_ok[jnp.clip(state.template, 0)],
     )
     joined_valmask = state.valmask & jnp.where(
-        c.defines[None, :, None], c.mask[None, :, :], True
+        eff_defines[None, :, None], eff_mask[None, :, :], True
     )
     off_ok = _offering_ok(statics, joined_valmask)  # [N, T]
     viable_it = state.itmask & c.class_it[None, :] & off_ok
@@ -192,19 +426,27 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics):
         & taint_ok
         & ((state.kind == 1) | jnp.any(viable_it, axis=-1))
     )
-    k_max = jnp.where(feasible, k_max, 0)
+    k_eff = jnp.minimum(k_max, slot_cap)
+    k_eff = jnp.where(feasible, k_eff, 0)
 
     # -- first-fit fill in slot order ------------------------------------
-    m = c.count
-    before = jnp.cumsum(k_max) - k_max  # exclusive prefix
-    take = jnp.clip(m - before, 0, k_max)  # [N]
+    before = jnp.cumsum(k_eff) - k_eff  # exclusive prefix
+    take_normal = jnp.clip(m - before, 0, k_eff)  # [N]
+    first_feasible = feasible & (jnp.cumsum(feasible) == 1)
+    take_single = jnp.where(first_feasible, jnp.minimum(k_eff, m), 0)
+    take = jnp.where(single_slot, take_single, take_normal)
     rem = m - jnp.sum(take)
 
     # -- open fresh slots -------------------------------------------------
-    has_template = c.new_template >= 0
-    kstar = jnp.maximum(c.kstar, 1)
+    has_template = (c.new_template >= 0) & (fresh_cap > 0)
+    kstar = jnp.clip(jnp.minimum(jnp.maximum(c.kstar, 1), fresh_cap), 1)
     n_new = jnp.where(
         has_template & (rem > 0), (rem + kstar - 1) // kstar, 0
+    )
+    # affinity bootstrap places on exactly one slot — a fresh one only when
+    # no existing slot admitted anything (nextDomainAffinity bootstrap path)
+    n_new = jnp.where(
+        single_slot, jnp.where(jnp.sum(take) > 0, 0, jnp.minimum(n_new, 1)), n_new
     )
     idx = jnp.arange(N, dtype=jnp.int32)
     fresh = (idx >= state.next_free) & (idx < state.next_free + n_new)
@@ -212,7 +454,7 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics):
         fresh, jnp.clip(rem - (idx - state.next_free) * kstar, 0, kstar), 0
     )
     overflow = state.overflow | (state.next_free + n_new > N)
-    unplaced = jnp.where(has_template, 0, rem)
+    unplaced_step = rem - jnp.sum(take_fresh)
 
     s = jnp.clip(c.new_template, 0)
     took = take > 0
@@ -223,7 +465,7 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics):
     # negative=True, sentinel bounds — so intersection-on-add is uniform:
     # mask AND, complement AND (~concrete), negative AND, gt max, lt min
     # (requirement.go:155-188 under the closed world).
-    upd = (took | fresh)[:, None] & c.defines[None, :]  # [N, K]
+    upd = (took | fresh)[:, None] & eff_defines[None, :]  # [N, K]
     base_valmask = jnp.where(
         fresh[:, None, None], statics.tmpl_mask[s][None, :, :], state.valmask
     )
@@ -238,13 +480,13 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics):
     base_lt = jnp.where(fresh[:, None], statics.tmpl_lt[s][None, :], state.lt)
 
     new_valmask = jnp.where(
-        upd[:, :, None], base_valmask & c.mask[None, :, :], base_valmask
+        upd[:, :, None], base_valmask & eff_mask[None, :, :], base_valmask
     )
     new_defines = base_defines | upd
     new_complement = jnp.where(
-        upd, base_complement & ~c.concrete[None, :], base_complement
+        upd, base_complement & ~eff_concrete[None, :], base_complement
     )
-    new_negative = jnp.where(upd, base_negative & c.negative[None, :], base_negative)
+    new_negative = jnp.where(upd, base_negative & eff_negative[None, :], base_negative)
     new_gt = jnp.where(upd, jnp.maximum(base_gt, c.gt[None, :]), base_gt)
     new_lt = jnp.where(upd, jnp.minimum(base_lt, c.lt[None, :]), base_lt)
 
@@ -274,6 +516,36 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics):
     new_template = jnp.where(fresh, s, state.template)
     new_capacity = jnp.where(fresh[:, None], BIG, state.capacity)
 
+    # -- topology count updates -------------------------------------------
+    # hostname groups: every placed pod this group counts lands on exactly
+    # its slot's hostname domain
+    new_hcount = state.hcount + take_all[:, None] * c.h_sel[None, :].astype(
+        jnp.int32
+    )
+    # label groups: spread/affinity record a placement only once the slot's
+    # key row is pinned to a single concrete value (topology.go:543-544);
+    # anti-affinity records every value the slot could take (:541-542)
+    def_c = new_defines & ~new_complement  # [N, K] concrete-defined
+    rowcount = jnp.sum(new_valmask, axis=2)  # [N, K]
+    w_pin = (take_all[:, None] * (def_c & (rowcount == 1))).astype(jnp.float32)
+    w_anti = (take_all[:, None] * def_c).astype(jnp.float32)
+    delta_pin = jnp.einsum("nk,nkv->kv", w_pin, new_valmask.astype(jnp.float32))
+    delta_anti = jnp.einsum("nk,nkv->kv", w_anti, new_valmask.astype(jnp.float32))
+    delta_g = jnp.where(
+        (statics.z_type == 1)[:, None],
+        delta_anti[statics.z_key],
+        delta_pin[statics.z_key],
+    )  # [Gz, V]
+    new_zcount = state.zcount + (
+        delta_g * c.z_sel[:, None].astype(jnp.float32)
+    ).astype(jnp.int32)
+
+    placed = m - unplaced_step
+    carry_after = carry0 - placed
+    unplaced = jnp.where(
+        is_wf, jnp.where(c.sub_last, carry_after, 0), unplaced_step
+    )
+
     state2 = SlotState(
         valmask=new_valmask,
         defines=new_defines,
@@ -288,6 +560,9 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics):
         template=new_template,
         next_free=state.next_free + n_new,
         overflow=overflow,
+        hcount=new_hcount,
+        zcount=new_zcount,
+        carry=carry_after,
     )
     return state2, (take_all, unplaced)
 

@@ -173,6 +173,55 @@ def encode_requirements_batch(
     )
 
 
+def decode_requirements(
+    vocab: FrozenVocab,
+    valmask_row: np.ndarray,  # [K, V] bool
+    defines_row: np.ndarray,  # [K] bool
+    complement_row: np.ndarray,  # [K] bool
+    gt_row: np.ndarray,  # [K] int32
+    lt_row: np.ndarray,  # [K] int32
+) -> "Requirements":
+    """Inverse of encode_requirements_batch for one entity row.
+
+    Rebuilds host Requirements from the device slot planes — used by the
+    decode path to materialize a fresh claim's joined requirements (template
+    ∧ joined classes ∧ topology tightenings) without replaying the host
+    algebra per add. Exact within the closed world: a complement row's
+    excluded set is reconstructed as the vocab values the mask rejects that
+    the Gt/Lt bounds alone would admit, so ``has()`` agrees with the
+    original for every value any solve entity can mention."""
+    from karpenter_core_tpu.scheduling.requirement import _within
+
+    reqs = Requirements()
+    for kid in np.nonzero(defines_row)[0]:
+        key = vocab.key_names[kid]
+        names = vocab.value_names[kid]
+        gt = int(gt_row[kid])
+        lt = int(lt_row[kid])
+        gt_o = gt if gt != GT_NONE else None
+        lt_o = lt if lt != LT_NONE else None
+        mask = valmask_row[kid]
+        if not complement_row[kid]:
+            vals = {names[v] for v in np.nonzero(mask[: len(names)])[0]}
+            reqs.add(Requirement(key, values=vals))
+        else:
+            excl = {
+                names[v]
+                for v in range(len(names))
+                if not mask[v] and _within(names[v], gt_o, lt_o)
+            }
+            reqs.add(
+                Requirement(
+                    key,
+                    complement=True,
+                    values=excl,
+                    greater_than=gt_o,
+                    less_than=lt_o,
+                )
+            )
+    return reqs
+
+
 def _requirement_mask(vocab: FrozenVocab, kid: int, req: Requirement) -> np.ndarray:
     """mask[v] = req.has(value_names[kid][v]) vectorized."""
     V = vocab.V
