@@ -73,7 +73,7 @@ from karpenter_core_tpu.ops.ffd import (
     SlotState,
     ffd_solve,
 )
-from karpenter_core_tpu.scheduling import Requirements, Taints
+from karpenter_core_tpu.scheduling import Requirement, Requirements, Taints
 from karpenter_core_tpu.solver.snapshot import PodClass, group_pods
 from karpenter_core_tpu.solver.vocab import (
     EntityMasks,
@@ -666,6 +666,7 @@ class DeviceScheduler:
             capacity=jnp.asarray(capacity),
             kind=jnp.asarray(kind),
             template=jnp.asarray(template_arr),
+            podcount=jnp.zeros((N,), dtype=jnp.int32),
             next_free=jnp.int32(E),
             overflow=jnp.asarray(False),
             hcount=jnp.asarray(hcount0),

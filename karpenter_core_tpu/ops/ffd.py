@@ -22,20 +22,26 @@ arithmetic over all open slots at once:
   and — for self-selecting label spreads — a water-fill quota per pinned
   sub-step, the batched equivalent of the reference's per-pod min-count
   domain selection (topologygroup.go:181-227);
-* placement — first-fit in slot order via exclusive cumulative sums;
-  leftovers open ceil(rem / kstar) identical fresh slots from the class's
-  chosen template.
+* placement — existing nodes first-fit in slot order via exclusive
+  cumulative sums, then in-flight claims by capped water-fill over per-slot
+  pod counts; leftovers open ceil(rem / kstar) identical fresh slots from
+  the class's chosen template.
 
 Instance-type narrowing rides a dedicated [N,T] viable mask (so the huge
 instance-type value vocabulary never enters the slot planes), and offering
 availability is evaluated against the slot's zone/capacity-type masks each
 step (the claim-requirements-vs-offering check of nodeclaim.go:252).
 
+Placement order mirrors the host policy (place_pod): existing nodes
+first-fit in slot order, then in-flight claims emptiest-first — a capped
+water-fill over per-slot pod counts (_waterfill_take), the batched
+equivalent of ``claims.sort(key=len(pods))`` before every add.
+
 Known, deliberate batching deviations from pod-at-a-time semantics
 (parity-tested in tests/test_device_solver.py and
-tests/test_device_topology.py): within one class placement is first-fit in
-slot order rather than emptiest-first (scheduler.go:277); same-shape classes
-are processed class-by-class rather than interleaved; a class's pods place
+tests/test_device_topology.py): emptiest-first ties break by slot creation
+index rather than the host's mutating-list order; same-shape classes are
+processed class-by-class rather than interleaved; a class's pods place
 atomically, so spread skew holds at class boundaries rather than at every
 pod; and non-self-selecting spread placements keep the admissible domain
 SET rather than pinning to the per-pod min-count domain, so such pods only
@@ -66,6 +72,8 @@ class SlotState(NamedTuple):
     capacity: jax.Array  # [N, R] float32 (existing slots; BIG for new)
     kind: jax.Array  # [N] int8: 0 unused, 1 existing, 2 new
     template: jax.Array  # [N] int32 (new slots; -1 otherwise)
+    podcount: jax.Array  # [N] int32 — pods placed per slot (drives the
+    # emptiest-first fill over in-flight claims, scheduler.py place_pod)
     next_free: jax.Array  # [] int32
     overflow: jax.Array  # [] bool
     # topology count state
@@ -334,6 +342,51 @@ def _host_caps(state: SlotState, c: ClassStep, statics: FFDStatics):
     return slot_cap, fresh_cap, single_slot
 
 
+# Level-search iterations: the water level is bounded by max(count) + m;
+# m is a class pod count with no structural cap, so cover int32.
+LEVEL_ITERS = 32
+
+
+def _level_fill(count, cap, adm, m, rank=None):
+    """Water-fill m units over admissible entries with per-entry caps.
+
+    Binary-search the level L with fill = clip(L - count, 0, cap) on
+    admissible entries, then hand the remainder one-each to the entries
+    sitting exactly at the level, lowest rank first (rank=None ties by
+    entry index via a cumsum — O(N), used for the slot axis)."""
+    cap = jnp.clip(cap, 0)
+
+    def fill_at(L):
+        return jnp.where(adm, jnp.clip(L - count, 0, cap), 0)
+
+    hi0 = jnp.max(jnp.where(adm, count, 0)) + m
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi + 1) // 2
+        ok = jnp.sum(fill_at(mid)) <= m
+        return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid - 1)
+
+    L, _ = jax.lax.fori_loop(0, LEVEL_ITERS, body, (jnp.int32(0), hi0))
+    fill = fill_at(L)
+    r = m - jnp.sum(fill)
+    elig = adm & (fill < cap) & (count + fill == L)
+    if rank is None:
+        erank = jnp.cumsum(elig) - elig  # exclusive: ties by entry index
+    else:
+        rk = jnp.where(elig, rank, RANK_NONE)
+        erank = jnp.sum((rk[None, :] < rk[:, None]) & elig[None, :], axis=1)
+    return fill + (elig & (erank < r))
+
+
+def _waterfill_take(count, cap, m):
+    """Distribute m pods over in-flight slots emptiest-first with per-slot
+    caps — the batched equivalent of the host policy's one-at-a-time "sort
+    claims by pod count, add to the first that admits" loop (scheduler.py
+    place_pod). count/cap/returns are [N] int32."""
+    return _level_fill(count, cap, cap > 0, m)
+
+
 def _wf_quota(state: SlotState, c: ClassStep, statics: FFDStatics, m):
     """Water-fill share of the pinned sub-step domain.
 
@@ -355,27 +408,7 @@ def _wf_quota(state: SlotState, c: ClassStep, statics: FFDStatics, m):
     mindom = statics.z_mindom[g]
     mindom_unsat = (mindom >= 0) & (supported < mindom)
     cap = jnp.where(mindom_unsat, jnp.clip(skew - counts, 0), BIGI)
-
-    def fill_at(L):
-        return jnp.where(padm, jnp.clip(L - counts, 0, cap), 0)
-
-    hi0 = jnp.max(jnp.where(padm, counts, 0)) + m
-
-    def body(_, lohi):
-        lo, hi = lohi
-        mid = (lo + hi + 1) // 2
-        ok = jnp.sum(fill_at(mid)) <= m
-        return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid - 1)
-
-    L, _ = jax.lax.fori_loop(0, 40, body, (jnp.int32(0), hi0))
-    fill = fill_at(L)
-    r = m - jnp.sum(fill)
-    post = counts + fill
-    elig = padm & (fill < cap) & (post == L)
-    rk = jnp.where(elig, statics.z_rank[g], RANK_NONE)
-    erank = jnp.sum((rk[None, :] < rk[:, None]) & elig[None, :], axis=1)
-    extra = elig & (erank < r)
-    quota = fill + extra
+    quota = _level_fill(counts, cap, padm, m, rank=statics.z_rank[g])
     return jnp.where(
         c.sub_value >= 0, quota[jnp.clip(c.sub_value, 0)], 0
     )
@@ -429,9 +462,16 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics):
     k_eff = jnp.minimum(k_max, slot_cap)
     k_eff = jnp.where(feasible, k_eff, 0)
 
-    # -- first-fit fill in slot order ------------------------------------
-    before = jnp.cumsum(k_eff) - k_eff  # exclusive prefix
-    take_normal = jnp.clip(m - before, 0, k_eff)  # [N]
+    # -- two-phase fill: existing nodes first-fit in slot order, then
+    # in-flight claims emptiest-first (place_pod: existing loop, then
+    # claims.sort(key=len(pods))) --------------------------------------
+    k_exist_eff = jnp.where(state.kind == 1, k_eff, 0)
+    before = jnp.cumsum(k_exist_eff) - k_exist_eff  # exclusive prefix
+    take_exist = jnp.clip(m - before, 0, k_exist_eff)  # [N]
+    rem_claims = m - jnp.sum(take_exist)
+    k_claim_eff = jnp.where(state.kind == 2, k_eff, 0)
+    take_claims = _waterfill_take(state.podcount, k_claim_eff, rem_claims)
+    take_normal = take_exist + take_claims
     first_feasible = feasible & (jnp.cumsum(feasible) == 1)
     take_single = jnp.where(first_feasible, jnp.minimum(k_eff, m), 0)
     take = jnp.where(single_slot, take_single, take_normal)
@@ -558,6 +598,7 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics):
         capacity=new_capacity,
         kind=new_kind,
         template=new_template,
+        podcount=state.podcount + take_all,
         next_free=state.next_free + n_new,
         overflow=overflow,
         hcount=new_hcount,
