@@ -73,16 +73,19 @@ def prefix_batches(
     for p in range(P):
         kind_batch[p, : p + 1] = 0
 
+    # label_aware=False matches the empty Topology() the sweep's prep was
+    # grouped under (the frontier bails on any topology-coupled pod)
     sig_to_ci = {
-        _spec_signature(cls.pods[0]): ci for ci, cls in enumerate(prep.classes)
+        _spec_signature(cls.pods[0], False): ci
+        for ci, cls in enumerate(prep.classes)
     }
     base_counts = np.zeros((C,), dtype=np.int32)
     for pod in base_pods:
-        base_counts[sig_to_ci[_spec_signature(pod)]] += 1
+        base_counts[sig_to_ci[_spec_signature(pod, False)]] += 1
     count_batch = np.tile(base_counts, (P, 1))
     for i, pods in enumerate(candidate_pods):
         for pod in pods:
-            count_batch[i:, sig_to_ci[_spec_signature(pod)]] += 1
+            count_batch[i:, sig_to_ci[_spec_signature(pod, False)]] += 1
     return kind_batch, count_batch
 
 

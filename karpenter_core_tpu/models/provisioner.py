@@ -277,7 +277,7 @@ class DeviceScheduler:
 
         # the topology planner decides which constraint shapes run in-kernel
         # (device count state) and which fall back to the host algebra
-        classes = self._sorted_classes(pods)
+        classes = self._sorted_classes(pods, topo)
         plan = topoplan.plan_topology(classes, topo)
         self._final_filter_cache: Dict[tuple, list] = {}
 
@@ -330,8 +330,11 @@ class DeviceScheduler:
 
     # ------------------------------------------------------------------
 
-    def _sorted_classes(self, pods: List[Pod]) -> List[PodClass]:
-        classes = group_pods(pods)
+    def _sorted_classes(self, pods: List[Pod], topo: Topology) -> List[PodClass]:
+        # labels/pod-affinity join the class key only when a topology group
+        # could observe them (see _spec_signature)
+        label_aware = bool(topo.topologies or topo.inverse_topologies)
+        classes = group_pods(pods, label_aware=label_aware)
         # class order = pod queue order lifted to classes (queue.go:76-112)
         classes.sort(
             key=lambda c: (
@@ -347,7 +350,7 @@ class DeviceScheduler:
     ) -> _Prepared:
         """Topology-free prepare entry for the consolidation sweep and the
         sharded-solver tests (callers guarantee no topology-coupled pods)."""
-        plan = topoplan.plan_topology(self._sorted_classes(pods), topo)
+        plan = topoplan.plan_topology(self._sorted_classes(pods, topo), topo)
         return self._prepare_with_vocab(plan, max_slots, topo)
 
     def _prepare_with_vocab(
@@ -1101,9 +1104,17 @@ class DeviceScheduler:
             g = dg.group
             kid = int(plan.z_key[gi])
             names = prep.vocab.value_names[kid]
-            for vid in np.nonzero(plan.z_domains[gi])[0]:
+            # union with nonzero count columns: the kernel can record
+            # placements on vocab values outside the registered universe (a
+            # counted-not-constrained class pinned to an unregistered
+            # domain); TopologyGroup.record creates new domain entries, so
+            # the sync must too or host-fallback replays see stale counters
+            cols = np.nonzero(plan.z_domains[gi] | (zcount[gi] != 0))[0]
+            for vid in cols:
                 name = names[vid]
                 cnt = max(int(zcount[gi, vid]), 0)
+                if name not in g.domains and cnt == 0:
+                    continue
                 g.domains[name] = cnt
                 if cnt > 0:
                     g.empty_domains.discard(name)

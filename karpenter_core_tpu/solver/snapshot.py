@@ -51,21 +51,42 @@ class PodClass:
         return len(self.pods)
 
 
-def _spec_signature(pod: Pod) -> tuple:
+def _spec_signature(pod: Pod, label_aware: bool) -> tuple:
     """Raw-spec equivalence key. Strictly finer than (or equal to) the
     requirement-level signature — two pods with identical selector/affinity/
     toleration/request/spread fields always produce identical Requirements —
-    so grouping by it is sound and skips building Requirements per pod."""
+    so grouping by it is sound and skips building Requirements per pod.
+
+    When the solve carries topology groups (label_aware), the key also
+    covers pod-(anti-)affinity terms and the pod's own labels: labels decide
+    which groups COUNT the pod (TopologyGroup.selects), terms decide which
+    groups CONSTRAIN it, so pods differing in either are not exchangeable.
+    Topology-free solves skip both so deployment-distinct labels don't
+    fragment the 50k-pod class collapse."""
     affinity_sig = None
-    if pod.affinity is not None and pod.affinity.node_affinity is not None:
-        na = pod.affinity.node_affinity
-        affinity_sig = (
-            tuple(na.required),
-            tuple(na.preferred),
-        )
+    pod_aff_sig = None
+    pod_anti_sig = None
+    if pod.affinity is not None:
+        if pod.affinity.node_affinity is not None:
+            na = pod.affinity.node_affinity
+            affinity_sig = (
+                tuple(na.required),
+                tuple(na.preferred),
+            )
+        if pod.affinity.pod_affinity is not None:
+            pa = pod.affinity.pod_affinity
+            pod_aff_sig = (tuple(pa.required), tuple(pa.preferred))
+        if pod.affinity.pod_anti_affinity is not None:
+            pa = pod.affinity.pod_anti_affinity
+            pod_anti_sig = (tuple(pa.required), tuple(pa.preferred))
     return (
         tuple(sorted(pod.node_selector.items())),
         affinity_sig,
+        pod_aff_sig,
+        pod_anti_sig,
+        tuple(sorted((pod.metadata.labels or {}).items()))
+        if label_aware
+        else (),
         tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)),
         tuple(sorted(pod.resource_requests.items())),
         tuple(pod.topology_spread_constraints),
@@ -76,7 +97,7 @@ def _spec_signature(pod: Pod) -> tuple:
     )
 
 
-def group_pods(pods: Sequence[Pod]) -> List[PodClass]:
+def group_pods(pods: Sequence[Pod], label_aware: bool = True) -> List[PodClass]:
     """Dedupe pods into equivalence classes. Signature covers everything the
     resource+requirements+taints solve observes; pods with affinity/spread
     constraints get their own per-constraint signatures (handled by the
@@ -84,7 +105,7 @@ def group_pods(pods: Sequence[Pod]) -> List[PodClass]:
     pod — the 50k-pod path spends its time here otherwise."""
     classes: Dict[tuple, PodClass] = {}
     for pod in pods:
-        sig = _spec_signature(pod)
+        sig = _spec_signature(pod, label_aware)
         cls = classes.get(sig)
         if cls is None:
             cls = PodClass(

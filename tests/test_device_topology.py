@@ -1,0 +1,282 @@
+"""Greedy-vs-device parity for the topology-aware kernel paths.
+
+This is the suite the batching-deviation contracts in ops/ffd.py and
+ops/topoplan.py point at: for each constraint shape the device solver
+(class-batched scan + device count state + plane decode) must produce a
+final state that (a) satisfies the constraints outright and (b) lands
+within node-count tolerance of the greedy oracle
+(reference semantics: topologygroup.go:181-342, scheduler.go:208-316).
+Covers zone/hostname spread (water-fill sub-steps), affinity bootstrap,
+hostname anti-affinity, existing-node seeding, and the deferred / fallback
+decode paths (hostPort pods, non-trivial spread node filters).
+"""
+import copy
+from collections import Counter
+
+import pytest
+
+from tests.helpers import GIB, make_diverse_pods, make_nodepool, make_pod
+from tests.test_topology import (
+    CATALOG,
+    claim_zone,
+    three_zone_pool,
+    zone_counts,
+)
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.api.objects import NodeSelectorRequirement
+from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import SimNode
+from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import Scheduler
+from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+
+def both_solve(pods, pools=None, existing=None, max_slots=64):
+    pools = pools or [three_zone_pool()]
+    g = Scheduler(pools, {p.name: CATALOG for p in pools},
+                  existing_nodes=list(existing or []))
+    rg = g.solve(copy.deepcopy(pods))
+    d = DeviceScheduler(pools, {p.name: CATALOG for p in pools},
+                        existing_nodes=list(existing or []),
+                        max_slots=max_slots)
+    rd = d.solve(copy.deepcopy(pods))
+    return rg, rd
+
+
+def assert_node_parity(rg, rd, tol=0):
+    assert set(rg.pod_errors) == set(rd.pod_errors), (
+        rg.pod_errors, rd.pod_errors)
+    assert abs(rd.node_count() - rg.node_count()) <= tol, (
+        f"device {rd.node_count()} vs greedy {rg.node_count()}")
+
+
+def pods_per_node(res):
+    """Pod lists per placement target (claims + touched existing nodes)."""
+    out = [list(c.pods) for c in res.new_node_claims]
+    out += [list(s.pods) for s in res.existing_nodes if s.pods]
+    return out
+
+
+class TestZoneSpreadParity:
+    def test_even_spread(self):
+        rg, rd = both_solve([make_pod(cpu=1.0, spread_zone=True)
+                             for _ in range(9)])
+        assert_node_parity(rg, rd)
+        assert zone_counts(rd) == {"zone-a": 3, "zone-b": 3, "zone-c": 3}
+
+    def test_skew_two(self):
+        pods = [make_pod(cpu=1.0, spread_zone=True, max_skew=2)
+                for _ in range(7)]
+        rg, rd = both_solve(pods)
+        assert_node_parity(rg, rd, tol=1)
+        counts = zone_counts(rd)
+        assert max(counts.values()) - min(counts.values() or [0]) <= 2, counts
+
+    def test_waterfill_against_imbalanced_existing(self):
+        # zone-a pre-loaded with 4 spread pods on an existing node; new
+        # spread pods must water-fill zone-b/zone-c first (the multi-sub-step
+        # carry path in _wf_quota)
+        node = SimNode(
+            name="existing-a",
+            labels={L.LABEL_TOPOLOGY_ZONE: "zone-a",
+                    L.LABEL_HOSTNAME: "existing-a",
+                    L.LABEL_OS: "linux",
+                    L.CAPACITY_TYPE_LABEL_KEY: "on-demand",
+                    L.NODEPOOL_LABEL_KEY: "default"},
+            taints=[],
+            available={"cpu": 16.0, "memory": 32 * GIB, "pods": 110.0},
+            initialized=True,
+        )
+        pods = [make_pod(cpu=0.5, spread_zone=True) for _ in range(8)]
+
+        def mk():
+            from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+                Topology, domain_universe,
+            )
+            pool = three_zone_pool()
+            seeds = []
+            for i in range(4):
+                sp = make_pod(cpu=0.1, labels={"app": "spread"},
+                              name=f"seed-{i}")
+                sp.node_name = "existing-a"  # bound pods count for topology
+                seeds.append((sp, dict(node.labels), "existing-a"))
+            topo = Topology(
+                domains={k: set(v) for k, v in domain_universe(
+                    [pool], {"default": CATALOG}, [node]).items()},
+                existing_pods=seeds,
+            )
+            return pool, topo
+
+        pool, topo_g = mk()
+        g = Scheduler([pool], {"default": CATALOG}, existing_nodes=[node],
+                      topology=topo_g)
+        rg = g.solve(copy.deepcopy(pods))
+        pool2, topo_d = mk()
+        d = DeviceScheduler([pool2], {"default": CATALOG},
+                            existing_nodes=[node], topology=topo_d,
+                            max_slots=64)
+        rd = d.solve(copy.deepcopy(pods))
+        assert rg.all_pods_scheduled() and rd.all_pods_scheduled(), (
+            rg.pod_errors, rd.pod_errors)
+        # zone-a starts at 4: the 8 new pods must lift b/c to 4 each under
+        # maxSkew=1 (4/4/4); none lands in zone-a
+        for res in (rg, rd):
+            zc = zone_counts(res)
+            assert zc.get("zone-b", 0) == 4 and zc.get("zone-c", 0) == 4, zc
+
+
+class TestHostnameSpreadParity:
+    def test_one_per_node(self):
+        # maxSkew=1 on hostname with min floating at zero: every pod takes a
+        # fresh hostname (topologygroup.go:235-238)
+        pods = [make_pod(cpu=0.5, spread_hostname=True) for _ in range(5)]
+        rg, rd = both_solve(pods)
+        assert_node_parity(rg, rd)
+        for group in pods_per_node(rd):
+            assert sum(1 for p in group
+                       if p.metadata.labels.get("app") == "spread") <= 1
+
+    def test_mixed_with_generic(self):
+        pods = [make_pod(cpu=0.5, spread_hostname=True) for _ in range(4)]
+        pods += [make_pod(cpu=0.25, name=f"filler-{i}") for i in range(12)]
+        rg, rd = both_solve(pods)
+        assert_node_parity(rg, rd, tol=1)
+
+
+class TestAntiAffinityParity:
+    def test_self_anti_one_per_node(self):
+        pods = [
+            make_pod(cpu=0.5, labels={"app": "anti"},
+                     anti_affinity_to={"app": "anti"},
+                     affinity_key=L.LABEL_HOSTNAME,
+                     name=f"anti-{i}")
+            for i in range(6)
+        ]
+        rg, rd = both_solve(pods)
+        assert_node_parity(rg, rd)
+        for group in pods_per_node(rd):
+            assert sum(1 for p in group
+                       if p.metadata.labels.get("app") == "anti") <= 1
+
+    def test_anti_copacks_with_fillers(self):
+        # emptiest-first must co-pack fillers onto anti-opened nodes instead
+        # of fragmenting (the r4 parity fix)
+        pods = [
+            make_pod(cpu=0.25, labels={"app": "anti"},
+                     anti_affinity_to={"app": "anti"},
+                     affinity_key=L.LABEL_HOSTNAME, name=f"anti-{i}")
+            for i in range(4)
+        ]
+        pods += [make_pod(cpu=0.25, name=f"filler-{i}") for i in range(8)]
+        rg, rd = both_solve(pods)
+        assert_node_parity(rg, rd, tol=1)
+
+
+class TestAffinityParity:
+    def test_zone_affinity_bootstrap_colocates(self):
+        # self-affinity on zone: first pod bootstraps a domain, the rest
+        # must follow it (nextDomainAffinity topologygroup.go:253-300)
+        pods = [
+            make_pod(cpu=0.5, labels={"app": "web"},
+                     affinity_to={"app": "web"}, name=f"web-{i}")
+            for i in range(5)
+        ]
+        rg, rd = both_solve(pods)
+        assert rg.all_pods_scheduled() and rd.all_pods_scheduled(), (
+            rg.pod_errors, rd.pod_errors)
+        for res in (rg, rd):
+            zones = {claim_zone(c) for c in res.new_node_claims if c.pods}
+            assert len(zones) == 1, zones
+        assert_node_parity(rg, rd, tol=1)
+
+    def test_affinity_follows_existing(self):
+        # a target pod already running in zone-b pins the affinity domain
+        node = SimNode(
+            name="existing-b",
+            labels={L.LABEL_TOPOLOGY_ZONE: "zone-b",
+                    L.LABEL_HOSTNAME: "existing-b",
+                    L.LABEL_OS: "linux",
+                    L.CAPACITY_TYPE_LABEL_KEY: "on-demand",
+                    L.NODEPOOL_LABEL_KEY: "default"},
+            taints=[],
+            available={"cpu": 2.0, "memory": 4 * GIB, "pods": 110.0},
+            initialized=True,
+        )
+        from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+            Topology, domain_universe,
+        )
+
+        def solve(cls):
+            pool = three_zone_pool()
+            tgt = make_pod(cpu=0.1, labels={"app": "db"}, name="tgt")
+            tgt.node_name = "existing-b"  # bound pods count for topology
+            topo = Topology(
+                domains={k: set(v) for k, v in domain_universe(
+                    [pool], {"default": CATALOG}, [node]).items()},
+                existing_pods=[(tgt, dict(node.labels), "existing-b")],
+            )
+            s = cls([pool], {"default": CATALOG}, existing_nodes=[node],
+                    topology=topo)
+            return s.solve([
+                make_pod(cpu=4.0, affinity_to={"app": "db"},
+                         name=f"follower-{i}") for i in range(3)
+            ])
+
+        rg, rd = solve(Scheduler), solve(DeviceScheduler)
+        for res in (rg, rd):
+            assert res.all_pods_scheduled(), res.pod_errors
+            for c in res.new_node_claims:
+                if c.pods:
+                    assert claim_zone(c) == "zone-b"
+        assert_node_parity(rg, rd, tol=1)
+
+
+class TestFallbackPaths:
+    def test_hostport_topology_pod_falls_back(self):
+        # hostPort + topology constraints is host-fallback territory
+        # (topoplan._eligibility); result must still satisfy both
+        pods = [make_pod(cpu=0.5, spread_zone=True) for _ in range(6)]
+        for i, p in enumerate(pods[:2]):
+            p.host_ports = [("0.0.0.0", 8080, "TCP")]
+        rg, rd = both_solve(pods)
+        assert set(rg.pod_errors) == set(rd.pod_errors)
+        # the two hostPort pods must sit on different nodes
+        for res in (rg, rd):
+            for group in pods_per_node(res):
+                assert sum(1 for p in group if p.host_ports) <= 1
+        assert_node_parity(rg, rd, tol=1)
+
+    def test_spread_with_node_filter_is_host_only(self):
+        # a spread whose pod carries zonal node-affinity: the TopologyGroup
+        # gets a non-trivial node filter -> host-only group (topoplan)
+        pods = [make_pod(cpu=0.5, spread_zone=True,
+                         zone_in=["zone-a", "zone-b"]) for _ in range(4)]
+        pods += [make_pod(cpu=0.5, name=f"plain-{i}") for i in range(4)]
+        rg, rd = both_solve(pods)
+        assert set(rg.pod_errors) == set(rd.pod_errors)
+        for res in (rg, rd):
+            zc = Counter()
+            for c in res.new_node_claims:
+                n = sum(1 for p in c.pods
+                        if p.metadata.labels.get("app") == "spread")
+                if n:
+                    zc[claim_zone(c)] += n
+            assert set(zc) <= {"zone-a", "zone-b"}, zc
+            if zc:
+                assert max(zc.values()) - min(zc.values()) <= 1, zc
+        assert_node_parity(rg, rd, tol=1)
+
+
+class TestDiverseMixParity:
+    @pytest.mark.parametrize("seed", [2, 3, 4, 5, 6, 7])
+    def test_diverse_mix_more_seeds(self, seed):
+        pods = make_diverse_pods(48, seed=seed, with_topology=True)
+        rg, rd = both_solve(pods)
+        assert set(rg.pod_errors) == set(rd.pod_errors), (
+            rg.pod_errors, rd.pod_errors)
+        # constraint satisfaction on the device result
+        for group in pods_per_node(rd):
+            assert sum(1 for p in group
+                       if p.metadata.labels.get("app") == "anti") <= 1
+        if rg.node_count():
+            assert abs(rd.node_count() - rg.node_count()) <= max(
+                2, 0.15 * rg.node_count())
