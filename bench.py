@@ -11,11 +11,17 @@ Secondary lines (reported in `detail`):
                   100-candidate cap evaluated as ONE vmapped device call
                   (vs log2(100) full host simulations upstream)
 
-cfg3 (topology) joins once device-side topology lands. Prints ONE JSON
-line; vs_baseline is pods/sec over the reference's enforced 100 pods/sec
-floor. Runs on whatever backend JAX selects (real TPU chip under the
-driver). Env knobs: BENCH_PODS / BENCH_TYPES (primary config),
-BENCH_FAST=1 (primary only).
+  cfg3_topology   the reference's diverse benchmark mix (1/6 each generic,
+                  zonal, selector, zone-spread, hostname-spread, hostname
+                  anti-affinity; scheduling_benchmark_test.go:233-247) at
+                  5k pods, through the device topology kernel
+
+Every config reports `parity_nodes_delta` = device nodes − greedy nodes
+on the identical pod set (the north star demands node-count parity, not
+just all-scheduled). Prints ONE JSON line; vs_baseline is pods/sec over
+the reference's enforced 100 pods/sec floor. Runs on whatever backend JAX
+selects (real TPU chip under the driver). Env knobs: BENCH_PODS /
+BENCH_TYPES (primary config), BENCH_FAST=1 (primary only, skips parity).
 """
 from __future__ import annotations
 
@@ -122,7 +128,118 @@ def _masked_pods(n):
     return pods
 
 
-def _solve_bench(pods, nodepools, catalog, max_slots=1024, repeats=5):
+def _topology_pods(n, n_deploys=10):
+    """BASELINE cfg3: the reference benchmark's diverse mix
+    (scheduling_benchmark_test.go:233-247) — 1/6 each generic, zonal
+    node-affinity, nodeSelector, zone spread, hostname spread, hostname
+    anti-affinity — in deployment-style cohorts (shared labels/selectors)
+    so classes collapse the way real workloads do."""
+    from karpenter_core_tpu.api import labels as L
+    from karpenter_core_tpu.api.objects import (
+        Affinity,
+        LabelSelector,
+        NodeAffinity,
+        NodeSelectorRequirement,
+        NodeSelectorTerm,
+        ObjectMeta,
+        Pod,
+        PodAffinity,
+        PodAffinityTerm,
+        TopologySpreadConstraint,
+    )
+
+    def selector(labels):
+        return LabelSelector(match_labels=tuple(sorted(labels.items())))
+
+    pods = []
+    for i in range(n):
+        kind = i % 6
+        dep = (i // 6) % n_deploys
+        requests = {
+            "cpu": 0.1 * (1 + i % 8),
+            "memory": 0.25 * GIB * (1 + (i // 8) % 6),
+        }
+        name = f"t{i}"
+        if kind == 0:
+            pods.append(Pod(metadata=ObjectMeta(name=name),
+                            resource_requests=requests))
+        elif kind == 1:
+            pods.append(Pod(
+                metadata=ObjectMeta(name=name),
+                resource_requests=requests,
+                affinity=Affinity(node_affinity=NodeAffinity(required=[
+                    NodeSelectorTerm(match_expressions=(
+                        NodeSelectorRequirement(
+                            L.LABEL_TOPOLOGY_ZONE, "In",
+                            ("zone-a", "zone-b")),
+                    ))
+                ])),
+            ))
+        elif kind == 2:
+            pods.append(Pod(
+                metadata=ObjectMeta(name=name),
+                resource_requests=requests,
+                node_selector={L.LABEL_OS: "linux"},
+            ))
+        elif kind == 3:
+            labels = {"app": f"spread-z-{dep}"}
+            pods.append(Pod(
+                metadata=ObjectMeta(name=name, labels=labels),
+                resource_requests=requests,
+                topology_spread_constraints=[TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=L.LABEL_TOPOLOGY_ZONE,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=selector(labels),
+                )],
+            ))
+        elif kind == 4:
+            labels = {"app": f"spread-h-{dep}"}
+            pods.append(Pod(
+                metadata=ObjectMeta(name=name, labels=labels),
+                resource_requests=requests,
+                topology_spread_constraints=[TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=L.LABEL_HOSTNAME,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=selector(labels),
+                )],
+            ))
+        else:
+            labels = {"app": f"anti-{dep}"}
+            pods.append(Pod(
+                metadata=ObjectMeta(name=name, labels=labels),
+                resource_requests=requests,
+                affinity=Affinity(pod_anti_affinity=PodAffinity(required=[
+                    PodAffinityTerm(
+                        topology_key=L.LABEL_HOSTNAME,
+                        label_selector=selector(labels),
+                    )
+                ])),
+            ))
+    return pods
+
+
+def _greedy_nodes(pods, nodepools, catalog):
+    """One greedy-oracle solve on the identical inputs; returns (nodes, s)."""
+    import copy
+
+    from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+        Scheduler,
+    )
+
+    its = {p.name: list(catalog) for p in nodepools}
+    s = Scheduler(copy.deepcopy(nodepools), its)
+    pods = copy.deepcopy(pods)  # outside the timed window
+    t0 = time.perf_counter()
+    res = s.solve(pods)
+    dt = time.perf_counter() - t0
+    assert res.all_pods_scheduled(), list(res.pod_errors.items())[:3]
+    return res.node_count(), dt
+
+
+def _solve_bench(pods, nodepools, catalog, max_slots=1024, repeats=5,
+                 parity=True):
     from karpenter_core_tpu.models.provisioner import DeviceScheduler
 
     its = {p.name: list(catalog) for p in nodepools}
@@ -139,12 +256,18 @@ def _solve_bench(pods, nodepools, catalog, max_slots=1024, repeats=5):
         res = sched.solve(pods)
         times.append(time.perf_counter() - t0)
     p50 = sorted(times)[len(times) // 2]
-    return {
+    out = {
         "p50_solve_s": round(p50, 3),
         "cold_solve_s": round(cold, 3),
         "pods_per_sec": round(len(pods) / p50, 1),
         "nodes": res.node_count(),
     }
+    if parity:
+        greedy_nodes, greedy_s = _greedy_nodes(pods, nodepools, catalog)
+        out["greedy_nodes"] = greedy_nodes
+        out["greedy_solve_s"] = round(greedy_s, 1)
+        out["parity_nodes_delta"] = res.node_count() - greedy_nodes
+    return out
 
 
 def _consolidation_bench(n_nodes=2000, n_candidates=100, repeats=3):
@@ -240,7 +363,9 @@ def main():
 
     catalog = bench_catalog(N_TYPES)
 
-    primary = _solve_bench(_plain_pods(N_PODS), [_pool()], catalog)
+    primary = _solve_bench(
+        _plain_pods(N_PODS), [_pool()], catalog, parity=not FAST
+    )
     detail = {"primary": primary}
 
     if not FAST:
@@ -266,6 +391,13 @@ def main():
         masked_pools[1].spec.template.labels["pool"] = "batch"
         detail["cfg2_masked"] = _solve_bench(
             _masked_pods(N_PODS), masked_pools, catalog
+        )
+        detail["cfg3_topology"] = _solve_bench(
+            _topology_pods(5000),
+            [_pool()],
+            bench_catalog(400),
+            max_slots=2048,
+            repeats=3,
         )
         detail["cfg4_consol"] = _consolidation_bench()
 

@@ -254,6 +254,17 @@ POD_SUCCEEDED = "Succeeded"
 POD_FAILED = "Failed"
 
 
+@dataclass(frozen=True)
+class PodVolume:
+    """One pod volume spec entry. Only PVC-backed shapes matter to
+    scheduling (emptyDir/hostPath etc. are represented by pvc_name=None and
+    ignored, reference volumetopology.go:86-88)."""
+
+    name: str
+    pvc_name: Optional[str] = None  # persistentVolumeClaim.claimName
+    ephemeral: bool = False  # generic ephemeral volume -> PVC "<pod>-<name>"
+
+
 @dataclass
 class Pod:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
@@ -266,6 +277,15 @@ class Pod:
     tolerations: list = field(default_factory=list)
     topology_spread_constraints: list = field(default_factory=list)
     host_ports: list = field(default_factory=list)  # list[(ip, port, protocol)]
+    volumes: list = field(default_factory=list)  # list[PodVolume]
+    # zone/etc requirements derived from this pod's PVCs, stamped by
+    # VolumeTopology.inject pre-solve; AND'd into the pod's requirements by
+    # Requirements.from_pod so relaxation can never strip them
+    # (volumetopology.go:68-72's per-term injection, lifted out of the spec)
+    volume_requirements: list = field(default_factory=list)
+    # {csi driver -> set of pvc keys}, resolved pre-solve for attach-limit
+    # accounting without a client in the scheduler (volumeusage.go GetVolumes)
+    resolved_volumes: Optional[dict] = None
     priority: int = 0
     priority_class_name: str = ""
     preemption_policy: str = "PreemptLowerPriority"
@@ -332,3 +352,73 @@ class Node:
 
     def ready(self) -> bool:
         return any(t == "Ready" and s == "True" for t, s, *_ in self.status.conditions)
+
+
+# ---------------------------------------------------------------------------
+# Storage (PVC/PV/StorageClass/CSINode/VolumeAttachment — the surface the
+# volume-aware scheduling + termination paths consume; reference:
+# volumetopology.go:45-150, volumeusage.go:82-150,
+# node/termination/controller.go:190-201)
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    storage_class_name: Optional[str] = None
+    volume_name: str = ""  # bound PV name ("" = unbound)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    # required node-affinity terms (ORed; zone-pinning for zonal volumes)
+    node_affinity_required: list = field(default_factory=list)  # [NodeSelectorTerm]
+    csi_driver: str = ""  # spec.csi.driver ("" = non-CSI)
+    local: bool = False  # spec.local / spec.hostPath: hostname affinity is
+    host_path: bool = False  # dropped on reschedule (volumetopology.go:141-146)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class StorageClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    provisioner: str = ""
+    # [(key, values)] from allowedTopologies[0].matchLabelExpressions
+    allowed_topologies: list = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class CSINode:
+    """Per-node CSI driver attach limits (name == node name)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    drivers: list = field(default_factory=list)  # [(driver name, allocatable)]
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclass
+class VolumeAttachment:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    attacher: str = ""
+    node_name: str = ""
+    pv_name: str = ""
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name

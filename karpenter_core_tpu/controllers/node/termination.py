@@ -57,6 +57,12 @@ class NodeTermination:
         ):
             return  # wait for drain to finish
 
+        # wait for drain-able pods' VolumeAttachments to detach before
+        # terminating (controller.go:140-143,190-201); attachments held by
+        # non-drain-able pods must not block forever (filterVolumeAttachments)
+        if not self._volumes_detached(node):
+            return
+
         # ensure the instance is gone (claims' finalizers handle provider
         # delete; cover unmanaged/orphan nodes too)
         for c in claims:
@@ -71,3 +77,32 @@ class NodeTermination:
                 self.kube.update(node)
             except NotFoundError:
                 pass  # provider delete already removed the node object
+
+    def _volumes_detached(self, node: Node) -> bool:
+        """True when no blocking VolumeAttachment remains on the node. An
+        attachment blocks only if no non-drain-able pod on the node still
+        uses its PV (controller.go:203-237 filterVolumeAttachments)."""
+        from karpenter_core_tpu.api.objects import PersistentVolumeClaim
+        from karpenter_core_tpu.scheduling.volumeusage import pvc_name_for
+
+        attachments = [
+            va
+            for va in self.kube.list_volume_attachments()
+            if va.node_name == node.name
+        ]
+        if not attachments:
+            return True
+        shielded_pvs = set()
+        for p in self.cluster.pods_on_node(node.name):
+            if podutil.is_evictable(p) and not p.is_daemonset:
+                continue  # drain-able: its attachments DO block
+            for vol in p.volumes:
+                claim_name = pvc_name_for(p, vol)
+                if claim_name is None:
+                    continue
+                pvc = self.kube.get(
+                    PersistentVolumeClaim, claim_name, p.metadata.namespace
+                )
+                if pvc is not None and pvc.volume_name:
+                    shielded_pvs.add(pvc.volume_name)
+        return all(va.pv_name in shielded_pvs for va in attachments)

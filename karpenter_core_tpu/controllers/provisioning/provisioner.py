@@ -97,6 +97,7 @@ class Provisioner:
             for np in nodepools
         }
         sim_nodes = self.cluster.sim_nodes()
+        self._attach_volume_state(sim_nodes)
         topology = Topology(
             domains=domain_universe(nodepools, instance_types, sim_nodes),
             existing_pods=self.cluster.existing_pod_triples(),
@@ -120,8 +121,62 @@ class Provisioner:
         pods = self.pending_pods() + self.deleting_node_pods()
         if not pods:
             return Results([], [], {}), []
+        pods, volume_errors = self._prepare_volumes(pods)
+        if not pods:
+            return Results([], [], volume_errors), []
         scheduler = self.new_scheduler(pods)
-        return scheduler.solve(pods), pods
+        results = scheduler.solve(pods)
+        results.pod_errors.update(volume_errors)
+        return results, pods
+
+    # -- volume preprocessing (volumetopology.go inject+validate,
+    # provisioner.go:436-516) ---------------------------------------------
+
+    def _prepare_volumes(self, pods: List[Pod]):
+        from karpenter_core_tpu.controllers.provisioning.scheduling.volumetopology import (
+            VolumeTopology,
+        )
+        from karpenter_core_tpu.scheduling.volumeusage import get_volumes
+
+        vt = VolumeTopology(self.kube)
+        keep: List[Pod] = []
+        errors: Dict[str, str] = {}
+        for p in pods:
+            if not p.volumes:
+                keep.append(p)
+                continue
+            err = vt.validate_pvcs(p)
+            if err is not None:
+                errors[p.uid] = err
+                continue
+            vt.inject(p)
+            p.resolved_volumes = get_volumes(self.kube, p) or None
+            keep.append(p)
+        return keep, errors
+
+    def _attach_volume_state(self, sim_nodes) -> None:
+        """Per-node CSINode limits + bound pods' volume usage
+        (statenode volume tracking, volumeusage.go Add/AddLimit)."""
+        from karpenter_core_tpu.api.objects import CSINode
+        from karpenter_core_tpu.scheduling.volumeusage import (
+            VolumeUsage,
+            get_volumes,
+        )
+
+        for sn in sim_nodes:
+            csinode = self.kube.get(CSINode, sn.name)
+            if csinode is None:
+                continue
+            usage = VolumeUsage()
+            for driver, allocatable in csinode.drivers:
+                usage.add_limit(driver, allocatable)
+            for p in self.cluster.pods_on_node(sn.name):
+                if p.resolved_volumes is None and p.volumes:
+                    # stamp once; volumes are immutable between binds
+                    p.resolved_volumes = get_volumes(self.kube, p) or {}
+                if p.resolved_volumes:
+                    usage.add(p.resolved_volumes)
+            sn.volume_usage = usage
 
     # -- output: NodeClaims + nominations ----------------------------------
 

@@ -218,6 +218,9 @@ class SimNode:
     initialized: bool = True
     nodeclaim_name: str = ""
     nodepool_name: str = ""
+    # CSI attach-limit state (volumeusage.go): filled by the provisioner
+    # from the node's CSINode + bound pods; None = no volume tracking
+    volume_usage: Optional[object] = None
 
 
 class ExistingNodeSim:
@@ -241,6 +244,11 @@ class ExistingNodeSim:
         )
         topology.register(apilabels.LABEL_HOSTNAME, node.name)
         self.host_port_usage = HostPortUsage()
+        # per-sim copy: hypothesized placements must not leak into the
+        # node's baseline usage across solves/relaxation rounds
+        self.volume_usage = (
+            node.volume_usage.copy() if node.volume_usage is not None else None
+        )
 
     @property
     def name(self) -> str:
@@ -254,6 +262,10 @@ class ExistingNodeSim:
         conflict = self.host_port_usage.conflicts(pod, pod.host_ports)
         if conflict:
             raise IncompatibleError(conflict)
+
+        err = self._volume_limit_error([pod])
+        if err:
+            raise IncompatibleError(err)
 
         requests = resutil.merge(self.requests, pod_requests)
         if not resutil.fits(requests, self.cached_available):
@@ -287,6 +299,7 @@ class ExistingNodeSim:
         self.requirements = node_requirements
         self.topology.record(pod, node_requirements)
         self.host_port_usage.add(pod, pod.host_ports)
+        self._record_volumes([pod])
 
     def add_group(self, pods: List[Pod], per_pod_requests: dict) -> None:
         """Batch-add k identical pods; same preconditions as
@@ -295,6 +308,10 @@ class ExistingNodeSim:
         errs = Taints(self.cached_taints).tolerates(pod)
         if errs:
             raise IncompatibleError("; ".join(errs))
+
+        err = self._volume_limit_error(pods)
+        if err:
+            raise IncompatibleError(err)
 
         requests = resutil.merge_repeated(
             self.requests, per_pod_requests, len(pods)
@@ -312,3 +329,31 @@ class ExistingNodeSim:
         self.pods.extend(pods)
         self.requests = requests
         self.requirements = node_requirements
+        self._record_volumes(pods)
+
+    # -- CSI attach limits (existingnode.go:84-90; new claims have no
+    # CSINode yet so only existing nodes enforce them) --------------------
+
+    def _pods_volumes(self, pods: List[Pod]) -> Optional[dict]:
+        from karpenter_core_tpu.scheduling import volumeusage as vu
+
+        joined: dict = {}
+        for p in pods:
+            if p.resolved_volumes:
+                joined = vu.union(joined, p.resolved_volumes)
+        return joined or None
+
+    def _volume_limit_error(self, pods: List[Pod]) -> Optional[str]:
+        if self.volume_usage is None:
+            return None
+        vols = self._pods_volumes(pods)
+        if vols is None:
+            return None
+        return self.volume_usage.exceeds_limits(vols)
+
+    def _record_volumes(self, pods: List[Pod]) -> None:
+        if self.volume_usage is None:
+            return
+        vols = self._pods_volumes(pods)
+        if vols is not None:
+            self.volume_usage.add(vols)

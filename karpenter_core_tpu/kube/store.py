@@ -24,9 +24,14 @@ from karpenter_core_tpu.api.nodepool import NodePool
 from karpenter_core_tpu.api.objects import (
     POD_PENDING,
     POD_RUNNING,
+    CSINode,
     DaemonSet,
     Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
     Pod,
+    StorageClass,
+    VolumeAttachment,
 )
 
 ADDED = "ADDED"
@@ -39,7 +44,15 @@ _KINDS = {
     NodeClaim: "NodeClaim",
     NodePool: "NodePool",
     DaemonSet: "DaemonSet",
+    PersistentVolumeClaim: "PersistentVolumeClaim",
+    PersistentVolume: "PersistentVolume",
+    StorageClass: "StorageClass",
+    CSINode: "CSINode",
+    VolumeAttachment: "VolumeAttachment",
 }
+
+# namespaced kinds key by namespace/name
+_NAMESPACED = {"Pod", "PersistentVolumeClaim"}
 
 
 class ConflictError(Exception):
@@ -58,7 +71,7 @@ def _kind_of(obj) -> str:
 
 
 def _key_of(kind: str, obj) -> str:
-    if kind == "Pod":
+    if kind in _NAMESPACED:
         return f"{obj.metadata.namespace}/{obj.metadata.name}"
     return obj.metadata.name
 
@@ -106,7 +119,7 @@ class KubeStore:
 
     def get(self, cls, name: str, namespace: str = "default"):
         kind = _KINDS[cls]
-        key = f"{namespace}/{name}" if kind == "Pod" else name
+        key = f"{namespace}/{name}" if kind in _NAMESPACED else name
         return self._objects[kind].get(key)
 
     def update(self, obj) -> object:
@@ -170,13 +183,61 @@ class KubeStore:
     def get_node_by_provider_id(self, provider_id: str) -> Optional[Node]:
         return self._nodes_by_pid.get(provider_id)
 
+    def list_volume_attachments(self) -> List[VolumeAttachment]:
+        return list(self._objects["VolumeAttachment"].values())
+
     # -- pod verbs --------------------------------------------------------
 
     def bind(self, pod: Pod, node_name: str) -> None:
-        """kube-scheduler Binding subresource stand-in."""
+        """kube-scheduler Binding subresource stand-in. Bound PVs grow a
+        VolumeAttachment (the attach-detach controller's role); detach on
+        unbind is immediate unless a test injects slow-CSI attachments."""
         pod.node_name = node_name
         pod.phase = POD_RUNNING
         self.update(pod)
+        for pv_name, driver in self._bound_pvs(pod):
+            va_name = f"va-{node_name}-{pv_name}"
+            if self.get(VolumeAttachment, va_name) is None:
+                from karpenter_core_tpu.api.objects import ObjectMeta
+
+                self.create(
+                    VolumeAttachment(
+                        metadata=ObjectMeta(name=va_name),
+                        attacher=driver,
+                        node_name=node_name,
+                        pv_name=pv_name,
+                    )
+                )
+
+    def _bound_pvs(self, pod: Pod):
+        from karpenter_core_tpu.scheduling.volumeusage import pvc_name_for
+
+        for vol in pod.volumes:
+            claim_name = pvc_name_for(pod, vol)
+            if claim_name is None:
+                continue
+            pvc = self.get(
+                PersistentVolumeClaim, claim_name, pod.metadata.namespace
+            )
+            if pvc is None or not pvc.volume_name:
+                continue
+            pv = self.get(PersistentVolume, pvc.volume_name)
+            yield pvc.volume_name, (pv.csi_driver if pv else "")
+
+    def _detach_unreferenced(self, pod: Pod, node_name: str) -> None:
+        """Remove VolumeAttachments for PVs no pod on the node still uses."""
+        if not node_name or not pod.volumes:
+            return
+        still_used = set()
+        for p in self._objects["Pod"].values():
+            if p.node_name == node_name and p is not pod:
+                still_used.update(name for name, _ in self._bound_pvs(p))
+        for pv_name, _ in self._bound_pvs(pod):
+            if pv_name in still_used:
+                continue
+            va = self.get(VolumeAttachment, f"va-{node_name}-{pv_name}")
+            if va is not None:
+                self.delete(va)
 
     def evict(self, pod: Pod) -> None:
         """Eviction API stand-in. A replicated workload's pod returns to
@@ -186,9 +247,11 @@ class KubeStore:
         key = _key_of("Pod", pod)
         if key not in self._objects["Pod"]:
             raise NotFoundError(f"Pod {key}")
+        prior_node = pod.node_name
         if pod.metadata.owner_references:
             pod.node_name = ""
             pod.phase = POD_PENDING
             self.update(pod)
         else:
             self.delete(pod)
+        self._detach_unreferenced(pod, prior_node)

@@ -142,6 +142,7 @@ class _Prepared:
     ct_kid: int
     n_zones: int
     n_cts: int
+    level_iters: int = 32
 
 
 class DeviceScheduler:
@@ -290,6 +291,7 @@ class DeviceScheduler:
             prep.init_state,
             self._class_steps(prep),
             prep.statics,
+            level_iters=prep.level_iters,
         )
         # one device->host transfer for everything decode reads; the slot
         # planes ride along only when topology decode needs them
@@ -677,6 +679,19 @@ class DeviceScheduler:
             carry=jnp.int32(0),
         )
 
+        # level-search iterations: the water level is bounded by seeded
+        # topology counts + pods in this solve
+        import math
+
+        count_bound = 2 * (
+            sum(c.count for c in classes)
+            + (int(plan.zcount0.max()) if plan.zcount0.size else 0)
+            + (int(hcount0.max()) if hcount0.size else 0)
+            + 2
+        )
+        # bucket to a multiple of 4 so drifting pod counts share jit cache
+        level_iters = -(-max(math.ceil(math.log2(count_bound)), 4) // 4) * 4
+
         return _Prepared(
             vocab=frozen,
             resource_names=resource_names,
@@ -707,6 +722,7 @@ class DeviceScheduler:
             ct_kid=ct_kid,
             n_zones=Z,
             n_cts=CT,
+            level_iters=level_iters,
         )
 
     def _class_steps(self, prep: _Prepared) -> ClassStep:

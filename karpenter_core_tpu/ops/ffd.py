@@ -342,12 +342,14 @@ def _host_caps(state: SlotState, c: ClassStep, statics: FFDStatics):
     return slot_cap, fresh_cap, single_slot
 
 
-# Level-search iterations: the water level is bounded by max(count) + m;
-# m is a class pod count with no structural cap, so cover int32.
+# Level-search iterations: the water level is bounded by max(count) + m.
+# Both are bounded by the solve's total pod count, so callers that know it
+# pass ceil(log2(2*pods)) via ffd_solve(level_iters=...); the default
+# covers int32 outright.
 LEVEL_ITERS = 32
 
 
-def _level_fill(count, cap, adm, m, rank=None):
+def _level_fill(count, cap, adm, m, rank=None, iters=LEVEL_ITERS):
     """Water-fill m units over admissible entries with per-entry caps.
 
     Binary-search the level L with fill = clip(L - count, 0, cap) on
@@ -367,7 +369,7 @@ def _level_fill(count, cap, adm, m, rank=None):
         ok = jnp.sum(fill_at(mid)) <= m
         return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid - 1)
 
-    L, _ = jax.lax.fori_loop(0, LEVEL_ITERS, body, (jnp.int32(0), hi0))
+    L, _ = jax.lax.fori_loop(0, iters, body, (jnp.int32(0), hi0))
     fill = fill_at(L)
     r = m - jnp.sum(fill)
     elig = adm & (fill < cap) & (count + fill == L)
@@ -379,15 +381,15 @@ def _level_fill(count, cap, adm, m, rank=None):
     return fill + (elig & (erank < r))
 
 
-def _waterfill_take(count, cap, m):
+def _waterfill_take(count, cap, m, iters=LEVEL_ITERS):
     """Distribute m pods over in-flight slots emptiest-first with per-slot
     caps — the batched equivalent of the host policy's one-at-a-time "sort
     claims by pod count, add to the first that admits" loop (scheduler.py
     place_pod). count/cap/returns are [N] int32."""
-    return _level_fill(count, cap, cap > 0, m)
+    return _level_fill(count, cap, cap > 0, m, iters=iters)
 
 
-def _wf_quota(state: SlotState, c: ClassStep, statics: FFDStatics, m):
+def _wf_quota(state: SlotState, c: ClassStep, statics: FFDStatics, m, iters=LEVEL_ITERS):
     """Water-fill share of the pinned sub-step domain.
 
     The batched equivalent of the reference's per-pod loop: each pod joins
@@ -408,7 +410,9 @@ def _wf_quota(state: SlotState, c: ClassStep, statics: FFDStatics, m):
     mindom = statics.z_mindom[g]
     mindom_unsat = (mindom >= 0) & (supported < mindom)
     cap = jnp.where(mindom_unsat, jnp.clip(skew - counts, 0), BIGI)
-    quota = _level_fill(counts, cap, padm, m, rank=statics.z_rank[g])
+    quota = _level_fill(
+        counts, cap, padm, m, rank=statics.z_rank[g], iters=iters
+    )
     return jnp.where(
         c.sub_value >= 0, quota[jnp.clip(c.sub_value, 0)], 0
     )
@@ -417,7 +421,8 @@ def _wf_quota(state: SlotState, c: ClassStep, statics: FFDStatics, m):
 # ---------------------------------------------------------------------------
 
 
-def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics):
+def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics,
+             level_iters: int = LEVEL_ITERS):
     """Place one pod class; returns (state', take [N] int32 + unplaced [])."""
     N = state.kind.shape[0]
 
@@ -437,7 +442,9 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics):
 
     is_wf = c.wf_group >= 0
     carry0 = jnp.where(c.sub_first, c.count, state.carry)
-    m = jnp.where(is_wf, _wf_quota(state, c, statics, carry0), c.count)
+    m = jnp.where(
+        is_wf, _wf_quota(state, c, statics, carry0, iters=level_iters), c.count
+    )
 
     # -- feasibility on open slots ---------------------------------------
     req_ok = _class_slot_compatible(state, c_eff, statics)
@@ -470,7 +477,9 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics):
     take_exist = jnp.clip(m - before, 0, k_exist_eff)  # [N]
     rem_claims = m - jnp.sum(take_exist)
     k_claim_eff = jnp.where(state.kind == 2, k_eff, 0)
-    take_claims = _waterfill_take(state.podcount, k_claim_eff, rem_claims)
+    take_claims = _waterfill_take(
+        state.podcount, k_claim_eff, rem_claims, iters=level_iters
+    )
     take_normal = take_exist + take_claims
     first_feasible = feasible & (jnp.cumsum(feasible) == 1)
     take_single = jnp.where(first_feasible, jnp.minimum(k_eff, m), 0)
@@ -608,10 +617,11 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics):
     return state2, (take_all, unplaced)
 
 
-@partial(jax.jit, static_argnames=())
-def ffd_solve(state: SlotState, classes: ClassStep, statics: FFDStatics):
+@partial(jax.jit, static_argnames=("level_iters",))
+def ffd_solve(state: SlotState, classes: ClassStep, statics: FFDStatics,
+              level_iters: int = LEVEL_ITERS):
     """Scan all classes; returns (final state, takes [C, N], unplaced [C])."""
     final, (takes, unplaced) = jax.lax.scan(
-        lambda st, c: ffd_step(st, c, statics), state, classes
+        lambda st, c: ffd_step(st, c, statics, level_iters), state, classes
     )
     return final, takes, unplaced

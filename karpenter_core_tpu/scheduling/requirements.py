@@ -74,6 +74,16 @@ class Requirements(dict):
     @classmethod
     def _pod_requirements(cls, pod: Pod, include_preferred: bool) -> "Requirements":
         requirements = cls.from_labels(pod.node_selector)
+        # PVC-derived zone pins AND in unconditionally — relaxation only
+        # mutates pod.affinity, so these survive by construction (the
+        # reference ANDs them into every node-selector term instead,
+        # volumetopology.go:68-72)
+        if pod.volume_requirements:
+            requirements.add(
+                *cls.from_node_selector_requirements(
+                    pod.volume_requirements
+                ).values()
+            )
         affinity = pod.affinity.node_affinity if pod.affinity else None
         if affinity is None:
             return requirements

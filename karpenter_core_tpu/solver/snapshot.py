@@ -94,6 +94,10 @@ def _spec_signature(pod: Pod, label_aware: bool) -> tuple:
         # runs per-pod HostPortUsage conflict checks (nodeclaim.go add path);
         # sharing a class with port-free twins would skip them
         tuple(sorted(pod.host_ports)),
+        # PVC-derived requirements and volume identities both affect
+        # placement (zone pins; attach-limit accounting on existing nodes)
+        tuple(pod.volume_requirements),
+        tuple(pod.volumes),
     )
 
 

@@ -1,0 +1,254 @@
+"""Volume subsystem: PVC zone injection, CSI attach limits, detach-wait
+(reference: volumetopology.go:42-196, volumeusage.go:44-229,
+node/termination/controller.go:140-143,190-237).
+"""
+import copy
+
+import pytest
+
+from tests.helpers import GIB, make_nodepool, make_pod
+from tests.test_e2e import CATALOG, new_operator, replicated
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.api.objects import (
+    CSINode,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PodVolume,
+    StorageClass,
+    VolumeAttachment,
+)
+from karpenter_core_tpu.controllers.provisioning.scheduling.volumetopology import (
+    VolumeTopology,
+)
+from karpenter_core_tpu.scheduling.volumeusage import VolumeUsage, get_volumes
+
+
+def make_zonal_pv(name: str, zone: str, driver: str = "ebs.csi.aws.com"):
+    return PersistentVolume(
+        metadata=ObjectMeta(name=name),
+        node_affinity_required=[
+            NodeSelectorTerm(match_expressions=(
+                NodeSelectorRequirement(L.LABEL_TOPOLOGY_ZONE, "In", (zone,)),
+            ))
+        ],
+        csi_driver=driver,
+    )
+
+
+def make_pvc(name: str, volume_name: str = "", storage_class: str = None):
+    return PersistentVolumeClaim(
+        metadata=ObjectMeta(name=name),
+        storage_class_name=storage_class,
+        volume_name=volume_name,
+    )
+
+
+def pod_with_pvc(name: str, pvc: str, cpu: float = 1.0):
+    p = make_pod(cpu=cpu, name=name)
+    p.volumes = [PodVolume(name="data", pvc_name=pvc)]
+    return p
+
+
+class TestVolumeTopologyInjection:
+    def test_bound_pv_zone_injected(self):
+        op = new_operator()
+        op.kube.create(make_zonal_pv("pv-b", "zone-b"))
+        op.kube.create(make_pvc("claim-b", volume_name="pv-b"))
+        vt = VolumeTopology(op.kube)
+        p = pod_with_pvc("p1", "claim-b")
+        vt.inject(p)
+        assert any(
+            r.key == L.LABEL_TOPOLOGY_ZONE and r.values == ("zone-b",)
+            for r in p.volume_requirements
+        )
+        # idempotent: re-inject replaces, never accumulates
+        vt.inject(p)
+        assert len(p.volume_requirements) == 1
+
+    def test_storage_class_topology_injected(self):
+        op = new_operator()
+        op.kube.create(StorageClass(
+            metadata=ObjectMeta(name="zonal-sc"),
+            provisioner="ebs.csi.aws.com",
+            allowed_topologies=[(L.LABEL_TOPOLOGY_ZONE, ("zone-c",))],
+        ))
+        op.kube.create(make_pvc("claim-c", storage_class="zonal-sc"))
+        vt = VolumeTopology(op.kube)
+        p = pod_with_pvc("p1", "claim-c")
+        vt.inject(p)
+        assert any(
+            r.key == L.LABEL_TOPOLOGY_ZONE and r.values == ("zone-c",)
+            for r in p.volume_requirements
+        )
+
+    def test_local_pv_hostname_dropped(self):
+        op = new_operator()
+        pv = PersistentVolume(
+            metadata=ObjectMeta(name="pv-local"),
+            node_affinity_required=[
+                NodeSelectorTerm(match_expressions=(
+                    NodeSelectorRequirement(L.LABEL_HOSTNAME, "In", ("old-node",)),
+                    NodeSelectorRequirement(
+                        L.LABEL_TOPOLOGY_ZONE, "In", ("zone-a",)),
+                ))
+            ],
+            csi_driver="",
+            local=True,
+        )
+        op.kube.create(pv)
+        op.kube.create(make_pvc("claim-l", volume_name="pv-local"))
+        vt = VolumeTopology(op.kube)
+        p = pod_with_pvc("p1", "claim-l")
+        vt.inject(p)
+        keys = {r.key for r in p.volume_requirements}
+        assert L.LABEL_HOSTNAME not in keys and L.LABEL_TOPOLOGY_ZONE in keys
+
+    def test_validation_missing_pvc(self):
+        op = new_operator()
+        vt = VolumeTopology(op.kube)
+        assert "not found" in vt.validate_pvcs(pod_with_pvc("p1", "ghost"))
+
+    def test_validation_dangling_storage_class(self):
+        op = new_operator()
+        op.kube.create(make_pvc("claim-x", storage_class="ghost-sc"))
+        vt = VolumeTopology(op.kube)
+        err = vt.validate_pvcs(pod_with_pvc("p1", "claim-x"))
+        assert "missing storage class" in err
+
+
+@pytest.mark.parametrize("solver", ["greedy", "tpu"])
+class TestZonalSchedulingE2E:
+    def test_zonal_pvc_pod_lands_in_its_zone(self, solver):
+        # the VERDICT gap: "a zonal PVC pod will be packed into the wrong
+        # zone today" — end-to-end through the operator on both solvers
+        op = new_operator(solver)
+        op.kube.create(make_nodepool(requirements=[NodeSelectorRequirement(
+            L.LABEL_TOPOLOGY_ZONE, "In", ("zone-a", "zone-b", "zone-c"))]))
+        op.kube.create(make_zonal_pv("pv-b", "zone-b"))
+        op.kube.create(make_pvc("claim-b", volume_name="pv-b"))
+        op.kube.create(pod_with_pvc("zonal-pod", "claim-b"))
+        for i in range(5):
+            op.kube.create(make_pod(cpu=1.0, name=f"filler-{i}"))
+        op.run_until_idle()
+        pod = op.kube.get(type(make_pod()), "zonal-pod")
+        assert pod.node_name, "zonal pod did not bind"
+        node = op.kube.get(
+            type(op.kube.list_nodes()[0]), pod.node_name
+        )
+        assert node.labels[L.LABEL_TOPOLOGY_ZONE] == "zone-b", node.labels
+        # a VolumeAttachment materialized on bind
+        vas = op.kube.list_volume_attachments()
+        assert any(
+            va.pv_name == "pv-b" and va.node_name == pod.node_name
+            for va in vas
+        )
+
+    def test_unschedulable_when_zone_outside_pool(self, solver):
+        op = new_operator(solver)
+        op.kube.create(make_nodepool(requirements=[NodeSelectorRequirement(
+            L.LABEL_TOPOLOGY_ZONE, "In", ("zone-a",))]))
+        op.kube.create(make_zonal_pv("pv-b", "zone-b"))
+        op.kube.create(make_pvc("claim-b", volume_name="pv-b"))
+        op.kube.create(pod_with_pvc("zonal-pod", "claim-b"))
+        op.run_until_idle()
+        pod = op.kube.get(type(make_pod()), "zonal-pod")
+        assert not pod.node_name
+
+
+class TestAttachLimits:
+    def test_get_volumes_resolves_drivers(self):
+        op = new_operator()
+        op.kube.create(make_zonal_pv("pv-1", "zone-a", driver="csi.x"))
+        op.kube.create(make_pvc("c1", volume_name="pv-1"))
+        op.kube.create(StorageClass(
+            metadata=ObjectMeta(name="sc-y"), provisioner="csi.y"))
+        op.kube.create(make_pvc("c2", storage_class="sc-y"))
+        p = make_pod(cpu=1.0, name="p")
+        p.volumes = [
+            PodVolume(name="a", pvc_name="c1"),
+            PodVolume(name="b", pvc_name="c2"),
+            PodVolume(name="c", pvc_name=None),  # emptyDir: ignored
+        ]
+        vols = get_volumes(op.kube, p)
+        assert vols == {"csi.x": {"default/c1"}, "csi.y": {"default/c2"}}
+
+    def test_usage_limit_and_dedupe(self):
+        u = VolumeUsage()
+        u.add_limit("csi.x", 2)
+        u.add({"csi.x": {"default/a"}})
+        assert u.exceeds_limits({"csi.x": {"default/b", "default/c"}})
+        # the same claim shared by another pod doesn't double-count
+        assert u.exceeds_limits({"csi.x": {"default/a", "default/b"}}) is None
+
+    @pytest.mark.parametrize("solver", ["greedy", "tpu"])
+    def test_attach_limit_pushes_pod_to_new_node(self, solver):
+        # existing node with attach limit 1 and one volume already attached:
+        # a second volume pod must go to a fresh node despite spare cpu
+        op = new_operator(solver)
+        op.kube.create(make_nodepool())
+        for i in (1, 2):
+            op.kube.create(make_zonal_pv(f"pv-{i}", "zone-a", driver="csi.x"))
+            op.kube.create(make_pvc(f"c{i}", volume_name=f"pv-{i}"))
+        op.kube.create(pod_with_pvc("vol-pod-1", "c1", cpu=0.5))
+        op.run_until_idle()
+        p1 = op.kube.get(type(make_pod()), "vol-pod-1")
+        assert p1.node_name
+        n1 = p1.node_name
+        # stamp the node's CSINode with limit 1
+        op.kube.create(CSINode(
+            metadata=ObjectMeta(name=n1), drivers=[("csi.x", 1)]
+        ))
+        op.kube.create(pod_with_pvc("vol-pod-2", "c2", cpu=0.5))
+        op.run_until_idle()
+        p2 = op.kube.get(type(make_pod()), "vol-pod-2")
+        assert p2.node_name and p2.node_name != n1, (p2.node_name, n1)
+
+
+class TestDetachWait:
+    def test_termination_waits_for_volume_detach(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(make_zonal_pv("pv-1", "zone-a"))
+        op.kube.create(make_pvc("c1", volume_name="pv-1"))
+        op.kube.create(replicated(pod_with_pvc("vol-pod", "c1")))
+        op.run_until_idle()
+        node = op.kube.list_nodes()[0]
+        # slow CSI driver: an attachment that outlives the pod
+        op.kube.create(VolumeAttachment(
+            metadata=ObjectMeta(name="va-slow"),
+            attacher="csi.x", node_name=node.name, pv_name="pv-1",
+        ))
+        op.kube.delete(node)
+        op.run_until_idle()
+        # drained but the attachment blocks the finalizer
+        assert op.kube.get(type(node), node.name) is not None
+        va = op.kube.get(VolumeAttachment, "va-slow")
+        op.kube.delete(va)
+        op.run_until_idle()
+        assert op.kube.get(type(node), node.name) is None
+
+    def test_nondrainable_pod_attachment_does_not_block(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(make_zonal_pv("pv-1", "zone-a"))
+        op.kube.create(make_pvc("c1", volume_name="pv-1"))
+        daemon = pod_with_pvc("ds-pod", "c1")
+        daemon.is_daemonset = True
+        op.kube.create(replicated(make_pod(cpu=0.5, name="plain")))
+        op.run_until_idle()
+        node = op.kube.list_nodes()[0]
+        daemon.node_name = node.name
+        op.kube.create(daemon)
+        op.cluster  # daemon binding flows via watch on create
+        op.kube.create(VolumeAttachment(
+            metadata=ObjectMeta(name="va-ds"),
+            attacher="csi.x", node_name=node.name, pv_name="pv-1",
+        ))
+        op.kube.delete(node)
+        op.run_until_idle()
+        # the daemonset pod's attachment is filtered out; node terminates
+        assert op.kube.get(type(node), node.name) is None
