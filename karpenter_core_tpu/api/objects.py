@@ -422,3 +422,24 @@ class VolumeAttachment:
     @property
     def name(self) -> str:
         return self.metadata.name
+
+
+# ---------------------------------------------------------------------------
+# PodDisruptionBudget (policy/v1; the surface pdb.NewLimits and the eviction
+# API consume — reference pkg/utils/pdb/pdb.go:33-118)
+
+@dataclass
+class PodDisruptionBudget:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    # exactly one of these is set; int = absolute, str "N%" = percentage
+    min_available: "int | str | None" = None
+    max_unavailable: "int | str | None" = None
+    unhealthy_pod_eviction_policy: str = "IfHealthyBudget"  # | AlwaysAllow
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"

@@ -29,6 +29,10 @@ def simulate_scheduling(
     sim_nodes = [
         n for n in cluster.sim_nodes() if n.name not in excluded
     ]
+    # the simulation must see the same CSI attach-limit state the real
+    # provisioning solve would (volumeusage.go), or consolidation commits
+    # to placements the next solve rejects
+    provisioner._attach_volume_state(sim_nodes)
     pods = provisioner.pending_pods() + provisioner.deleting_node_pods()
     for c in candidates:
         pods.extend(c.reschedulable_pods)
@@ -82,15 +86,21 @@ def get_candidates(
     should_disrupt: Callable[[Candidate], bool],
 ) -> List[Candidate]:
     """(helpers.go:144-161)"""
+    from karpenter_core_tpu.utils.pdb import Limits
+
     nodepools = {np.name: np for np in kube.list_nodepools()}
     instance_types = {
         name: cloud_provider.get_instance_types(np)
         for name, np in nodepools.items()
     }
+    pdb_limits = Limits.from_kube(kube)
     out = []
     for sn in cluster.nodes():
         try:
-            c = new_candidate(clock, cluster, sn, nodepools, instance_types)
+            c = new_candidate(
+                clock, cluster, sn, nodepools, instance_types,
+                pdb_limits=pdb_limits,
+            )
         except CandidateError:
             continue
         if should_disrupt(c):

@@ -7,7 +7,7 @@ from __future__ import annotations
 from karpenter_core_tpu.api import labels as apilabels
 from karpenter_core_tpu.api.objects import Node
 from karpenter_core_tpu.cloudprovider.types import NodeClaimNotFoundError
-from karpenter_core_tpu.kube.store import NotFoundError
+from karpenter_core_tpu.kube.store import NotFoundError, TooManyRequestsError
 from karpenter_core_tpu.scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
 from karpenter_core_tpu.utils import pod as podutil
 
@@ -43,14 +43,19 @@ class NodeTermination:
             self.kube.update(node)
 
         # drain: non-daemon, evictable pods first; priority grouping is moot
-        # with a synchronous eviction stand-in (terminator.go:96-138)
+        # with a synchronous eviction stand-in (terminator.go:96-138). A
+        # PDB-blocked eviction (429) leaves the pod for the next reconcile —
+        # the drain proceeds at the budget's allowed rate (eviction.go:176)
         remaining = [
             p
             for p in self.cluster.pods_on_node(node.name)
             if podutil.is_evictable(p) and not p.is_daemonset
         ]
         for p in remaining:
-            self.kube.evict(p)
+            try:
+                self.kube.evict(p)
+            except TooManyRequestsError:
+                continue
         if any(
             not p.is_daemonset
             for p in self.cluster.pods_on_node(node.name)

@@ -30,6 +30,7 @@ from karpenter_core_tpu.api.objects import (
     PersistentVolume,
     PersistentVolumeClaim,
     Pod,
+    PodDisruptionBudget,
     StorageClass,
     VolumeAttachment,
 )
@@ -49,10 +50,11 @@ _KINDS = {
     StorageClass: "StorageClass",
     CSINode: "CSINode",
     VolumeAttachment: "VolumeAttachment",
+    PodDisruptionBudget: "PodDisruptionBudget",
 }
 
 # namespaced kinds key by namespace/name
-_NAMESPACED = {"Pod", "PersistentVolumeClaim"}
+_NAMESPACED = {"Pod", "PersistentVolumeClaim", "PodDisruptionBudget"}
 
 
 class ConflictError(Exception):
@@ -61,6 +63,10 @@ class ConflictError(Exception):
 
 class NotFoundError(Exception):
     pass
+
+
+class TooManyRequestsError(Exception):
+    """Eviction blocked by a PodDisruptionBudget (the apiserver's 429)."""
 
 
 def _kind_of(obj) -> str:
@@ -186,6 +192,9 @@ class KubeStore:
     def list_volume_attachments(self) -> List[VolumeAttachment]:
         return list(self._objects["VolumeAttachment"].values())
 
+    def list_pdbs(self) -> List[PodDisruptionBudget]:
+        return list(self._objects["PodDisruptionBudget"].values())
+
     # -- pod verbs --------------------------------------------------------
 
     def bind(self, pod: Pod, node_name: str) -> None:
@@ -240,13 +249,23 @@ class KubeStore:
                 self.delete(va)
 
     def evict(self, pod: Pod) -> None:
-        """Eviction API stand-in. A replicated workload's pod returns to
-        Pending (ReplicaSet recreation folded in); bare pods are deleted."""
+        """Eviction API stand-in: PDB-gated like the apiserver (429 when a
+        budget has no disruptions left). A replicated workload's pod returns
+        to Pending (ReplicaSet recreation folded in); bare pods are
+        deleted."""
         if pod.is_mirror or pod.is_daemonset:
             return
         key = _key_of("Pod", pod)
         if key not in self._objects["Pod"]:
             raise NotFoundError(f"Pod {key}")
+        if self._objects["PodDisruptionBudget"]:
+            from karpenter_core_tpu.utils.pdb import Limits
+
+            blocking = Limits.from_kube(self).blocking_pdb(pod)
+            if blocking is not None:
+                raise TooManyRequestsError(
+                    f"eviction of {key} blocked by pdb {blocking}"
+                )
         prior_node = pod.node_name
         if pod.metadata.owner_references:
             pod.node_name = ""

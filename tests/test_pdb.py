@@ -1,0 +1,163 @@
+"""PodDisruptionBudget end-to-end: limits math, candidate gating, and
+PDB-rate-limited drains (reference: pkg/utils/pdb/pdb.go:33-118,
+disruption types.go:71-117, terminator/eviction.go:95-176).
+"""
+import pytest
+
+from tests.helpers import make_nodepool, make_pod
+from tests.test_e2e import new_operator, replicated
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.api.objects import (
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    PodDisruptionBudget,
+)
+from karpenter_core_tpu.kube.store import TooManyRequestsError
+from karpenter_core_tpu.utils.pdb import Limits
+
+
+def selector(**labels):
+    return LabelSelector(match_labels=tuple(sorted(labels.items())))
+
+
+def make_pdb(name="pdb", min_available=None, max_unavailable=None,
+             policy="IfHealthyBudget", **labels):
+    return PodDisruptionBudget(
+        metadata=ObjectMeta(name=name),
+        selector=selector(**labels),
+        min_available=min_available,
+        max_unavailable=max_unavailable,
+        unhealthy_pod_eviction_policy=policy,
+    )
+
+
+def running_pod(name, **labels):
+    p = make_pod(cpu=0.1, name=name, labels=labels)
+    p.phase = "Running"
+    p.node_name = "n1"
+    return replicated(p)
+
+
+class TestLimitsMath:
+    def test_min_available_absolute(self):
+        op = new_operator()
+        for i in range(3):
+            op.kube.create(running_pod(f"w{i}", app="web"))
+        op.kube.create(make_pdb(min_available=2, app="web"))
+        limits = Limits.from_kube(op.kube)
+        assert limits.items[0].disruptions_allowed == 1
+
+    def test_min_available_percent_rounds_up(self):
+        op = new_operator()
+        for i in range(3):
+            op.kube.create(running_pod(f"w{i}", app="web"))
+        op.kube.create(make_pdb(min_available="50%", app="web"))
+        # desired = ceil(1.5) = 2 -> allowed 1
+        assert Limits.from_kube(op.kube).items[0].disruptions_allowed == 1
+
+    def test_max_unavailable_percent_rounds_up(self):
+        op = new_operator()
+        for i in range(4):
+            op.kube.create(running_pod(f"w{i}", app="web"))
+        op.kube.create(make_pdb(max_unavailable="30%", app="web"))
+        # ceil(1.2) = 2 unavailable allowed (roundUp=true in policy/v1)
+        assert Limits.from_kube(op.kube).items[0].disruptions_allowed == 2
+
+    def test_zero_budget_blocks(self):
+        op = new_operator()
+        op.kube.create(running_pod("w0", app="web"))
+        op.kube.create(make_pdb(min_available=1, app="web"))
+        limits = Limits.from_kube(op.kube)
+        pod = op.kube.list_pods()[0]
+        assert limits.can_evict_pods([pod]) is not None
+
+    def test_always_allow_ignores_unhealthy(self):
+        op = new_operator()
+        p = running_pod("w0", app="web")
+        p.phase = "Pending"
+        p.node_name = ""
+        op.kube.create(p)
+        op.kube.create(
+            make_pdb(min_available=1, policy="AlwaysAllow", app="web")
+        )
+        limits = Limits.from_kube(op.kube)
+        assert limits.can_evict_pods([op.kube.list_pods()[0]]) is None
+
+    def test_unrelated_pods_unaffected(self):
+        op = new_operator()
+        op.kube.create(running_pod("w0", app="web"))
+        op.kube.create(make_pdb(min_available=1, app="web"))
+        other = make_pod(cpu=0.1, name="other", labels={"app": "db"})
+        other.phase = "Running"
+        assert Limits.from_kube(op.kube).can_evict_pods([other]) is None
+
+
+class TestEvictionGate:
+    def test_store_evict_429(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(
+            cpu=0.5, name="w0", labels={"app": "web"})))
+        op.run_until_idle()
+        op.kube.create(make_pdb(min_available=1, app="web"))
+        pod = op.kube.get(Pod, "w0")
+        with pytest.raises(TooManyRequestsError):
+            op.kube.evict(pod)
+
+    def test_evict_allowed_with_headroom(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        for i in range(2):
+            op.kube.create(replicated(make_pod(
+                cpu=0.5, name=f"w{i}", labels={"app": "web"})))
+        op.run_until_idle()
+        op.kube.create(make_pdb(min_available=1, app="web"))
+        op.kube.evict(op.kube.get(Pod, "w0"))  # allowed: 2 healthy, 1 needed
+
+
+class TestCandidateGating:
+    def test_pdb_blocked_node_is_not_disrupted(self):
+        # empty-ish node carrying only a fully-protected workload must not
+        # be consolidated (the VERDICT gap: "Disruption can currently evict
+        # every replica of a protected workload at once")
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(
+            cpu=0.1, name="w0", labels={"app": "web"})))
+        op.run_until_idle()
+        op.kube.create(make_pdb(min_available=1, app="web"))
+        n_before = len(op.kube.list_nodes())
+        assert n_before == 1
+        # let consolidation condition mature
+        op.clock.step(60.0)
+        op.run_until_idle()
+        op.clock.step(600.0)
+        op.run_until_idle()
+        # node survives: its only pod is PDB-protected
+        assert len(op.kube.list_nodes()) == 1
+        assert op.kube.get(Pod, "w0").node_name
+
+
+class TestRateLimitedDrain:
+    def test_drain_respects_budget_over_time(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        pods = [
+            replicated(make_pod(cpu=0.3, name=f"w{i}", labels={"app": "web"}))
+            for i in range(3)
+        ]
+        for p in pods:
+            op.kube.create(p)
+        op.run_until_idle()
+        nodes = op.kube.list_nodes()
+        assert len(nodes) == 1
+        op.kube.create(make_pdb(min_available=2, app="web"))
+        # delete the node: drain may evict only 1 pod per pass; evicted pods
+        # rebind to a replacement node, restoring budget for the next pass
+        op.kube.delete(nodes[0])
+        op.run_until_idle()
+        # eventually the node drains fully and goes away; all pods run
+        assert op.kube.get(type(nodes[0]), nodes[0].name) is None
+        assert all(p.node_name for p in op.kube.list_pods())

@@ -2,12 +2,10 @@
 (reference: volumetopology.go:42-196, volumeusage.go:44-229,
 node/termination/controller.go:140-143,190-237).
 """
-import copy
-
 import pytest
 
 from tests.helpers import GIB, make_nodepool, make_pod
-from tests.test_e2e import CATALOG, new_operator, replicated
+from tests.test_e2e import new_operator, replicated
 
 from karpenter_core_tpu.api import labels as L
 from karpenter_core_tpu.api.objects import (
