@@ -22,6 +22,10 @@ from karpenter_core_tpu.controllers.disruption.methods import (
     SingleNodeConsolidation,
 )
 from karpenter_core_tpu.controllers.disruption.types import Command
+from karpenter_core_tpu.controllers.disruption.validation import (
+    CONSOLIDATION_TTL,
+    validate_command,
+)
 from karpenter_core_tpu.kube.store import NotFoundError
 from karpenter_core_tpu.scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
 
@@ -45,6 +49,16 @@ class InFlightCommand:
     command: Command
     replacement_names: List[str]
     created_at: float
+
+
+@dataclass
+class PendingCommand:
+    """A computed command waiting out the validation TTL
+    (validation.go:83-101)."""
+
+    command: Command
+    method: object
+    computed_at: float
 
 
 class DisruptionController:
@@ -78,6 +92,7 @@ class DisruptionController:
             SingleNodeConsolidation(ctx),
         ]
         self.in_flight: List[InFlightCommand] = []
+        self.pending: Optional[PendingCommand] = None
 
     # -- the 10s poll body (controller.go:104-197) -------------------------
 
@@ -87,6 +102,8 @@ class DisruptionController:
             # one graceful command at a time keeps validation simple and
             # mirrors the serial executeCommand flow
             return None
+        if self.pending is not None:
+            return self._reconcile_pending()
         for method in self.methods:
             candidates = get_candidates(
                 self.clock,
@@ -103,10 +120,37 @@ class DisruptionController:
             command = method.compute_command(budgets, candidates)
             if command.decision == "no-op":
                 continue
+            if getattr(method, "validation", None) is not None:
+                # hold for the TTL; validated on a later pass
+                self.pending = PendingCommand(
+                    command=command,
+                    method=method,
+                    computed_at=self.clock.now(),
+                )
+                return None
             self._execute(command)
             return command
         self.cluster.mark_consolidated()
         return None
+
+    def validation_wait_remaining(self) -> float:
+        """Seconds until the pending command's TTL elapses (0 when none)."""
+        if self.pending is None:
+            return 0.0
+        return max(
+            CONSOLIDATION_TTL - self.clock.since(self.pending.computed_at), 0.0
+        )
+
+    def _reconcile_pending(self) -> Optional[Command]:
+        if self.validation_wait_remaining() > 0:
+            return None
+        pending, self.pending = self.pending, None
+        err = validate_command(self.ctx, pending.method, pending.command)
+        if err is not None:
+            # invalidated: drop; the next poll recomputes from fresh state
+            return None
+        self._execute(pending.command)
+        return pending.command
 
     # -- execution (controller.go:203-247) ---------------------------------
 

@@ -23,59 +23,19 @@ def simulate_scheduling(
     candidates: List[Candidate],
 ) -> Results:
     """Re-enter the full provisioning scheduler with the candidates' nodes
-    removed and their pods queued (helpers.go:49-113). The solver strategy
-    (greedy|tpu) rides the provisioner's configuration."""
-    excluded = {c.name for c in candidates}
-    sim_nodes = [
-        n for n in cluster.sim_nodes() if n.name not in excluded
-    ]
-    # the simulation must see the same CSI attach-limit state the real
-    # provisioning solve would (volumeusage.go), or consolidation commits
-    # to placements the next solve rejects
-    provisioner._attach_volume_state(sim_nodes)
+    removed and their pods queued (helpers.go:49-113). The scheduler
+    assembly (solver strategy, volume state, topology exclusions) is the
+    provisioner's own, so the simulation cannot drift from the real solve."""
     pods = provisioner.pending_pods() + provisioner.deleting_node_pods()
     for c in candidates:
         pods.extend(c.reschedulable_pods)
-
-    nodepools = provisioner.ready_nodepools()
-    instance_types = {
-        np.name: provisioner.cloud_provider.get_instance_types(np)
-        for np in nodepools
-    }
-    from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
-        Topology,
-        domain_universe,
+    pods, volume_errors = provisioner._prepare_volumes(pods)
+    scheduler = provisioner.new_scheduler(
+        pods, excluded_nodes={c.name for c in candidates}
     )
-
-    topology = Topology(
-        domains=domain_universe(nodepools, instance_types, sim_nodes),
-        existing_pods=[
-            (p, labels, name)
-            for (p, labels, name) in cluster.existing_pod_triples()
-            if name not in excluded
-        ],
-        excluded_pod_uids={p.uid for p in pods},
-    )
-    common = dict(
-        nodepools=nodepools,
-        instance_types=instance_types,
-        existing_nodes=sim_nodes,
-        daemonset_pods=provisioner.daemonset_pods(),
-        topology=topology,
-    )
-    if provisioner.solver == "tpu":
-        from karpenter_core_tpu.models.provisioner import DeviceScheduler
-
-        scheduler = DeviceScheduler(
-            **common, **provisioner.device_scheduler_opts
-        )
-    else:
-        from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
-            Scheduler,
-        )
-
-        scheduler = Scheduler(**common)
-    return scheduler.solve(pods)
+    results = scheduler.solve(pods)
+    results.pod_errors.update(volume_errors)
+    return results
 
 
 def get_candidates(

@@ -60,6 +60,7 @@ class Emptiness:
 
     reason = REASON_EMPTY
     consolidation_type = "empty"
+    validation = "emptiness"  # TTL re-check: still empty (emptiness.go:94-122)
 
     def __init__(self, ctx):
         self.ctx = ctx
@@ -86,6 +87,7 @@ class Drift:
 
     reason = REASON_DRIFTED
     consolidation_type = "drift"
+    validation = None  # drift executes without a TTL window (drift.go)
 
     def __init__(self, ctx):
         self.ctx = ctx
@@ -136,6 +138,7 @@ class _ConsolidationBase:
     """Shared simulate→price-filter pipeline (consolidation.go:133-304)."""
 
     reason = REASON_UNDERUTILIZED
+    validation = "consolidation"  # 15s TTL re-simulation (validation.go)
 
     def __init__(self, ctx):
         self.ctx = ctx

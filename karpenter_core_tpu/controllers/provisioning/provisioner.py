@@ -90,17 +90,28 @@ class Provisioner:
 
     # -- the solve ---------------------------------------------------------
 
-    def new_scheduler(self, pods: List[Pod]):
+    def new_scheduler(self, pods: List[Pod], excluded_nodes=frozenset()):
+        """Scheduler over the live cluster minus ``excluded_nodes`` — the
+        shared assembly for the real solve and the disruption simulation
+        (helpers.go:49-113 builds its sim the same way)."""
         nodepools = self.ready_nodepools()
         instance_types = {
             np.name: self.cloud_provider.get_instance_types(np)
             for np in nodepools
         }
-        sim_nodes = self.cluster.sim_nodes()
+        sim_nodes = [
+            n
+            for n in self.cluster.sim_nodes()
+            if n.name not in excluded_nodes
+        ]
         self._attach_volume_state(sim_nodes)
         topology = Topology(
             domains=domain_universe(nodepools, instance_types, sim_nodes),
-            existing_pods=self.cluster.existing_pod_triples(),
+            existing_pods=[
+                t
+                for t in self.cluster.existing_pod_triples()
+                if t[2] not in excluded_nodes
+            ],
             excluded_pod_uids={p.uid for p in pods},
         )
         common = dict(

@@ -156,11 +156,20 @@ class Operator:
             self.disruption.reconcile()
 
     def run_until_idle(self, max_iters: int = 100, disrupt: bool = True) -> int:
-        """Reconcile until the store stops changing; returns passes used."""
+        """Reconcile until the store stops changing; returns passes used.
+
+        A pending disruption command waiting out its validation TTL is not
+        idle: with a steppable (fake) clock the wait elapses here — the
+        synchronous stand-in for the reference blocking on clock.After
+        (validation.go:88-96) — so consolidation stays closed-loop."""
         for i in range(max_iters):
             before = self.kube.mutations
             self.reconcile_once(disrupt=disrupt)
             if self.kube.mutations == before and not self.disruption.in_flight:
+                wait = self.disruption.validation_wait_remaining()
+                if disrupt and wait > 0 and hasattr(self.clock, "step"):
+                    self.clock.step(wait)
+                    continue
                 return i + 1
         return max_iters
 

@@ -10,7 +10,6 @@ disruption controller).
 """
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
 from typing import List, Optional
 
@@ -26,10 +25,12 @@ from karpenter_core_tpu.utils import pod as podutil
 
 def _resolve(value, expected: int, round_up: bool) -> int:
     if isinstance(value, str) and value.endswith("%"):
-        pct = float(value[:-1]) / 100.0
-        return (
-            math.ceil(pct * expected) if round_up else math.floor(pct * expected)
-        )
+        # exact integer arithmetic like intstr.GetScaledValueFromIntOrPercent
+        # — float math is off by one for pairs like 14% of 50
+        num = int(value[:-1])
+        if round_up:
+            return -(-num * expected // 100)
+        return num * expected // 100
     return int(value)
 
 

@@ -7,7 +7,6 @@ import pytest
 from tests.helpers import make_nodepool, make_pod
 from tests.test_e2e import new_operator, replicated
 
-from karpenter_core_tpu.api import labels as L
 from karpenter_core_tpu.api.objects import (
     LabelSelector,
     ObjectMeta,

@@ -1,0 +1,100 @@
+"""Consolidation validation TTL (reference: validation.go:56-215,
+consolidation.go:46): commands wait 15s, then re-validate against fresh
+cluster state before executing.
+"""
+from tests.helpers import make_nodepool, make_pod
+from tests.test_e2e import new_operator, replicated
+
+from karpenter_core_tpu.controllers.disruption.validation import (
+    CONSOLIDATION_TTL,
+)
+
+
+def consolidate_ready(op):
+    """Mature the Consolidatable condition and run the disruption poll."""
+    op.clock.step(40.0)
+    op.run_until_idle()
+
+
+def build_underutilized_cluster(op, n_pods=6):
+    """Pods sized so several nodes come up, then most pods are deleted,
+    leaving underutilized nodes for consolidation."""
+    op.kube.create(make_nodepool())
+    pods = [
+        replicated(make_pod(cpu=3.0, name=f"w{i}")) for i in range(n_pods)
+    ]
+    for p in pods:
+        op.kube.create(p)
+    op.run_until_idle()
+    return pods
+
+
+class TestValidationTTL:
+    def test_command_waits_ttl_then_executes(self):
+        op = new_operator()
+        pods = build_underutilized_cluster(op)
+        nodes_before = len(op.kube.list_nodes())
+        assert nodes_before >= 2
+        # delete most workload: nodes become consolidatable
+        for p in pods[2:]:
+            op.kube.delete(p)
+        op.clock.step(40.0)
+        # drive manual reconciles (no clock movement inside) until a
+        # command is computed; it must be HELD, not executed
+        for _ in range(10):
+            op.reconcile_once()
+            if op.disruption.pending is not None:
+                break
+        assert op.disruption.pending is not None
+        n_nodes = len(op.kube.list_nodes())
+        op.reconcile_once()
+        assert op.disruption.pending is not None, "executed before the TTL"
+        assert len(op.kube.list_nodes()) == n_nodes
+        # run_until_idle steps the fake clock through the TTL; the command
+        # validates and executes
+        op.run_until_idle()
+        assert len(op.kube.list_nodes()) < nodes_before
+        assert all(p.node_name for p in op.kube.list_pods())
+
+    def test_pods_arriving_during_ttl_abort_command(self):
+        op = new_operator()
+        pods = build_underutilized_cluster(op)
+        nodes_before = len(op.kube.list_nodes())
+        for p in pods[2:]:
+            op.kube.delete(p)
+        op.clock.step(40.0)
+        # drive until a command is pending (but TTL not elapsed)
+        for _ in range(10):
+            op.reconcile_once()
+            if op.disruption.pending is not None:
+                break
+        assert op.disruption.pending is not None
+        held = op.disruption.pending
+        # a burst of pending pods lands inside the validation window,
+        # large enough that the candidates' capacity is needed again
+        for i in range(8):
+            op.kube.create(replicated(make_pod(cpu=3.0, name=f"burst-{i}")))
+        # elapse the TTL; validation must reject the stale command
+        op.clock.step(CONSOLIDATION_TTL + 1.0)
+        op.reconcile_once()
+        assert op.disruption.pending is not held
+        # no candidate node was deleted by the aborted command: the burst
+        # pods bind, and nothing thrashes
+        op.run_until_idle()
+        assert all(p.node_name for p in op.kube.list_pods())
+
+    def test_drift_executes_without_ttl(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=1.0, name="w0")))
+        op.run_until_idle()
+        claim = op.kube.list_nodeclaims()[0]
+        # force drift via nodepool hash change
+        pool = op.kube.list_nodepools()[0]
+        pool.spec.template.labels["drifted"] = "yes"
+        op.kube.update(pool)
+        op.run_until_idle()
+        # drift disruption proceeded: old claim replaced without TTL stall
+        claims = op.kube.list_nodeclaims()
+        assert claim.name not in {c.name for c in claims}
+        assert all(p.node_name for p in op.kube.list_pods())
