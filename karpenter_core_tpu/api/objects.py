@@ -288,6 +288,8 @@ class Pod:
     resolved_volumes: Optional[dict] = None
     priority: int = 0
     priority_class_name: str = ""
+    # k8s defaults terminationGracePeriodSeconds to 30
+    termination_grace_period_seconds: float = 30.0
     preemption_policy: str = "PreemptLowerPriority"
     scheduling_gates: list = field(default_factory=list)
     node_name: str = ""

@@ -98,10 +98,10 @@ class DisruptionController:
 
     def reconcile(self) -> Optional[Command]:
         self._reconcile_orchestration()
-        if self.in_flight:
-            # one graceful command at a time keeps validation simple and
-            # mirrors the serial executeCommand flow
-            return None
+        # in-flight commands run CONCURRENTLY (orchestration/queue.go:108-141);
+        # double-disruption is prevented by the candidates' marked_for_deletion
+        # gate in new_candidate — the HasAny guard of queue.go:305. Validation
+        # of a newly computed command stays serial.
         if self.pending is not None:
             return self._reconcile_pending()
         for method in self.methods:

@@ -11,6 +11,12 @@ from karpenter_core_tpu.kube.store import NotFoundError, TooManyRequestsError
 from karpenter_core_tpu.scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
 from karpenter_core_tpu.utils import pod as podutil
 
+_CRITICAL_PRIORITY_CLASSES = ("system-cluster-critical", "system-node-critical")
+
+
+def _is_critical(pod) -> bool:
+    return pod.priority_class_name in _CRITICAL_PRIORITY_CLASSES
+
 
 class NodeTermination:
     def __init__(self, kube, cluster, cloud_provider, clock):
@@ -42,20 +48,43 @@ class NodeTermination:
             node.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
             self.kube.update(node)
 
-        # drain: non-daemon, evictable pods first; priority grouping is moot
-        # with a synchronous eviction stand-in (terminator.go:96-138). A
-        # PDB-blocked eviction (429) leaves the pod for the next reconcile —
-        # the drain proceeds at the budget's allowed rate (eviction.go:176)
-        remaining = [
+        # TGP enforcement (terminator.go:140-165): a NodeClaim
+        # terminationGracePeriod sets a hard node deadline; each pod is
+        # force-deleted (bypassing PDBs) at deadline − podGracePeriod so it
+        # still gets its full grace window before the node dies
+        deadline = self._termination_deadline(node, claims)
+        if deadline is not None:
+            for p in list(self.cluster.pods_on_node(node.name)):
+                if p.is_daemonset or p.is_mirror:
+                    continue
+                if self.clock.now() >= deadline - p.termination_grace_period_seconds:
+                    try:
+                        self.kube.delete(p)
+                    except NotFoundError:
+                        pass
+
+        # drain in priority groups (graceful-node-shutdown order,
+        # terminator.go:119-138): non-critical pods evict first; critical
+        # pods only once the earlier group is gone. A PDB-blocked eviction
+        # (429) leaves the pod for the next reconcile — the drain proceeds
+        # at the budget's allowed rate (eviction.go:176)
+        evictable = [
             p
             for p in self.cluster.pods_on_node(node.name)
             if podutil.is_evictable(p) and not p.is_daemonset
         ]
-        for p in remaining:
-            try:
-                self.kube.evict(p)
-            except TooManyRequestsError:
-                continue
+        groups = [
+            [p for p in evictable if not _is_critical(p)],
+            [p for p in evictable if _is_critical(p)],
+        ]
+        for group in groups:
+            if group:
+                for p in group:
+                    try:
+                        self.kube.evict(p)
+                    except TooManyRequestsError:
+                        continue
+                break  # later groups wait for this one to drain
         if any(
             not p.is_daemonset
             for p in self.cluster.pods_on_node(node.name)
@@ -82,6 +111,36 @@ class NodeTermination:
                 self.kube.update(node)
             except NotFoundError:
                 pass  # provider delete already removed the node object
+
+    def _termination_deadline(self, node: Node, claims) -> "float | None":
+        """deletionTimestamp + the owning claim's terminationGracePeriod,
+        persisted as a node annotation on first computation so the deadline
+        survives the claim object (the reference stamps the equivalent
+        annotation on the NodeClaim, lifecycle/controller.go:254-269)."""
+        stamped = node.metadata.annotations.get(
+            apilabels.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY
+        )
+        if stamped is not None:
+            return float(stamped)
+        start = node.metadata.deletion_timestamp
+        for c in claims:
+            tgp = c.spec.termination_grace_period
+            if tgp is None:
+                continue
+            base = (
+                c.metadata.deletion_timestamp
+                if c.metadata.deletion_timestamp is not None
+                else start
+            )
+            if base is None:
+                continue
+            deadline = base + tgp
+            node.metadata.annotations[
+                apilabels.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY
+            ] = str(deadline)
+            self.kube.update(node)
+            return deadline
+        return None
 
     def _volumes_detached(self, node: Node) -> bool:
         """True when no blocking VolumeAttachment remains on the node. An

@@ -1,0 +1,116 @@
+"""Orchestration fidelity: concurrent in-flight commands, TGP-enforced
+pod deletion, priority-grouped drains (reference: orchestration/
+queue.go:108-305, terminator/terminator.go:119-165).
+"""
+from tests.helpers import make_nodepool, make_pod
+from tests.test_e2e import new_operator, replicated
+
+from karpenter_core_tpu.api.objects import Node, Pod
+
+
+class TestConcurrentCommands:
+    def test_second_command_starts_while_first_in_flight(self):
+        # two drifted nodes; with the first command's replacement still
+        # uninitialized (lifecycle frozen — the disruption controller is
+        # driven directly), the second command must start anyway
+        # (orchestration/queue.go:108-141), and the marked_for_deletion /
+        # HasAny guard keeps the candidate sets disjoint (queue.go:305)
+        from karpenter_core_tpu.api.nodepool import Budget
+
+        op = new_operator()
+        pool = make_nodepool()
+        # the default 10% budget allows only ONE concurrent disruption in a
+        # two-node pool; widen it so concurrency is observable
+        pool.spec.disruption.budgets = [Budget(nodes="100%")]
+        op.kube.create(pool)
+        op.kube.create(replicated(make_pod(cpu=9.0, name="w0")))
+        op.kube.create(replicated(make_pod(cpu=9.0, name="w1")))
+        op.run_until_idle()
+        assert len(op.kube.list_nodes()) >= 2
+        pool.spec.template.labels["drifted"] = "yes"
+        op.kube.update(pool)
+        # mature the Drifted conditions without running disruption
+        op.run_until_idle(disrupt=False)
+
+        op.disruption.reconcile()  # computes + executes command 1
+        assert len(op.disruption.in_flight) == 1
+        op.disruption.reconcile()  # cmd1 replacement not initialized yet
+        assert len(op.disruption.in_flight) == 2, "second command stalled"
+        sets = [
+            {c.name for c in cmd.command.candidates}
+            for cmd in op.disruption.in_flight
+        ]
+        assert not (sets[0] & sets[1]), sets
+        # let the operator finish both commands
+        op.run_until_idle()
+        assert not op.disruption.in_flight
+        assert all(p.node_name for p in op.kube.list_pods())
+
+
+class TestTGPEnforcement:
+    def test_expired_pod_force_deleted_despite_pdb(self):
+        # a fully-blocking PDB would stall the drain forever; the claim's
+        # terminationGracePeriod guarantees the node dies anyway, with the
+        # pod force-deleted at deadline - podGracePeriod (terminator.go:140-165)
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        p = replicated(make_pod(cpu=0.5, name="w0", labels={"app": "web"}))
+        p.termination_grace_period_seconds = 30.0
+        op.kube.create(p)
+        op.run_until_idle()
+        claim = op.kube.list_nodeclaims()[0]
+        claim.spec.termination_grace_period = 300.0
+        op.kube.update(claim)
+        from tests.test_pdb import make_pdb
+
+        op.kube.create(make_pdb(min_available=1, app="web"))
+        node = op.kube.list_nodes()[0]
+        op.kube.delete(node)
+        op.run_until_idle()
+        # PDB blocks the graceful drain; node still present
+        assert op.kube.get(Node, node.name) is not None
+        assert op.kube.get(Pod, "w0") is not None
+        # cross the force-delete threshold: deadline - podGracePeriod
+        op.clock.step(300.0 - 30.0 + 1.0)
+        op.run_until_idle()
+        assert op.kube.get(Node, node.name) is None
+
+    def test_graceful_drain_before_deadline(self):
+        # without a PDB the drain completes long before the TGP deadline
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=0.5, name="w0")))
+        op.run_until_idle()
+        claim = op.kube.list_nodeclaims()[0]
+        claim.spec.termination_grace_period = 300.0
+        op.kube.update(claim)
+        node = op.kube.list_nodes()[0]
+        op.kube.delete(node)
+        op.run_until_idle()
+        assert op.kube.get(Node, node.name) is None
+        # the pod was evicted (rebound elsewhere), not deleted
+        assert op.kube.get(Pod, "w0") is not None
+
+
+class TestPriorityGroupedDrain:
+    def test_critical_pods_drain_last(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        crit = replicated(make_pod(cpu=0.5, name="crit"))
+        crit.priority_class_name = "system-cluster-critical"
+        op.kube.create(crit)
+        op.kube.create(replicated(make_pod(cpu=0.5, name="plain")))
+        op.run_until_idle()
+        nodes = op.kube.list_nodes()
+        assert len(nodes) == 1
+        node = nodes[0]
+        op.kube.delete(node)
+        # first drain pass: only the non-critical pod is evicted
+        op.reconcile_once()
+        crit_pod = op.kube.get(Pod, "crit")
+        plain_pod = op.kube.get(Pod, "plain")
+        assert plain_pod.node_name != node.name  # evicted (pending or moved)
+        assert crit_pod.node_name == node.name  # still there
+        op.run_until_idle()
+        assert op.kube.get(Node, node.name) is None
+        assert all(p.node_name for p in op.kube.list_pods())
