@@ -104,6 +104,8 @@ class DisruptionController:
         # of a newly computed command stays serial.
         if self.pending is not None:
             return self._reconcile_pending()
+        from karpenter_core_tpu.metrics import wiring as m
+
         for method in self.methods:
             candidates = get_candidates(
                 self.clock,
@@ -111,6 +113,9 @@ class DisruptionController:
                 self.kube,
                 self.cloud_provider,
                 method.should_disrupt,
+            )
+            m.DISRUPTION_ELIGIBLE_NODES.set(
+                len(candidates), {"reason": method.reason}
             )
             if not candidates:
                 continue
@@ -144,10 +149,15 @@ class DisruptionController:
     def _reconcile_pending(self) -> Optional[Command]:
         if self.validation_wait_remaining() > 0:
             return None
+        from karpenter_core_tpu.metrics import wiring as m
+
         pending, self.pending = self.pending, None
         err = validate_command(self.ctx, pending.method, pending.command)
         if err is not None:
             # invalidated: drop; the next poll recomputes from fresh state
+            m.DISRUPTION_VALIDATION_FAILURES.inc(
+                {"reason": pending.method.reason}
+            )
             return None
         self._execute(pending.command)
         return pending.command
@@ -155,6 +165,11 @@ class DisruptionController:
     # -- execution (controller.go:203-247) ---------------------------------
 
     def _execute(self, command: Command) -> None:
+        from karpenter_core_tpu.metrics import wiring as m
+
+        m.DISRUPTION_DECISIONS.inc(
+            {"decision": command.decision, "reason": command.reason}
+        )
         # taint + mark so the provisioner stops using the candidates
         for c in command.candidates:
             node = self.kube.get(Node, c.name)

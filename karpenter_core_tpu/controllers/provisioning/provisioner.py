@@ -129,15 +129,21 @@ class Provisioner:
         return Scheduler(topology=topology, **common)
 
     def schedule(self) -> Tuple[Results, List[Pod]]:
+        from karpenter_core_tpu.metrics import wiring as m
+
         pods = self.pending_pods() + self.deleting_node_pods()
         if not pods:
             return Results([], [], {}), []
         pods, volume_errors = self._prepare_volumes(pods)
+        m.QUEUE_DEPTH.set(len(pods))
+        m.IGNORED_PODS.set(len(volume_errors))
         if not pods:
             return Results([], [], volume_errors), []
         scheduler = self.new_scheduler(pods)
-        results = scheduler.solve(pods)
+        with m.SCHEDULING_DURATION.time():
+            results = scheduler.solve(pods)
         results.pod_errors.update(volume_errors)
+        m.UNSCHEDULABLE_PODS.set(len(results.pod_errors))
         return results, pods
 
     # -- volume preprocessing (volumetopology.go inject+validate,

@@ -217,10 +217,17 @@ class DeviceScheduler:
         while max_slots < len(self.existing_nodes):
             max_slots *= 2
 
+        from karpenter_core_tpu.metrics import wiring as m
+
         # relaxation terminates naturally: each relax() strips one soft term
         # (preferences.go:38-57); the greedy oracle loops the same way
+        first_round = True
         while True:
-            result = self._solve_once(all_pods, max_slots)
+            if not first_round:
+                m.SOLVER_RELAX_ROUNDS.inc()
+            first_round = False
+            with m.SOLVER_SOLVE_DURATION.time():
+                result = self._solve_once(all_pods, max_slots)
             if result is None:  # slot overflow — retry larger
                 if max_slots >= _SLOT_HARD_CAP:
                     errors = {
@@ -282,11 +289,16 @@ class DeviceScheduler:
         plan = topoplan.plan_topology(classes, topo)
         self._final_filter_cache: Dict[tuple, list] = {}
 
+        from karpenter_core_tpu.metrics import wiring as m
+
         try:
-            prep = self._prepare_with_vocab(plan, max_slots, topo)
+            with m.SOLVER_PREPARE_DURATION.time():
+                prep = self._prepare_with_vocab(plan, max_slots, topo)
         except _SlotOverflow:
             return None
 
+        kernel_timer = m.SOLVER_KERNEL_DURATION.time()
+        kernel_timer.__enter__()
         state, takes, unplaced = ffd_solve(
             prep.init_state,
             self._class_steps(prep),
@@ -313,12 +325,18 @@ class DeviceScheduler:
                 zcount=state.zcount,
             )
         out = jax.device_get(fetch)
+        kernel_timer.__exit__(None, None, None)
         if bool(out["overflow"]):
             return None
-        claims, existing_sims, failed = self._decode(prep, out)
+        with m.SOLVER_DECODE_DURATION.time():
+            claims, existing_sims, failed = self._decode(prep, out)
 
         # ineligible topology classes: host loop over the post-device cluster
         fallback_pods = [p for cls in plan.fallback_classes for p in cls.pods]
+        if fallback_pods:
+            m.SOLVER_HOST_FALLBACK_PODS.inc(
+                {"cause": "ineligible"}, by=len(fallback_pods)
+            )
         fallback_requests = {
             p.uid: resutil.requests_for_pods(p) for p in fallback_pods
         }
@@ -900,6 +918,12 @@ class DeviceScheduler:
                         target.add(p, req)
                     except IncompatibleError:
                         divergent.append(p)
+        if divergent:
+            from karpenter_core_tpu.metrics import wiring as m
+
+            m.SOLVER_HOST_FALLBACK_PODS.inc(
+                {"cause": "divergent"}, by=len(divergent)
+            )
         for p in divergent:
             err = self._host_fallback_add(p, claims, prep.existing_sims, topo)
             if err is not None:
@@ -988,6 +1012,12 @@ class DeviceScheduler:
         self._sync_topo_counts(prep, hcount, zcount, slot_hostnames)
         self._recount_host_only(prep, committed)
 
+        if deferred:
+            from karpenter_core_tpu.metrics import wiring as m
+
+            m.SOLVER_HOST_FALLBACK_PODS.inc(
+                {"cause": "deferred"}, by=len(deferred)
+            )
         for p in deferred:
             err = self._host_fallback_add(p, claims, prep.existing_sims, topo)
             if err is not None:

@@ -154,6 +154,36 @@ class Operator:
             self._provision()
         if disrupt:
             self.disruption.reconcile()
+        self._export_metrics()
+
+    def _export_metrics(self) -> None:
+        """State gauges + pod/node/nodepool exporters (state/metrics.go:36-67,
+        pkg/controllers/metrics/{pod,node,nodepool})."""
+        from karpenter_core_tpu.metrics import wiring as m
+        from karpenter_core_tpu.utils import resources as resutil
+
+        m.CLUSTER_NODE_COUNT.set(len(self.cluster.nodes()))
+        m.CLUSTER_SYNCED.set(1.0 if self.cluster.synced() else 0.0)
+        by_phase: Dict[str, int] = {}
+        for p in self.kube.list_pods():
+            by_phase[p.phase] = by_phase.get(p.phase, 0) + 1
+        for phase, n in by_phase.items():
+            m.PODS_STATE.set(n, {"phase": phase})
+        alloc: Dict[str, float] = {}
+        for node in self.kube.list_nodes():
+            alloc = resutil.merge(alloc, node.status.allocatable)
+        for name, qty in alloc.items():
+            m.NODES_ALLOCATABLE.set(qty, {"resource_type": name})
+        for pool in self.kube.list_nodepools():
+            for name, qty in (pool.status.resources or {}).items():
+                m.NODEPOOL_USAGE.set(
+                    qty, {"nodepool": pool.name, "resource_type": name}
+                )
+            if pool.spec.limits:
+                for name, qty in dict(pool.spec.limits).items():
+                    m.NODEPOOL_LIMIT.set(
+                        qty, {"nodepool": pool.name, "resource_type": name}
+                    )
 
     def run_until_idle(self, max_iters: int = 100, disrupt: bool = True) -> int:
         """Reconcile until the store stops changing; returns passes used.

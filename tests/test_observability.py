@@ -1,0 +1,66 @@
+"""Observability wiring: after an e2e run the registry carries non-zero
+values for scheduler, disruption, state, exporter, and solver metrics
+(VERDICT r3 item 7; reference scheduling/metrics.go, disruption/metrics.go,
+state/metrics.go, pkg/controllers/metrics/).
+"""
+from tests.helpers import make_nodepool, make_pod
+from tests.test_e2e import new_operator, replicated
+
+from karpenter_core_tpu.metrics import wiring as m
+from karpenter_core_tpu.metrics.registry import REGISTRY
+
+
+class TestMetricsWiring:
+    def test_e2e_run_populates_registry(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        for i in range(6):
+            op.kube.create(replicated(make_pod(cpu=3.0, name=f"w{i}")))
+        op.run_until_idle()
+        # scheduler metrics
+        assert m.SCHEDULING_DURATION.totals, "no solve timed"
+        assert m.QUEUE_DEPTH.value() > 0
+        # state + exporters
+        assert m.CLUSTER_NODE_COUNT.value() >= 1
+        assert m.CLUSTER_SYNCED.value() == 1.0
+        assert m.PODS_STATE.value({"phase": "Running"}) == 6
+        assert m.NODES_ALLOCATABLE.value({"resource_type": "cpu"}) > 0
+        assert m.NODEPOOL_USAGE.value(
+            {"nodepool": "default", "resource_type": "cpu"}
+        ) > 0
+        # drive a consolidation so disruption metrics move
+        for p in op.kube.list_pods()[2:]:
+            op.kube.delete(p)
+        op.clock.step(40.0)
+        op.run_until_idle()
+        eligible_seen = any(
+            v > 0 for v in m.DISRUPTION_ELIGIBLE_NODES.values.values()
+        )
+        decisions_seen = any(
+            v > 0 for v in m.DISRUPTION_DECISIONS.values.values()
+        )
+        assert eligible_seen and decisions_seen
+        # render carries it all in exposition format
+        text = REGISTRY.render()
+        assert "karpenter_provisioner_scheduling_duration_seconds_count" in text
+        assert "karpenter_voluntary_disruption_decisions_total" in text
+
+    def test_device_solver_metrics_and_fallback_counter(self):
+        before_fallback = sum(m.SOLVER_HOST_FALLBACK_PODS.values.values())
+        op = new_operator("tpu")
+        op.kube.create(make_nodepool())
+        # hostPort + spread pods are topology-ineligible -> host fallback
+        from tests.helpers import make_diverse_pods
+
+        for p in make_diverse_pods(12, seed=0, with_topology=True):
+            op.kube.create(p)
+        hp = make_pod(cpu=0.5, name="hp", spread_zone=True)
+        hp.host_ports = [("0.0.0.0", 9000, "TCP")]
+        op.kube.create(hp)
+        op.run_until_idle()
+        assert m.SOLVER_SOLVE_DURATION.totals, "device solve not timed"
+        assert m.SOLVER_PREPARE_DURATION.totals
+        assert m.SOLVER_KERNEL_DURATION.totals
+        assert m.SOLVER_DECODE_DURATION.totals
+        after_fallback = sum(m.SOLVER_HOST_FALLBACK_PODS.values.values())
+        assert after_fallback > before_fallback, "fallback went uncounted"
