@@ -270,6 +270,35 @@ def _solve_bench(pods, nodepools, catalog, max_slots=1024, repeats=5,
     return out
 
 
+def _shape_churn_bench(n=20000, types=800, rounds=6):
+    """Every solve mutates the pod mix — different pod counts AND a
+    different shape grid, so class counts drift round to round. Bucketed
+    device shapes (models/provisioner._bucket) must keep hitting the jit
+    cache: p50 over the churn rounds should sit near the static-shape p50
+    rather than paying a multi-second recompile per round."""
+    from karpenter_core_tpu.models.provisioner import DeviceScheduler
+    from karpenter_core_tpu.cloudprovider.kwok import bench_catalog
+
+    catalog = bench_catalog(types)
+    sched = DeviceScheduler(
+        [_pool()], {"default": list(catalog)}, max_slots=1024
+    )
+    times = []
+    for r in range(rounds):
+        pods = _plain_pods(n + 53 * r, shapes=(14 + r % 3, 11 + r % 2))
+        t0 = time.perf_counter()
+        res = sched.solve(pods)
+        times.append(time.perf_counter() - t0)
+        assert res.all_pods_scheduled(), list(res.pod_errors.items())[:3]
+    churn = sorted(times[1:])[len(times[1:]) // 2]
+    return {
+        "p50_churn_s": round(churn, 3),
+        "cold_s": round(times[0], 3),
+        "rounds": rounds,
+        "round_times_s": [round(t, 3) for t in times],
+    }
+
+
 def _consolidation_bench(n_nodes=2000, n_candidates=100, repeats=3):
     """BASELINE config 4: the multi-node consolidation frontier over a
     2k-node cluster — all `n_candidates` prefixes in one vmapped call
@@ -325,6 +354,11 @@ def _consolidation_bench(n_nodes=2000, n_candidates=100, repeats=3):
         base_pods=[],
         candidate_pods=[resched[2 * i : 2 * i + 2] for i in range(n_candidates)],
     )
+    Jp = int(classes.count.shape[0])
+    if count_batch.shape[1] < Jp:  # steps pad to a bucketed count
+        count_batch = np.pad(
+            count_batch, ((0, 0), (0, Jp - count_batch.shape[1]))
+        )
 
     args = (
         prep.init_state,
@@ -399,6 +433,7 @@ def main():
             max_slots=2048,
             repeats=3,
         )
+        detail["shape_churn"] = _shape_churn_bench()
         detail["cfg4_consol"] = _consolidation_bench()
 
     pods_per_sec = primary["pods_per_sec"]

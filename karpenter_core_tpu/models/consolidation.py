@@ -146,9 +146,15 @@ def schedulability_frontier(
         prep, base_pods, [c.reschedulable_pods for c in candidates]
     )
 
+    classes = sched._class_steps(prep)
+    Jp = int(classes.count.shape[0])
+    if count_batch.shape[1] < Jp:  # steps pad to a bucketed count
+        count_batch = np.pad(
+            count_batch, ((0, 0), (0, Jp - count_batch.shape[1]))
+        )
     next_free, unplaced, overflow = _prefix_scan(
         prep.init_state,
-        sched._class_steps(prep),
+        classes,
         prep.statics,
         jnp.asarray(kind_batch),
         jnp.asarray(count_batch),

@@ -101,6 +101,23 @@ def _tolerates_taints(tolerations, taints) -> bool:
     return all(any(tol.tolerates(t) for tol in tolerations) for t in taints)
 
 
+def _bucket(n: int, lo: int = 8) -> int:
+    """Next power of two (>= lo): device-array axes pad to bucketed sizes so
+    repeated solves with drifting shapes (class counts, vocab growth, pod
+    mixes) hit the jit cache instead of recompiling for seconds."""
+    return max(lo, 1 << max(n - 1, 1).bit_length())
+
+
+def _pad(a: np.ndarray, targets: dict, fill) -> np.ndarray:
+    """Pad axes of a to targets {axis: size} with a constant fill."""
+    widths = [(0, 0)] * a.ndim
+    for axis, size in targets.items():
+        widths[axis] = (0, max(size - a.shape[axis], 0))
+    if all(w == (0, 0) for w in widths):
+        return a
+    return np.pad(a, widths, constant_values=fill)
+
+
 class _SlotOverflow(Exception):
     """More slots needed than max_slots — caller doubles and retries."""
 
@@ -328,6 +345,21 @@ class DeviceScheduler:
         kernel_timer.__exit__(None, None, None)
         if bool(out["overflow"]):
             return None
+        # slice bucketed device shapes back to the natural sizes decode
+        # (and the topoplan arrays) index with
+        J = len(plan.steps)
+        sh = self._pad_shapes
+        out["takes"] = np.asarray(out["takes"])[:J]
+        out["unplaced"] = np.asarray(out["unplaced"])[:J]
+        if plan.has_device_topology():
+            out["valmask"] = np.asarray(out["valmask"])[:, : sh["K"], : sh["V"]]
+            out["defines"] = np.asarray(out["defines"])[:, : sh["K"]]
+            out["complement"] = np.asarray(out["complement"])[:, : sh["K"]]
+            out["gt"] = np.asarray(out["gt"])[:, : sh["K"]]
+            out["lt"] = np.asarray(out["lt"])[:, : sh["K"]]
+            out["itmask"] = np.asarray(out["itmask"])[:, : sh["T"]]
+            out["hcount"] = np.asarray(out["hcount"])[:, : sh["Gh"]]
+            out["zcount"] = np.asarray(out["zcount"])[: sh["Gz"], : sh["V"]]
         with m.SOLVER_DECODE_DURATION.time():
             claims, existing_sims, failed = self._decode(prep, out)
 
@@ -519,24 +551,38 @@ class DeviceScheduler:
                     off_avail[ti, z, c_] = True
 
         # device compat precomputes
+        # class axis buckets before the jitted mask kernels, or a drifting
+        # class count recompiles them every solve (the shape-churn cliff)
         cm, im, tm = class_masks, it_masks, tmpl_masks
+        Cp = _bucket(C)
+
+        def cpad(a, fill):
+            return _pad(a, {0: Cp}, fill)
+
+        cmask_p = np.where(
+            cpad(cm.defines, False)[:, :, None], cpad(cm.mask, False), True
+        )
         class_it = np.asarray(
             mops.intersects(
-                cm.mask, cm.defines, cm.concrete, cm.negative, cm.gt, cm.lt,
+                cmask_p, cpad(cm.defines, False), cpad(cm.concrete, False),
+                cpad(cm.negative, True), cpad(cm.gt, GT_NONE),
+                cpad(cm.lt, LT_NONE),
                 im.mask, im.defines, im.concrete, im.negative, im.gt, im.lt,
             )
-        ) if C and T else np.zeros((C, T), dtype=bool)
+        )[:C] if C and T else np.zeros((C, T), dtype=bool)
         if class_it.shape[1] < pad_T:
             class_it = np.pad(
                 class_it, ((0, 0), (0, pad_T - class_it.shape[1]))
             )
         tmpl_compat = np.asarray(
             mops.compatible(
-                cm.mask, cm.defines, cm.concrete, cm.negative, cm.gt, cm.lt,
+                cmask_p, cpad(cm.defines, False), cpad(cm.concrete, False),
+                cpad(cm.negative, True), cpad(cm.gt, GT_NONE),
+                cpad(cm.lt, LT_NONE),
                 tm.mask, tm.defines, tm.concrete, tm.negative, tm.gt, tm.lt,
                 jnp.asarray(well_known),
             )
-        ) if C and S else np.zeros((C, pad_S), dtype=bool)
+        )[:C] if C and S else np.zeros((C, pad_S), dtype=bool)
 
         taint_ok = np.array(
             [
@@ -647,53 +693,100 @@ class DeviceScheduler:
                 if name not in slot_name_set
             )
 
+        # -- shape bucketing (the jit-cache / compile-cliff defense) --------
+        # Padded entities are inert by construction: keys/values pad to the
+        # neutral invariant (all-True slot valmask, False class/template
+        # masks under defines=False), instance types/templates pad
+        # never-viable, topology groups pad owner/sel=False, resources pad
+        # zero-request. The kernel runs at padded shapes; _solve_once slices
+        # outputs back to natural sizes before decode.
+        Kp = _bucket(K)
+        Vp = _bucket(V)
+        Tp = _bucket(pad_T)
+        Sp = _bucket(pad_S, lo=2)
+        Rp = _bucket(R, lo=4)
+        Ghp = _bucket(plan.Gh, lo=1)
+        Gzp = _bucket(plan.Gz, lo=1)
+        self._pad_shapes = dict(K=K, V=V, T=pad_T, Gh=plan.Gh, Gz=plan.Gz)
+
+        def pad_masks(mask, defines_, concrete_like_complement, negative_,
+                      gt_, lt_):
+            """Pad one entity-mask family: V/K axes of the value mask pad
+            False then re-neutralize where defines is False."""
+            m2 = _pad(mask, {mask.ndim - 2: Kp, mask.ndim - 1: Vp}, False)
+            d2 = _pad(defines_, {defines_.ndim - 1: Kp}, False)
+            m2 = np.where(d2[..., None], m2, True)
+            c2 = _pad(concrete_like_complement,
+                      {concrete_like_complement.ndim - 1: Kp}, True)
+            n2 = _pad(negative_, {negative_.ndim - 1: Kp}, True)
+            g2 = _pad(gt_, {gt_.ndim - 1: Kp}, GT_NONE)
+            l2 = _pad(lt_, {lt_.ndim - 1: Kp}, LT_NONE)
+            return m2, d2, c2, n2, g2, l2
+
+        tm_mask, tm_def, tm_comp, tm_neg, tm_gt, tm_lt = pad_masks(
+            tmpl_masks.mask,
+            tmpl_masks.defines,
+            np.where(tmpl_masks.defines, ~tmpl_masks.concrete, True),
+            np.where(tmpl_masks.defines, tmpl_masks.negative, True),
+            tmpl_masks.gt,
+            tmpl_masks.lt,
+        )
         statics = FFDStatics(
-            it_alloc=jnp.asarray(it_alloc),
-            off_avail=jnp.asarray(off_avail),
+            it_alloc=jnp.asarray(_pad(it_alloc, {0: Tp, 1: Rp}, 0.0)),
+            off_avail=jnp.asarray(_pad(off_avail, {0: Tp}, False)),
             zone_key=jnp.int32(zone_kid),
             ct_key=jnp.int32(ct_kid),
-            tmpl_mask=jnp.asarray(tmpl_masks.mask),
-            tmpl_defines=jnp.asarray(tmpl_masks.defines),
-            tmpl_complement=jnp.asarray(
-                np.where(tmpl_masks.defines, ~tmpl_masks.concrete, True)
-            ),
-            tmpl_negative=jnp.asarray(
-                np.where(tmpl_masks.defines, tmpl_masks.negative, True)
-            ),
-            tmpl_gt=jnp.asarray(tmpl_masks.gt),
-            tmpl_lt=jnp.asarray(tmpl_masks.lt),
-            tmpl_it=jnp.asarray(tmpl_it),
-            tmpl_overhead=jnp.asarray(tmpl_overhead),
-            well_known=jnp.asarray(well_known),
+            tmpl_mask=jnp.asarray(_pad(tm_mask, {0: Sp}, True)),
+            tmpl_defines=jnp.asarray(_pad(tm_def, {0: Sp}, False)),
+            tmpl_complement=jnp.asarray(_pad(tm_comp, {0: Sp}, True)),
+            tmpl_negative=jnp.asarray(_pad(tm_neg, {0: Sp}, True)),
+            tmpl_gt=jnp.asarray(_pad(tm_gt, {0: Sp}, GT_NONE)),
+            tmpl_lt=jnp.asarray(_pad(tm_lt, {0: Sp}, LT_NONE)),
+            tmpl_it=jnp.asarray(_pad(tmpl_it, {0: Sp, 1: Tp}, False)),
+            tmpl_overhead=jnp.asarray(_pad(tmpl_overhead, {0: Sp, 1: Rp}, 0.0)),
+            well_known=jnp.asarray(_pad(well_known, {0: Kp}, False)),
             gt_none=jnp.int32(GT_NONE),
             lt_none=jnp.int32(LT_NONE),
-            h_type=jnp.asarray(plan.h_type),
-            h_skew=jnp.asarray(plan.h_skew),
-            h_possel0=jnp.asarray(h_possel0),
-            z_type=jnp.asarray(plan.z_type),
-            z_skew=jnp.asarray(plan.z_skew),
-            z_key=jnp.asarray(plan.z_key),
-            z_mindom=jnp.asarray(plan.z_mindom),
-            z_domains=jnp.asarray(plan.z_domains),
-            z_rank=jnp.asarray(plan.z_rank),
+            h_type=jnp.asarray(_pad(plan.h_type, {0: Ghp}, 0)),
+            h_skew=jnp.asarray(_pad(plan.h_skew, {0: Ghp}, 0)),
+            h_possel0=jnp.asarray(_pad(h_possel0, {0: Ghp}, False)),
+            z_type=jnp.asarray(_pad(plan.z_type, {0: Gzp}, 0)),
+            z_skew=jnp.asarray(_pad(plan.z_skew, {0: Gzp}, 0)),
+            z_key=jnp.asarray(_pad(plan.z_key, {0: Gzp}, 0)),
+            z_mindom=jnp.asarray(
+                _pad(plan.z_mindom, {0: Gzp}, topoplan.NO_MIN_DOMAINS)
+            ),
+            z_domains=jnp.asarray(_pad(plan.z_domains, {0: Gzp, 1: Vp}, False)),
+            z_rank=jnp.asarray(_pad(plan.z_rank, {0: Gzp, 1: Vp}, RANK_NONE)),
+        )
+        # slot valmask pads True everywhere: defined keys re-acquire False
+        # pad columns on first intersection with a (False-padded) class mask;
+        # EXISTING slots' defined keys must pad False now or anti-affinity
+        # rowcounts see phantom values
+        valmask_p = _pad(valmask, {1: Kp, 2: Vp}, True)
+        defines_p = _pad(defines, {1: Kp}, False)
+        valmask_p[:, : K] = np.where(
+            defines[:, :K, None],
+            _pad(valmask, {2: Vp}, False)[:, :K],
+            valmask_p[:, :K],
         )
         init_state = SlotState(
-            valmask=jnp.asarray(valmask),
-            defines=jnp.asarray(defines),
-            complement=jnp.asarray(complement),
-            negative=jnp.asarray(negative),
-            gt=jnp.asarray(gt),
-            lt=jnp.asarray(lt),
-            itmask=jnp.asarray(itmask),
-            requests=jnp.asarray(requests),
-            capacity=jnp.asarray(capacity),
+            valmask=jnp.asarray(valmask_p),
+            defines=jnp.asarray(defines_p),
+            complement=jnp.asarray(_pad(complement, {1: Kp}, True)),
+            negative=jnp.asarray(_pad(negative, {1: Kp}, True)),
+            gt=jnp.asarray(_pad(gt, {1: Kp}, GT_NONE)),
+            lt=jnp.asarray(_pad(lt, {1: Kp}, LT_NONE)),
+            itmask=jnp.asarray(_pad(itmask, {1: Tp}, False)),
+            requests=jnp.asarray(_pad(requests, {1: Rp}, 0.0)),
+            capacity=jnp.asarray(_pad(capacity, {1: Rp}, np.float32(BIG))),
             kind=jnp.asarray(kind),
             template=jnp.asarray(template_arr),
             podcount=jnp.zeros((N,), dtype=jnp.int32),
             next_free=jnp.int32(E),
             overflow=jnp.asarray(False),
-            hcount=jnp.asarray(hcount0),
-            zcount=jnp.asarray(plan.zcount0),
+            hcount=jnp.asarray(_pad(hcount0, {1: Ghp}, 0)),
+            zcount=jnp.asarray(_pad(plan.zcount0, {0: Gzp, 1: Vp}, 0)),
             carry=jnp.int32(0),
         )
 
@@ -746,7 +839,10 @@ class DeviceScheduler:
     def _class_steps(self, prep: _Prepared) -> ClassStep:
         """Per-STEP scanned arrays: one step per class, except self-selecting
         label-spread classes which expand to one pinned sub-step per
-        admissible domain (ops/topoplan.py)."""
+        admissible domain (ops/topoplan.py). All axes pad to the bucketed
+        shapes of prep.statics/init_state; steps pad to a bucketed count
+        with inert entries (count=0, no viable template — the scan carries
+        state through them unchanged)."""
         cm = prep.class_masks
         plan = prep.plan
         steps = plan.steps
@@ -756,6 +852,14 @@ class DeviceScheduler:
             [prep.classes[ci].count for ci in cis], dtype=np.int32
         )
         J = len(steps)
+        Jp = _bucket(J)
+        Kp = int(prep.statics.well_known.shape[0])
+        Vp = int(prep.statics.z_domains.shape[1])
+        Tp = int(prep.statics.it_alloc.shape[0])
+        Sp = int(prep.statics.tmpl_it.shape[0])
+        Rp = int(prep.statics.it_alloc.shape[1])
+        Ghp = int(prep.statics.h_type.shape[0])
+        Gzp = int(prep.statics.z_type.shape[0])
         zone_rest = (
             np.stack(
                 [
@@ -768,41 +872,53 @@ class DeviceScheduler:
             if J
             else np.zeros((0, V), dtype=bool)
         )
+
+        def stepvec(values, dtype, fill):
+            return _pad(np.array(values, dtype=dtype), {0: Jp}, fill)
+
+        mask = _pad(cm.mask[cis], {0: Jp, 1: Kp, 2: Vp}, False)
+        defines = _pad(cm.defines[cis], {0: Jp, 1: Kp}, False)
+        mask = np.where(defines[:, :, None], mask, True)  # neutral pads
+        smask = _pad(prep.smask[cis], {0: Jp, 1: Kp, 2: Vp}, True)
         return ClassStep(
-            mask=jnp.asarray(cm.mask[cis]),
-            defines=jnp.asarray(cm.defines[cis]),
-            concrete=jnp.asarray(cm.concrete[cis]),
-            negative=jnp.asarray(cm.negative[cis]),
-            gt=jnp.asarray(cm.gt[cis]),
-            lt=jnp.asarray(cm.lt[cis]),
-            count=jnp.asarray(counts),
-            requests=jnp.asarray(prep.class_requests[cis]),
-            class_it=jnp.asarray(prep.class_it[cis]),
-            tmpl_ok=jnp.asarray(prep.tmpl_ok[cis]),
-            exist_taint_ok=jnp.asarray(prep.exist_taint_ok[cis]),
-            new_template=jnp.asarray(prep.new_template[cis]),
-            kstar=jnp.asarray(prep.kstar[cis]),
-            smask=jnp.asarray(prep.smask[cis]),
-            h_sel=jnp.asarray(plan.h_sel[cis]),
-            h_owner=jnp.asarray(plan.h_owner[cis]),
-            z_sel=jnp.asarray(plan.z_sel[cis]),
-            z_owner=jnp.asarray(plan.z_owner[cis]),
+            mask=jnp.asarray(mask),
+            defines=jnp.asarray(defines),
+            concrete=jnp.asarray(_pad(cm.concrete[cis], {0: Jp, 1: Kp}, False)),
+            negative=jnp.asarray(_pad(cm.negative[cis], {0: Jp, 1: Kp}, True)),
+            gt=jnp.asarray(_pad(cm.gt[cis], {0: Jp, 1: Kp}, GT_NONE)),
+            lt=jnp.asarray(_pad(cm.lt[cis], {0: Jp, 1: Kp}, LT_NONE)),
+            count=jnp.asarray(_pad(counts, {0: Jp}, 0)),
+            requests=jnp.asarray(
+                _pad(prep.class_requests[cis], {0: Jp, 1: Rp}, 0.0)
+            ),
+            class_it=jnp.asarray(_pad(prep.class_it[cis], {0: Jp, 1: Tp}, False)),
+            tmpl_ok=jnp.asarray(_pad(prep.tmpl_ok[cis], {0: Jp, 1: Sp}, False)),
+            exist_taint_ok=jnp.asarray(
+                _pad(prep.exist_taint_ok[cis], {0: Jp}, False)
+            ),
+            new_template=jnp.asarray(_pad(prep.new_template[cis], {0: Jp}, -1)),
+            kstar=jnp.asarray(_pad(prep.kstar[cis], {0: Jp}, 0)),
+            smask=jnp.asarray(smask),
+            h_sel=jnp.asarray(_pad(plan.h_sel[cis], {0: Jp, 1: Ghp}, False)),
+            h_owner=jnp.asarray(_pad(plan.h_owner[cis], {0: Jp, 1: Ghp}, False)),
+            z_sel=jnp.asarray(_pad(plan.z_sel[cis], {0: Jp, 1: Gzp}, False)),
+            z_owner=jnp.asarray(_pad(plan.z_owner[cis], {0: Jp, 1: Gzp}, False)),
             sub_value=jnp.asarray(
-                np.array([s.sub_value for s in steps], dtype=np.int32)
+                stepvec([s.sub_value for s in steps], np.int32, -1)
             ),
             sub_first=jnp.asarray(
-                np.array([s.sub_first for s in steps], dtype=bool)
+                stepvec([s.sub_first for s in steps], bool, True)
             ),
             sub_last=jnp.asarray(
-                np.array([s.sub_last for s in steps], dtype=bool)
+                stepvec([s.sub_last for s in steps], bool, True)
             ),
             wf_group=jnp.asarray(
-                np.array([s.wf_group for s in steps], dtype=np.int32)
+                stepvec([s.wf_group for s in steps], np.int32, -1)
             ),
             wf_key=jnp.asarray(
-                np.array([s.wf_key for s in steps], dtype=np.int32)
+                stepvec([s.wf_key for s in steps], np.int32, -1)
             ),
-            zone_rest=jnp.asarray(zone_rest),
+            zone_rest=jnp.asarray(_pad(zone_rest, {0: Jp, 1: Vp}, False)),
         )
 
     def _catalog_union(self) -> List[InstanceType]:
