@@ -129,8 +129,25 @@ class Operator:
             self.clock,
             enabled=self.options.feature_gates.get("NodeRepair", False),
         )
+        # pod-trigger batching gates the solve (batcher.go:33-110); the
+        # store's synchronous watch is the trigger controller
+        # (provisioning/controller.go:54-76)
+        from karpenter_core_tpu.controllers.provisioning.batcher import Batcher
+
+        self.batcher = Batcher(
+            self.clock,
+            max_duration=self.options.batch_max_duration,
+            idle_duration=self.options.batch_idle_duration,
+        )
+        self.kube.watch(self._trigger_on_pod)
         # claim/node name -> pod keys awaiting bind
         self.nominations: Dict[str, List[str]] = {}
+
+    def _trigger_on_pod(self, event: str, kind: str, obj) -> None:
+        if kind != "Pod" or event == "DELETED":
+            return
+        if podutil.is_provisionable(obj):
+            self.batcher.trigger()
 
     # -- one pass ----------------------------------------------------------
 
@@ -150,7 +167,10 @@ class Operator:
             self.termination.reconcile(node)
             self.node_health.reconcile(node)
         self._bind_nominated()
-        if any(podutil.is_provisionable(p) for p in self.kube.list_pods()):
+        if self.batcher.ready() and any(
+            podutil.is_provisionable(p) for p in self.kube.list_pods()
+        ):
+            self.batcher.reset()
             self._provision()
         if disrupt:
             self.disruption.reconcile()
@@ -196,9 +216,13 @@ class Operator:
             before = self.kube.mutations
             self.reconcile_once(disrupt=disrupt)
             if self.kube.mutations == before and not self.disruption.in_flight:
-                wait = self.disruption.validation_wait_remaining()
-                if disrupt and wait > 0 and hasattr(self.clock, "step"):
-                    self.clock.step(wait)
+                waits = [self.batcher.wait_remaining()]
+                if disrupt:
+                    waits.append(self.disruption.validation_wait_remaining())
+                waits = [w for w in waits if w > 0]
+                if waits and hasattr(self.clock, "step"):
+                    # fire the nearest timer first (batch close / TTL elapse)
+                    self.clock.step(min(waits))
                     continue
                 return i + 1
         return max_iters

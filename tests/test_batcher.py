@@ -1,0 +1,90 @@
+"""Batcher window semantics (reference: batcher.go:33-110): 1s idle / 10s
+max windows gate when the provisioner solves.
+"""
+from tests.helpers import make_nodepool, make_pod
+from tests.test_e2e import new_operator
+
+from karpenter_core_tpu.controllers.provisioning.batcher import Batcher
+from karpenter_core_tpu.utils.clock import FakeClock
+
+
+class TestBatcherUnit:
+    def test_idle_window_closes_batch(self):
+        clk = FakeClock()
+        b = Batcher(clk, max_duration=10.0, idle_duration=1.0)
+        b.trigger()
+        assert not b.ready()
+        clk.step(0.5)
+        b.trigger()  # activity keeps the window open
+        clk.step(0.9)
+        assert not b.ready()
+        clk.step(0.2)  # 1.1s since last trigger
+        assert b.ready()
+
+    def test_max_window_bounds_a_busy_stream(self):
+        clk = FakeClock()
+        b = Batcher(clk, max_duration=10.0, idle_duration=1.0)
+        b.trigger()
+        # continuous triggers every 0.5s never go idle...
+        for _ in range(25):
+            clk.step(0.5)
+            b.trigger()
+        # ...but 10s after the window opened, the batch closes regardless
+        assert b.ready()
+
+    def test_reset_reopens(self):
+        clk = FakeClock()
+        b = Batcher(clk, max_duration=10.0, idle_duration=1.0)
+        b.trigger()
+        clk.step(1.5)
+        assert b.ready()
+        b.reset()
+        assert not b.ready() and not b.open
+        b.trigger()
+        assert b.open and not b.ready()
+
+    def test_wait_remaining(self):
+        clk = FakeClock()
+        b = Batcher(clk, max_duration=10.0, idle_duration=1.0)
+        assert b.wait_remaining() == 0.0
+        b.trigger()
+        assert abs(b.wait_remaining() - 1.0) < 1e-9
+        # near the max window, the max bound dominates the idle bound
+        for _ in range(19):
+            clk.step(0.5)
+            b.trigger()
+        assert abs(b.wait_remaining() - 0.5) < 1e-9
+
+
+class TestBatcherOperator:
+    def test_no_solve_before_window_closes(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(make_pod(cpu=1.0, name="p0"))
+        # same-instant reconcile: the window is open but not closed
+        op.reconcile_once()
+        assert not op.kube.list_nodeclaims(), "solved inside the batch window"
+        # idle window elapses -> the solve fires
+        op.clock.step(1.1)
+        op.reconcile_once()
+        assert op.kube.list_nodeclaims()
+
+    def test_stream_batches_into_one_solve(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        for i in range(5):
+            op.kube.create(make_pod(cpu=0.5, name=f"p{i}"))
+            op.reconcile_once()  # stream arrives within one window
+        assert not op.kube.list_nodeclaims()
+        op.clock.step(1.1)
+        op.run_until_idle()
+        # one batch -> one claim serves all five pods
+        assert len(op.kube.list_nodeclaims()) == 1
+        assert all(p.node_name for p in op.kube.list_pods())
+
+    def test_run_until_idle_steps_the_window(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(make_pod(cpu=1.0, name="p0"))
+        op.run_until_idle()
+        assert all(p.node_name for p in op.kube.list_pods())
