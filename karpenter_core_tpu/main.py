@@ -1,0 +1,49 @@
+"""Operator CLI entry point (reference: kwok/main.go:28-47).
+
+Builds the full controller stack over the in-memory store + kwok provider
+and runs the reconcile loop. Flags/env parse through Options.parse
+(--solver greedy|tpu, --batch-max-duration, --batch-idle-duration,
+--log-level, --feature-gates Name=true,...), plus loop controls:
+--poll-interval seconds between passes, --max-iters to bound the run
+(0 = run until interrupted).
+
+    python -m karpenter_core_tpu.main --solver tpu --log-level debug
+"""
+from __future__ import annotations
+
+import sys
+import time
+from typing import List, Optional
+
+from karpenter_core_tpu.logging import configure
+from karpenter_core_tpu.operator import Operator, Options
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    options = Options.parse(argv)
+    logger = configure(options.log_level)
+
+    op = Operator(options=options)
+    logger.info(
+        "operator starting: solver=%s batch=%ss/%ss gates=%s",
+        options.solver,
+        options.batch_max_duration,
+        options.batch_idle_duration,
+        options.feature_gates,
+    )
+    n = 0
+    try:
+        while True:
+            op.reconcile_once()
+            n += 1
+            if options.max_iters and n >= options.max_iters:
+                break
+            time.sleep(options.poll_interval)
+    except KeyboardInterrupt:
+        logger.info("operator interrupted after %d passes", n)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

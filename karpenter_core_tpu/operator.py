@@ -36,6 +36,7 @@ from karpenter_core_tpu.controllers.nodeclaim.gc import (
     Expiration,
     GarbageCollection,
 )
+from karpenter_core_tpu.controllers.nodeclaim.hydration import Hydration
 from karpenter_core_tpu.controllers.nodeclaim.lifecycle import NodeClaimLifecycle
 from karpenter_core_tpu.controllers.nodepool.controllers import (
     Counter,
@@ -54,13 +55,71 @@ from karpenter_core_tpu.utils.clock import Clock
 @dataclass
 class Options:
     """Flag surface (reference: pkg/operator/options/options.go:49-102, plus
-    the new solver seam)."""
+    the new solver seam). Resolution order mirrors AddFlags + env fallback
+    (options.go:85-144): explicit flag > KARPENTER_* env var > default;
+    feature gates parse from the comma-separated "Name=bool" string."""
 
     solver: str = "greedy"  # greedy | tpu
     batch_max_duration: float = 10.0
     batch_idle_duration: float = 1.0
+    log_level: str = "info"
+    poll_interval: float = 1.0  # CLI loop pacing
+    max_iters: int = 0  # CLI loop bound (0 = until interrupted)
     feature_gates: Dict[str, bool] = field(default_factory=dict)
     device_scheduler_opts: Dict = field(default_factory=dict)
+
+    _FLAGS = {
+        "solver": ("--solver", "KARPENTER_SOLVER", str),
+        "batch_max_duration": (
+            "--batch-max-duration", "KARPENTER_BATCH_MAX_DURATION", float,
+        ),
+        "batch_idle_duration": (
+            "--batch-idle-duration", "KARPENTER_BATCH_IDLE_DURATION", float,
+        ),
+        "log_level": ("--log-level", "KARPENTER_LOG_LEVEL", str),
+        "poll_interval": ("--poll-interval", "KARPENTER_POLL_INTERVAL", float),
+        "max_iters": ("--max-iters", "KARPENTER_MAX_ITERS", int),
+    }
+
+    @classmethod
+    def parse(cls, argv=None, env=None) -> "Options":
+        import os as _os
+
+        argv = list(argv or [])
+        env = dict(env if env is not None else _os.environ)
+        opts = cls()
+        known = {flag for flag, _, _ in cls._FLAGS.values()} | {
+            "--feature-gates"
+        }
+        flat: Dict[str, str] = {}
+        i = 0
+        while i < len(argv):
+            arg = argv[i]
+            name = arg.split("=", 1)[0]
+            if name not in known:
+                raise ValueError(f"unknown flag {arg!r}")
+            if "=" in arg:
+                flat[name] = arg.split("=", 1)[1]
+            elif i + 1 < len(argv):
+                flat[name] = argv[i + 1]
+                i += 1
+            else:
+                raise ValueError(f"flag {arg!r} needs a value")
+            i += 1
+        for attr, (flag, envvar, conv) in cls._FLAGS.items():
+            if flag in flat:
+                setattr(opts, attr, conv(flat[flag]))
+            elif envvar in env:
+                setattr(opts, attr, conv(env[envvar]))
+        gates = flat.get(
+            "--feature-gates", env.get("KARPENTER_FEATURE_GATES", "")
+        )
+        for part in filter(None, (p.strip() for p in gates.split(","))):
+            name, _, value = part.partition("=")
+            opts.feature_gates[name] = value.lower() in ("true", "1", "yes")
+        if opts.solver not in ("greedy", "tpu"):
+            raise ValueError(f"unknown solver {opts.solver!r}")
+        return opts
 
 
 class Operator:
@@ -111,6 +170,7 @@ class Operator:
             feature_gates=self.options.feature_gates,
         )
         self.recorder = Recorder(self.clock)
+        self.hydration = Hydration(self.kube)
         self.expiration = Expiration(self.kube, self.clock)
         self.garbage_collection = GarbageCollection(
             self.kube, self.cloud_provider, self.clock
@@ -149,6 +209,17 @@ class Operator:
         if podutil.is_provisionable(obj):
             self.batcher.trigger()
 
+    # -- health surface (operator.go:181-198 healthz/readyz) ---------------
+
+    def healthz(self) -> bool:
+        """Liveness: the process can serve (always true in-process)."""
+        return True
+
+    def readyz(self) -> bool:
+        """Readiness: cluster state has caught up with the store — the
+        Synced gate every solve already requires (state/cluster.go:96-150)."""
+        return self.cluster.synced()
+
     # -- one pass ----------------------------------------------------------
 
     def reconcile_once(self, disrupt: bool = True) -> None:
@@ -159,6 +230,7 @@ class Operator:
             self.nodepool_counter.reconcile(pool)
         for claim in list(self.kube.list_nodeclaims()):
             self.lifecycle.reconcile(claim)
+            self.hydration.reconcile(claim)
             self.nodeclaim_disruption.reconcile(claim)
             self.expiration.reconcile(claim)
             self.consistency.reconcile(claim)
@@ -167,11 +239,21 @@ class Operator:
             self.termination.reconcile(node)
             self.node_health.reconcile(node)
         self._bind_nominated()
-        if self.batcher.ready() and any(
+        provisionable = any(
             podutil.is_provisionable(p) for p in self.kube.list_pods()
-        ):
+        )
+        # self-heal: pods can become provisionable without a Pod write (a
+        # nominated claim died; a pre-populated store) — open a window for
+        # them so the batcher gate can never starve the solve
+        if provisionable and not self.batcher.open:
+            self.batcher.trigger()
+        if self.batcher.ready():
+            # a closed window resets even with nothing to solve (deleted
+            # pods), or its stale age would instantly close the next burst's
+            # window and split it into per-pod solves
             self.batcher.reset()
-            self._provision()
+            if provisionable:
+                self._provision()
         if disrupt:
             self.disruption.reconcile()
         self._export_metrics()

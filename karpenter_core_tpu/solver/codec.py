@@ -1,0 +1,115 @@
+"""Snapshot wire codec: the solver's process boundary.
+
+SURVEY §7 and BASELINE frame the solver as a service a control plane talks
+to over gRPC/DCN; this codec is that boundary's payload format. A solve
+request (the ``Snapshot`` from solver/snapshot.py — pure numpy + interned
+vocab) and a solve response (per-class slot assignments) round-trip
+through bytes with no Python-specific pickling: arrays ride npz, the
+vocab/metadata ride JSON. A Go (or any) client can produce the same
+layout; the in-process path simply skips the codec.
+"""
+from __future__ import annotations
+
+import io
+import json
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from karpenter_core_tpu.solver.vocab import EntityMasks, Vocab
+
+_HEADER_KEY = "__header__"
+
+
+def _masks_to_arrays(prefix: str, m: EntityMasks, out: Dict[str, np.ndarray]):
+    out[f"{prefix}_mask"] = m.mask
+    out[f"{prefix}_defines"] = m.defines
+    out[f"{prefix}_concrete"] = m.concrete
+    out[f"{prefix}_negative"] = m.negative
+    out[f"{prefix}_gt"] = m.gt
+    out[f"{prefix}_lt"] = m.lt
+
+
+def _masks_from_arrays(prefix: str, z) -> EntityMasks:
+    return EntityMasks(
+        mask=z[f"{prefix}_mask"],
+        defines=z[f"{prefix}_defines"],
+        concrete=z[f"{prefix}_concrete"],
+        negative=z[f"{prefix}_negative"],
+        gt=z[f"{prefix}_gt"],
+        lt=z[f"{prefix}_lt"],
+    )
+
+
+def encode_request(
+    vocab,
+    resource_names: List[str],
+    class_masks: EntityMasks,
+    class_requests: np.ndarray,
+    class_counts: np.ndarray,
+    it_masks: EntityMasks,
+    it_allocatable: np.ndarray,
+) -> bytes:
+    """Serialize one solve request. The vocab's interning tables travel in
+    the header so the solver reconstructs the identical closed world."""
+    header = {
+        "version": 1,
+        "resource_names": list(resource_names),
+        "key_names": list(vocab.key_names),
+        "value_names": [list(v) for v in vocab.value_names],
+    }
+    arrays: Dict[str, np.ndarray] = {
+        "class_requests": class_requests,
+        "class_counts": class_counts,
+        "it_allocatable": it_allocatable,
+    }
+    _masks_to_arrays("class", class_masks, arrays)
+    _masks_to_arrays("it", it_masks, arrays)
+    buf = io.BytesIO()
+    arrays[_HEADER_KEY] = np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8
+    )
+    np.savez_compressed(buf, **arrays)
+    return buf.getvalue()
+
+
+def decode_request(data: bytes):
+    """Inverse of encode_request: (vocab, resource_names, class_masks,
+    class_requests, class_counts, it_masks, it_allocatable)."""
+    z = np.load(io.BytesIO(data))
+    header = json.loads(bytes(z[_HEADER_KEY]).decode())
+    # re-intern through Vocab so derived tables (int_values, valid) match
+    # the sender's exactly — insertion order preserves every id
+    v = Vocab()
+    for key in header["key_names"]:
+        v.key_id(key)
+    for key, names in zip(header["key_names"], header["value_names"]):
+        for name in names:
+            v.value_id(key, name)
+    vocab = v.finalize()
+    return (
+        vocab,
+        list(header["resource_names"]),
+        _masks_from_arrays("class", z),
+        z["class_requests"],
+        z["class_counts"],
+        _masks_from_arrays("it", z),
+        z["it_allocatable"],
+    )
+
+
+def encode_response(
+    takes: np.ndarray, unplaced: np.ndarray, slot_template: np.ndarray
+) -> bytes:
+    """Serialize one solve response: per-step × per-slot take counts plus
+    the chosen template per fresh slot."""
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf, takes=takes, unplaced=unplaced, slot_template=slot_template
+    )
+    return buf.getvalue()
+
+
+def decode_response(data: bytes) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    z = np.load(io.BytesIO(data))
+    return z["takes"], z["unplaced"], z["slot_template"]

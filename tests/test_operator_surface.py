@@ -1,0 +1,145 @@
+"""Operator-surface periphery: options flag/env parsing, logging, CLI
+entry, hydration, cloud-provider metrics decorator, health probes
+(reference: options.go:85-144, logging.go:35-79, kwok/main.go:28-47,
+hydration/controller.go:41-78, cloudprovider/metrics).
+"""
+import pytest
+
+from tests.helpers import make_nodepool, make_pod
+from tests.test_e2e import new_operator
+
+from karpenter_core_tpu.operator import Options
+
+
+class TestOptionsParse:
+    def test_defaults(self):
+        o = Options.parse([], env={})
+        assert o.solver == "greedy" and o.batch_max_duration == 10.0
+
+    def test_flags_space_and_equals(self):
+        o = Options.parse(
+            ["--solver", "tpu", "--batch-max-duration=5",
+             "--batch-idle-duration", "0.5", "--log-level=debug"],
+            env={},
+        )
+        assert o.solver == "tpu"
+        assert o.batch_max_duration == 5.0
+        assert o.batch_idle_duration == 0.5
+        assert o.log_level == "debug"
+
+    def test_env_fallback_and_flag_priority(self):
+        env = {"KARPENTER_SOLVER": "tpu", "KARPENTER_BATCH_MAX_DURATION": "3"}
+        o = Options.parse([], env=env)
+        assert o.solver == "tpu" and o.batch_max_duration == 3.0
+        o2 = Options.parse(["--solver", "greedy"], env=env)
+        assert o2.solver == "greedy"  # flag wins over env
+
+    def test_feature_gates_string(self):
+        o = Options.parse(
+            ["--feature-gates", "NodeRepair=true,SpotToSpot=false"], env={}
+        )
+        assert o.feature_gates == {"NodeRepair": True, "SpotToSpot": False}
+
+    def test_unknown_solver_rejected(self):
+        with pytest.raises(ValueError):
+            Options.parse(["--solver", "quantum"], env={})
+
+    def test_unknown_flag_rejected(self):
+        # a typo'd flag must error, not silently swallow the next flag
+        with pytest.raises(ValueError):
+            Options.parse(["--verbose", "--solver", "tpu"], env={})
+
+    def test_loop_flags_both_forms(self):
+        o = Options.parse(
+            ["--poll-interval=2.5", "--max-iters", "7"], env={}
+        )
+        assert o.poll_interval == 2.5 and o.max_iters == 7
+
+
+class TestLogging:
+    def test_configure_levels_and_nop(self):
+        import logging as stdlib_logging
+
+        from karpenter_core_tpu.logging import configure, nop_logger
+
+        logger = configure("debug")
+        assert logger.level == stdlib_logging.DEBUG
+        configure("error")
+        assert logger.level == stdlib_logging.ERROR
+        nop = nop_logger()
+        assert not nop.isEnabledFor(stdlib_logging.CRITICAL)
+
+
+class TestCLI:
+    def test_main_runs_bounded_loop(self, capsys):
+        from karpenter_core_tpu.main import main
+
+        assert main(["--solver", "greedy", "--max-iters", "2",
+                     "--poll-interval", "0"]) == 0
+
+
+class TestHydration:
+    def test_nodeclass_label_backfilled(self):
+        from karpenter_core_tpu.api.nodeclaim import NodeClassRef
+
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(make_pod(cpu=1.0, name="p0"))
+        op.run_until_idle()
+        claim = op.kube.list_nodeclaims()[0]
+        # a pre-existing (old-version) claim: nodeClassRef set, label absent
+        claim.spec.node_class_ref = NodeClassRef(
+            group="karpenter.kwok.sh", kind="KWOKNodeClass", name="default"
+        )
+        op.kube.update(claim)
+        op.run_until_idle()
+        key = "karpenter.kwok.sh/kwoknodeclass"
+        claim = op.kube.get(type(claim), claim.name)
+        assert claim.metadata.labels.get(key) == "default"
+        node = op.kube.get_node_by_provider_id(claim.status.provider_id)
+        assert node.metadata.labels.get(key) == "default"
+
+
+class TestCloudProviderMetrics:
+    def test_decorator_records_durations_and_errors(self):
+        from karpenter_core_tpu.cloudprovider.metrics import (
+            METHOD_DURATION,
+            METHOD_ERRORS,
+            MetricsDecorator,
+        )
+
+        class Boom(Exception):
+            pass
+
+        class FakeProvider:
+            name = "fake"
+
+            def get_instance_types(self, nodepool):
+                return ["it"]
+
+            def delete(self, claim):
+                raise Boom("nope")
+
+        p = MetricsDecorator(FakeProvider())
+        assert p.name == "fake"  # non-wrapped attrs forward
+        assert p.get_instance_types(None) == ["it"]
+        labels = {"method": "get_instance_types", "provider": "FakeProvider"}
+        assert METHOD_DURATION.totals.get(
+            tuple(sorted(labels.items()))
+        )
+        with pytest.raises(Boom):
+            p.delete(None)
+        err_labels = {
+            "method": "delete", "provider": "FakeProvider", "error": "Boom",
+        }
+        assert METHOD_ERRORS.value(err_labels) == 1
+
+
+class TestHealthProbes:
+    def test_ready_after_sync(self):
+        op = new_operator()
+        assert op.healthz()
+        op.kube.create(make_nodepool())
+        op.kube.create(make_pod(cpu=1.0, name="p0"))
+        op.run_until_idle()
+        assert op.readyz()
