@@ -310,13 +310,28 @@ class MultiNodeConsolidation(_ConsolidationBase):
         if self.ctx.provisioner.solver == "tpu":
             frontier_sizes = self._device_frontier(candidates)
         if frontier_sizes:
-            # host-exact validation (price filters, spot rules) at the
-            # device frontier, stepping down on price-infeasibility
-            for size in frontier_sizes:
+            # host-exact validation (price filters, spot rules) walks the
+            # device-viable ladder: the largest few outright, then a binary
+            # search over the REMAINING viable sizes — never the full [2,n]
+            # range the reference probes (host validity is monotone in
+            # prefix size, the same assumption its binary search makes)
+            head, tail = frontier_sizes[:4], frontier_sizes[4:]
+            for size in head:
                 ok, cmd = self._host_validate(candidates, size)
                 if ok:
                     best = cmd
                     break
+            if best.decision == "no-op" and tail:
+                asc = tail[::-1]  # ascending sizes
+                lo, hi = 0, len(asc) - 1
+                while lo <= hi:
+                    mid = (lo + hi) // 2
+                    ok, cmd = self._host_validate(candidates, asc[mid])
+                    if ok:
+                        best = cmd
+                        lo = mid + 1
+                    else:
+                        hi = mid - 1
         if best.decision == "no-op":
             if frontier_sizes == []:
                 # the device proved no prefix schedulable, but its FFD is
@@ -330,14 +345,17 @@ class MultiNodeConsolidation(_ConsolidationBase):
                 if ok:
                     best = cmd
                     best = self._binary_search(candidates, 3, best)
-            else:
-                # no frontier available, or the tried frontier sizes all
-                # failed host validation (price filters may pass at smaller
-                # untried sizes): reference binary search; lo=2 keeps the
-                # >=2-candidate invariant (multinodeconsolidation.go:111-118
-                # never probes below a 2-candidate prefix — size 1 belongs
-                # to SingleNodeConsolidation)
+            elif frontier_sizes is None:
+                # no frontier available (topology-coupled pods): reference
+                # binary search; lo=2 keeps the >=2-candidate invariant
+                # (multinodeconsolidation.go:111-118 never probes below a
+                # 2-candidate prefix — size 1 belongs to
+                # SingleNodeConsolidation)
                 best = self._binary_search(candidates, 2, best)
+            # a non-empty frontier whose every size failed host (price)
+            # validation deliberately ends the cycle no-op: sizes outside
+            # the device-viable set face the same price filters, and
+            # SingleNodeConsolidation sweeps up the small wins next poll
         if best.decision != "no-op":
             for c in best.candidates:
                 budgets.consume(c.nodepool.name, self.reason)
@@ -389,7 +407,7 @@ class MultiNodeConsolidation(_ConsolidationBase):
             if ok and n_new <= 1
         ]
         sizes.sort(reverse=True)
-        return sizes[:4]  # frontier + a few step-downs for price filtering
+        return sizes
 
     @staticmethod
     def _filter_out_same_type(replacement, consolidate: List[Candidate]) -> None:
