@@ -394,6 +394,10 @@ def _consolidation_bench(n_nodes=2000, n_candidates=100, repeats=3):
 def main():
     from karpenter_core_tpu.cloudprovider.kwok import bench_catalog
     from karpenter_core_tpu.api.objects import Taint
+    from karpenter_core_tpu.utils.jaxenv import enable_persistent_compile_cache
+
+    # cold solves amortize across driver runs via the on-disk XLA cache
+    enable_persistent_compile_cache()
 
     catalog = bench_catalog(N_TYPES)
 

@@ -70,11 +70,13 @@ class DisruptionController:
         cloud_provider,
         clock,
         feature_gates: Optional[Dict[str, bool]] = None,
+        recorder=None,
     ):
         self.kube = kube
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock
+        self.recorder = recorder
         ctx = DisruptionContext(
             kube=kube,
             cluster=cluster,
@@ -158,6 +160,15 @@ class DisruptionController:
             m.DISRUPTION_VALIDATION_FAILURES.inc(
                 {"reason": pending.method.reason}
             )
+            if self.recorder is not None:
+                from karpenter_core_tpu.events import Event
+
+                self.recorder.publish(Event(
+                    involved_object="Deployment/karpenter",
+                    type="Normal",
+                    reason="DisruptionValidationFailed",
+                    message=err,
+                ))
             return None
         self._execute(pending.command)
         return pending.command
@@ -170,6 +181,21 @@ class DisruptionController:
         m.DISRUPTION_DECISIONS.inc(
             {"decision": command.decision, "reason": command.reason}
         )
+        if self.recorder is not None:
+            from karpenter_core_tpu.events import Event
+
+            self.recorder.publish(*[
+                Event(
+                    involved_object=f"Node/{c.name}",
+                    type="Normal",
+                    reason="DisruptionTerminating",
+                    message=(
+                        f"Disrupting node via {command.reason} "
+                        f"({command.decision})"
+                    ),
+                )
+                for c in command.candidates
+            ])
         # taint + mark so the provisioner stops using the candidates
         for c in command.candidates:
             node = self.kube.get(Node, c.name)

@@ -19,11 +19,12 @@ def _is_critical(pod) -> bool:
 
 
 class NodeTermination:
-    def __init__(self, kube, cluster, cloud_provider, clock):
+    def __init__(self, kube, cluster, cloud_provider, clock, recorder=None):
         self.kube = kube
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock
+        self.recorder = recorder
 
     def reconcile(self, node: Node) -> None:
         if node.metadata.deletion_timestamp is None:
@@ -82,7 +83,16 @@ class NodeTermination:
                 for p in group:
                     try:
                         self.kube.evict(p)
-                    except TooManyRequestsError:
+                    except TooManyRequestsError as e:
+                        if self.recorder is not None:
+                            from karpenter_core_tpu.events import Event
+
+                            self.recorder.publish(Event(
+                                involved_object=f"Pod/{p.key()}",
+                                type="Warning",
+                                reason="FailedDraining",
+                                message=str(e),
+                            ))
                         continue
                 break  # later groups wait for this one to drain
         if any(

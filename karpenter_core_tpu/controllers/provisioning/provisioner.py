@@ -36,6 +36,7 @@ class Provisioner:
         clock,
         solver: str = "greedy",
         device_scheduler_opts: Optional[dict] = None,
+        recorder=None,
     ):
         self.kube = kube
         self.cluster = cluster
@@ -43,6 +44,7 @@ class Provisioner:
         self.clock = clock
         self.solver = solver
         self.device_scheduler_opts = device_scheduler_opts or {}
+        self.recorder = recorder
 
     # -- input assembly ----------------------------------------------------
 
@@ -144,6 +146,20 @@ class Provisioner:
             results = scheduler.solve(pods)
         results.pod_errors.update(volume_errors)
         m.UNSCHEDULABLE_PODS.set(len(results.pod_errors))
+        if self.recorder is not None and results.pod_errors:
+            from karpenter_core_tpu.events import Event
+
+            by_uid = {p.uid: p for p in pods}
+            self.recorder.publish(*[
+                Event(
+                    involved_object=f"Pod/{by_uid[uid].key()}",
+                    type="Warning",
+                    reason="FailedScheduling",
+                    message=msg,
+                )
+                for uid, msg in results.pod_errors.items()
+                if uid in by_uid
+            ])
         return results, pods
 
     # -- volume preprocessing (volumetopology.go inject+validate,
@@ -206,6 +222,18 @@ class Provisioner:
         for sim in results.existing_nodes:
             for p in sim.pods:
                 nominations[p.key()] = sim.name
+        if self.recorder is not None and nominations:
+            from karpenter_core_tpu.events import Event
+
+            self.recorder.publish(*[
+                Event(
+                    involved_object=f"Pod/{key}",
+                    type="Normal",
+                    reason="Nominated",
+                    message=f"Pod should schedule on {target}",
+                )
+                for key, target in nominations.items()
+            ])
 
         usage_by_pool = self._usage_by_nodepool()
         pools = {np.name: np for np in self.kube.list_nodepools()}

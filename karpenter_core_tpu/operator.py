@@ -143,6 +143,7 @@ class Operator:
             self.kube, instance_types
         )
         self.cluster = Cluster(self.kube, self.clock)
+        self.recorder = Recorder(self.clock)
         self.provisioner = Provisioner(
             self.kube,
             self.cluster,
@@ -150,12 +151,14 @@ class Operator:
             self.clock,
             solver=self.options.solver,
             device_scheduler_opts=self.options.device_scheduler_opts,
+            recorder=self.recorder,
         )
         self.lifecycle = NodeClaimLifecycle(
             self.kube, self.cluster, self.cloud_provider, self.clock
         )
         self.termination = NodeTermination(
-            self.kube, self.cluster, self.cloud_provider, self.clock
+            self.kube, self.cluster, self.cloud_provider, self.clock,
+            recorder=self.recorder,
         )
         self.nodeclaim_disruption = NodeClaimDisruption(
             self.kube, self.cloud_provider, self.clock
@@ -168,8 +171,8 @@ class Operator:
             self.cloud_provider,
             self.clock,
             feature_gates=self.options.feature_gates,
+            recorder=self.recorder,
         )
-        self.recorder = Recorder(self.clock)
         self.hydration = Hydration(self.kube)
         self.expiration = Expiration(self.kube, self.clock)
         self.garbage_collection = GarbageCollection(

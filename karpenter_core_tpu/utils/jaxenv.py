@@ -52,3 +52,20 @@ def force_virtual_cpu_mesh(n_devices: int) -> None:
             f"flag could not take effect; force_virtual_cpu_mesh({n_devices}) "
             f"must run before any JAX backend use"
         )
+
+
+def enable_persistent_compile_cache(path: str = ".jax_cache") -> None:
+    """Point JAX's persistent compilation cache at a repo-local directory.
+
+    The solver's cold compile is seconds of XLA work; the persistent cache
+    makes it a one-time cost per (shape-bucket, jax version, chip) instead
+    of per process. Safe to call multiple times; silently a no-op on jax
+    builds without the cache config.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", os.path.abspath(path))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    except Exception:
+        pass
