@@ -145,9 +145,13 @@ class Scheduler:
     def solve(self, pods: List[Pod]) -> Results:
         """The FFD loop (scheduler.go:208-266)."""
         errors: Dict[str, str] = {}
+        self.topology.ensure_inverse_initialized()
         for p in pods:
             self.cached_pod_requests[p.uid] = resutil.requests_for_pods(p)
-            self.topology.update(p)  # NewTopology registers every solve pod
+            # NewTopology registers every solve pod; constraint-free pods
+            # build no groups so the call is skipped on the 50k path
+            if p.topology_spread_constraints or p.affinity is not None:
+                self.topology.update(p)
         q = Queue(pods, self.cached_pod_requests)
         pods_by_uid = {p.uid: p for p in pods}
 

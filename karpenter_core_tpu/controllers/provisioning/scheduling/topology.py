@@ -371,12 +371,18 @@ class Topology:
 
     # -- group construction ------------------------------------------------
 
-    def update(self, pod: Pod) -> None:
-        """(Re)build the groups this pod owns; called for every pod entering
-        a solve and again after each relaxation (topology.go:105-140)."""
+    def ensure_inverse_initialized(self) -> None:
+        """Build inverse anti-affinity groups from existing cluster pods.
+        update() does this lazily; callers that skip update() for
+        constraint-free pods must call it once per solve instead."""
         if not self._inverse_initialized:
             self._update_inverse_affinities()
             self._inverse_initialized = True
+
+    def update(self, pod: Pod) -> None:
+        """(Re)build the groups this pod owns; called for every pod entering
+        a solve and again after each relaxation (topology.go:105-140)."""
+        self.ensure_inverse_initialized()
 
         for group in self.topologies.values():
             group.remove_owner(pod.uid)

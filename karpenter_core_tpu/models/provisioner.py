@@ -297,8 +297,12 @@ class DeviceScheduler:
             existing_pods=ctx.existing_pods if ctx is not None else None,
             excluded_pod_uids=ctx.excluded_pods if ctx is not None else (),
         )
+        topo.ensure_inverse_initialized()
         for p in pods:
-            topo.update(p)
+            # constraint-free pods build no groups; skipping the call is the
+            # 50k-path win (update() itself is a no-op for them)
+            if p.topology_spread_constraints or p.affinity is not None:
+                topo.update(p)
 
         # the topology planner decides which constraint shapes run in-kernel
         # (device count state) and which fall back to the host algebra

@@ -63,6 +63,24 @@ def _spec_signature(pod: Pod, label_aware: bool) -> tuple:
     groups CONSTRAIN it, so pods differing in either are not exchangeable.
     Topology-free solves skip both so deployment-distinct labels don't
     fragment the 50k-pod class collapse."""
+    # fast path for the dominant 50k-batch shape: resource-only pods (no
+    # affinity/tolerations/spread/ports/volumes). The short tuple can never
+    # collide with the full 10-tuple below.
+    if (
+        pod.affinity is None
+        and not pod.tolerations
+        and not pod.topology_spread_constraints
+        and not pod.host_ports
+        and not pod.volumes
+        and not pod.volume_requirements
+        and not pod.node_selector
+    ):
+        return (
+            tuple(sorted(pod.resource_requests.items())),
+            tuple(sorted((pod.metadata.labels or {}).items()))
+            if label_aware
+            else (),
+        )
     affinity_sig = None
     pod_aff_sig = None
     pod_anti_sig = None
