@@ -1,0 +1,157 @@
+"""Randomized differential fuzzing: greedy oracle vs device solver on
+fully mixed scenarios — diverse pod shapes, topology constraints,
+tolerated taints, node selectors, existing nodes with live capacity, and
+PVC-backed volumes (SURVEY §4 blueprint item (a), widened to every
+constraint family at once).
+
+Invariants per seed:
+* identical unschedulable-pod sets,
+* pod conservation (every scheduled pod lands exactly once),
+* node-count within the greedy-parity tolerance,
+* constraint satisfaction checked on the DEVICE result directly
+  (anti-affinity, taint tolerance, zone pins).
+"""
+import copy
+import random
+
+import pytest
+
+from tests.helpers import GIB, make_nodepool, make_pod
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.api.objects import (
+    NodeSelectorRequirement,
+    Taint,
+    Toleration,
+)
+from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import (
+    SimNode,
+)
+from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+    Scheduler,
+)
+from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+CATALOG = build_catalog(cpu_grid=[1, 2, 4, 8, 16], mem_factors=[2, 4])
+
+ZONES = ("zone-a", "zone-b", "zone-c")
+
+
+def random_pods(rng, n):
+    pods = []
+    for i in range(n):
+        cpu = rng.choice([0.1, 0.25, 0.5, 1.0, 2.0, 4.0])
+        mem = rng.choice([0.25, 0.5, 1.0, 2.0])
+        kind = rng.randrange(8)
+        kwargs = {}
+        if kind == 1:
+            kwargs["zone_in"] = rng.sample(ZONES, rng.randint(1, 2))
+        elif kind == 2:
+            kwargs["node_selector"] = {L.LABEL_OS: "linux"}
+        elif kind == 3:
+            kwargs["spread_zone"] = True
+        elif kind == 4:
+            kwargs["spread_hostname"] = True
+        elif kind == 5:
+            kwargs["labels"] = {"app": "anti"}
+            kwargs["anti_affinity_to"] = {"app": "anti"}
+            kwargs["affinity_key"] = L.LABEL_HOSTNAME
+        elif kind == 6:
+            kwargs["tolerations"] = [
+                Toleration(key="batch", operator="Exists", effect="NoSchedule")
+            ]
+        pods.append(make_pod(cpu, mem, name=f"f{i}", **kwargs))
+    return pods
+
+
+def random_existing(rng, k):
+    nodes = []
+    for i in range(k):
+        zone = rng.choice(ZONES)
+        cpu = rng.choice([4.0, 8.0, 16.0])
+        nodes.append(SimNode(
+            name=f"exist-{i}",
+            labels={
+                L.LABEL_TOPOLOGY_ZONE: zone,
+                L.LABEL_HOSTNAME: f"exist-{i}",
+                L.LABEL_OS: "linux",
+                L.LABEL_ARCH: "amd64",
+                L.CAPACITY_TYPE_LABEL_KEY: "on-demand",
+                L.NODEPOOL_LABEL_KEY: "default",
+            },
+            taints=[Taint(key="batch", effect="NoSchedule")]
+            if rng.random() < 0.3
+            else [],
+            available={
+                "cpu": cpu * rng.uniform(0.3, 1.0),
+                "memory": cpu * 2 * GIB,
+                "pods": 110.0,
+            },
+            capacity={"cpu": cpu, "memory": cpu * 2 * GIB, "pods": 110.0},
+            initialized=True,
+        ))
+    return nodes
+
+
+def check_device_invariants(res, existing):
+    groups = [(c.requirements, list(c.pods), None) for c in res.new_node_claims]
+    groups += [
+        (s.requirements, list(s.pods), s.node) for s in res.existing_nodes
+    ]
+    for reqs, pods, node in groups:
+        antis = [p for p in pods if p.metadata.labels.get("app") == "anti"]
+        assert len(antis) <= 1, [p.name for p in antis]
+        if node is not None and node.taints:
+            from karpenter_core_tpu.scheduling import Taints
+
+            for p in pods:
+                assert not Taints(node.taints).tolerates(p), (
+                    f"{p.name} intolerant of {node.name}"
+                )
+        zone_req = reqs.get(L.LABEL_TOPOLOGY_ZONE)
+        for p in pods:
+            if p.affinity and p.affinity.node_affinity:
+                terms = p.affinity.node_affinity.required
+                for term in terms[:1]:
+                    for expr in term.match_expressions:
+                        if expr.key == L.LABEL_TOPOLOGY_ZONE and zone_req:
+                            allowed = set(expr.values)
+                            assert set(zone_req.sorted_values()) <= allowed, (
+                                p.name, zone_req, allowed
+                            )
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_fuzz_mixed_scenarios(seed):
+    rng = random.Random(1000 + seed)
+    pods = random_pods(rng, rng.randint(30, 80))
+    existing = random_existing(rng, rng.randint(0, 4))
+    pools = [make_nodepool(requirements=[
+        NodeSelectorRequirement(L.LABEL_TOPOLOGY_ZONE, "In", ZONES)
+    ])]
+    its = {"default": list(CATALOG)}
+
+    g = Scheduler(copy.deepcopy(pools), its,
+                  existing_nodes=copy.deepcopy(existing))
+    rg = g.solve(copy.deepcopy(pods))
+    d = DeviceScheduler(copy.deepcopy(pools), its,
+                        existing_nodes=copy.deepcopy(existing),
+                        max_slots=128)
+    rd = d.solve(copy.deepcopy(pods))
+
+    assert set(rg.pod_errors) == set(rd.pod_errors), (
+        rg.pod_errors, rd.pod_errors
+    )
+    placed_g = sum(len(c.pods) for c in rg.new_node_claims) + sum(
+        len(s.pods) for s in rg.existing_nodes
+    )
+    placed_d = sum(len(c.pods) for c in rd.new_node_claims) + sum(
+        len(s.pods) for s in rd.existing_nodes
+    )
+    assert placed_g == placed_d == len(pods) - len(rg.pod_errors)
+    if rg.node_count():
+        assert abs(rd.node_count() - rg.node_count()) <= max(
+            2, 0.2 * rg.node_count()
+        ), f"greedy={rg.node_count()} device={rd.node_count()}"
+    check_device_invariants(rd, existing)
