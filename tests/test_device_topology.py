@@ -280,3 +280,74 @@ class TestDiverseMixParity:
         if rg.node_count():
             assert abs(rd.node_count() - rg.node_count()) <= max(
                 2, 0.15 * rg.node_count())
+
+
+class TestMinDomainsParity:
+    def test_min_domains_unsatisfied_caps_each_domain_at_skew(self):
+        # minDomains=5 over a 3-zone universe: the global minimum pins at
+        # zero while under-provisioned, so every domain caps at maxSkew
+        # (topologygroup.go:229-249) — 2 pods with skew 1 land in TWO
+        # different zones rather than stacking
+        pods = []
+        for i in range(2):
+            p = make_pod(cpu=1.0, spread_zone=True)
+            p.topology_spread_constraints = [
+                type(p.topology_spread_constraints[0])(
+                    max_skew=1,
+                    topology_key=L.LABEL_TOPOLOGY_ZONE,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=p.topology_spread_constraints[0].label_selector,
+                    min_domains=5,
+                )
+            ]
+            pods.append(p)
+        rg, rd = both_solve(pods)
+        assert rg.all_pods_scheduled() and rd.all_pods_scheduled(), (
+            rg.pod_errors, rd.pod_errors)
+        for res in (rg, rd):
+            zones = [claim_zone(c) for c in res.new_node_claims if c.pods]
+            assert len(set(zones)) == 2, zones
+        assert_node_parity(rg, rd, tol=1)
+
+    def test_min_domains_unsatisfied_blocks_fourth_pod(self):
+        # 3 zones, minDomains=5, skew 1: at most one pod per zone while the
+        # minimum is pinned at zero -> the 4th pod cannot schedule
+        def spread_pod():
+            p = make_pod(cpu=1.0, spread_zone=True)
+            p.topology_spread_constraints = [
+                type(p.topology_spread_constraints[0])(
+                    max_skew=1,
+                    topology_key=L.LABEL_TOPOLOGY_ZONE,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=p.topology_spread_constraints[0].label_selector,
+                    min_domains=5,
+                )
+            ]
+            return p
+
+        pods = [spread_pod() for _ in range(4)]
+        rg, rd = both_solve(pods)
+        assert len(rg.pod_errors) == 1, rg.pod_errors
+        assert set(rg.pod_errors) == set(rd.pod_errors)
+
+    def test_min_domains_satisfied_is_plain_spread(self):
+        # minDomains <= zone count: normal spread semantics
+        def spread_pod():
+            p = make_pod(cpu=1.0, spread_zone=True)
+            p.topology_spread_constraints = [
+                type(p.topology_spread_constraints[0])(
+                    max_skew=1,
+                    topology_key=L.LABEL_TOPOLOGY_ZONE,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=p.topology_spread_constraints[0].label_selector,
+                    min_domains=2,
+                )
+            ]
+            return p
+
+        pods = [spread_pod() for _ in range(6)]
+        rg, rd = both_solve(pods)
+        assert rg.all_pods_scheduled() and rd.all_pods_scheduled()
+        for res in (rg, rd):
+            zc = zone_counts(res)
+            assert set(zc.values()) == {2}, zc
