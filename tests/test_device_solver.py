@@ -341,3 +341,73 @@ class TestHostPorts:
             for claim in res.new_node_claims:
                 ported = sum(1 for p in claim.pods if p.host_ports)
                 assert ported <= 1, [p.metadata.name for p in claim.pods]
+
+
+class TestDaemonOverheadParity:
+    def test_daemon_overhead_reduces_fresh_capacity(self):
+        # a fat daemonset pod joins every fresh node's requests
+        # (scheduler.go:358-364 -> the kernel's tmpl_overhead tensor);
+        # both solvers must open the same number of nodes
+        daemon = make_pod(cpu=3.0, memory_gib=2.0, name="ds")
+        daemon.is_daemonset = True
+        pods_factory = lambda: [
+            make_pod(cpu=4.0, memory_gib=1.0, name=f"w{i}") for i in range(8)
+        ]
+        import copy
+
+        its = {"default": list(CATALOG)}
+        g = Scheduler([make_nodepool()], its,
+                      daemonset_pods=[copy.deepcopy(daemon)])
+        rg = g.solve(pods_factory())
+        d = DeviceScheduler([make_nodepool()], its,
+                            daemonset_pods=[copy.deepcopy(daemon)],
+                            max_slots=64)
+        rd = d.solve(pods_factory())
+        assert rg.all_pods_scheduled() and rd.all_pods_scheduled()
+        assert rg.node_count() == rd.node_count()
+        # the daemon's cpu is reserved: 16-cpu nodes fit 3 workers (12+3=15)
+        # not 4 (16+3=19)
+        for c in rd.new_node_claims:
+            assert c.requests["cpu"] <= max(
+                it.allocatable()["cpu"] for it in c.instance_type_options
+            ) + 1e-9
+
+    def test_intolerant_daemon_excluded_from_tainted_pool(self):
+        # the daemon does not tolerate the pool taint -> no overhead there
+        # (_daemon_compatible, scheduler.go:366-386)
+        daemon = make_pod(cpu=3.0, name="ds")
+        daemon.is_daemonset = True
+        pool = make_nodepool(
+            name="tainted",
+            taints=[Taint(key="batch", value="", effect="NoSchedule")],
+        )
+        pods = [
+            make_pod(
+                cpu=4.0,
+                name=f"w{i}",
+                tolerations=[Toleration(
+                    key="batch", operator="Exists", effect="NoSchedule"
+                )],
+            )
+            for i in range(4)
+        ]
+        import copy
+
+        its = {"tainted": list(CATALOG)}
+        # baseline: no daemonset at all
+        g0 = Scheduler([copy.deepcopy(pool)], its)
+        r0 = g0.solve(copy.deepcopy(pods))
+        g = Scheduler([copy.deepcopy(pool)], its,
+                      daemonset_pods=[copy.deepcopy(daemon)])
+        rg = g.solve(copy.deepcopy(pods))
+        d = DeviceScheduler([copy.deepcopy(pool)], its,
+                            daemonset_pods=[copy.deepcopy(daemon)],
+                            max_slots=64)
+        rd = d.solve(copy.deepcopy(pods))
+        assert r0.all_pods_scheduled()
+        assert rg.all_pods_scheduled() and rd.all_pods_scheduled()
+        # the intolerant daemon contributes NO overhead: both solvers match
+        # the daemonless baseline exactly
+        assert rg.node_count() == rd.node_count() == r0.node_count()
+        for c in rd.new_node_claims:
+            assert all(v == 0.0 for v in c.daemon_resources.values())
