@@ -200,21 +200,26 @@ K_MARGIN = 1e-4
 
 
 def _k_max(state: SlotState, c: ClassStep, statics: FFDStatics, viable_it):
-    """Max pods of the class each slot can absorb. [N]"""
+    """Max pods of the class each slot can absorb: ([N], per-IT [N, T]).
+
+    The per-IT counts double as the post-take fit check — k_raw[n,t] >=
+    take ⇔ the slot's cumulative requests after taking still fit type t
+    (same conservative K_MARGIN) — so ffd_step's itmask update needs no
+    second [N, T, R] reduction."""
     r = c.requests  # [R]
     safe_r = jnp.where(r > 0, r, 1.0)
     # new slots: per viable instance type
     head = (statics.it_alloc[None, :, :] - state.requests[:, None, :]) / safe_r
     head = jnp.where(r[None, None, :] > 0, head, BIG)
-    k_it = jnp.floor(jnp.min(head, axis=-1) - K_MARGIN)  # [N, T]
-    k_it = jnp.where(viable_it, k_it, -1.0)
+    k_raw = jnp.floor(jnp.min(head, axis=-1) - K_MARGIN)  # [N, T]
+    k_it = jnp.where(viable_it, k_raw, -1.0)
     k_new = jnp.max(k_it, axis=-1)  # [N]
     # existing slots: fixed available capacity
     head_e = (state.capacity - state.requests) / safe_r
     head_e = jnp.where(r[None, :] > 0, head_e, BIG)
     k_exist = jnp.floor(jnp.min(head_e, axis=-1) - K_MARGIN)  # [N]
     k = jnp.where(state.kind == 1, k_exist, k_new)
-    return jnp.clip(k, 0.0, 2**30).astype(jnp.int32)
+    return jnp.clip(k, 0.0, 2**30).astype(jnp.int32), k_raw
 
 
 # ---------------------------------------------------------------------------
@@ -458,8 +463,9 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics,
     )
     off_ok = _offering_ok(statics, joined_valmask)  # [N, T]
     viable_it = state.itmask & c.class_it[None, :] & off_ok
-    k_max = _k_max(state, c, statics, viable_it)
+    k_max, k_raw = _k_max(state, c, statics, viable_it)
 
+    safe_r_step = jnp.where(c.requests > 0, c.requests, 1.0)
     feasible = (
         (state.kind > 0)
         & req_ok
@@ -546,18 +552,40 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics,
     )
     new_requests = base_requests + take_all[:, None].astype(jnp.float32) * c.requests[None, :]
 
-    fits_new = jnp.all(
-        new_requests[:, None, :] <= statics.it_alloc[None, :, :], axis=-1
-    )  # [N, T]
     base_itmask = jnp.where(
         fresh[:, None], statics.tmpl_it[s][None, :], state.itmask
     )
     joined = took | fresh
+    # post-take viability without re-reducing [N, T, R]:
+    # * capacity, open slots: k_raw >= take (see _k_max; state.requests
+    #   already carries any overhead, and a dim only grows when a class
+    #   requests it — which that class's own k check covers).
+    # * capacity, fresh slots: one [T] row with the template overhead on
+    #   EVERY dim — including dims the class doesn't request, where the
+    #   overhead alone can exceed an instance type's allocatable.
+    # * offerings: an OPEN slot's post-take valmask IS joined_valmask, so
+    #   the pre-take off_ok is exact; FRESH slots all share one
+    #   template∧class zone/ct row — a single [T] evaluation.
+    oh = statics.tmpl_overhead[s]  # [R]
+    head_f = (statics.it_alloc - oh[None, :]) / safe_r_step[None, :]
+    head_f = jnp.where(
+        c.requests[None, :] > 0,
+        head_f,
+        jnp.where(statics.it_alloc >= oh[None, :], BIG, -1.0),
+    )
+    k_fresh = jnp.floor(jnp.min(head_f, axis=-1) - K_MARGIN)  # [T]
+    off_fresh = _offering_ok(
+        statics, (statics.tmpl_mask[s] & eff_mask)[None, :, :]
+    )[0]  # [T]
+    fit_ok = jnp.where(
+        fresh[:, None],
+        k_fresh[None, :] >= take_all[:, None].astype(k_raw.dtype),
+        k_raw >= take_all[:, None].astype(k_raw.dtype),
+    )
+    off_sel = jnp.where(fresh[:, None], off_fresh[None, :], off_ok)
     new_itmask = jnp.where(
         joined[:, None],
-        base_itmask & c.class_it[None, :] & fits_new & _offering_ok(
-            statics, new_valmask
-        ),
+        base_itmask & c.class_it[None, :] & fit_ok & off_sel,
         base_itmask,
     )
 

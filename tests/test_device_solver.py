@@ -411,3 +411,62 @@ class TestDaemonOverheadParity:
         assert rg.node_count() == rd.node_count() == r0.node_count()
         for c in rd.new_node_claims:
             assert all(v == 0.0 for v in c.daemon_resources.values())
+
+    def test_overhead_exceeding_type_never_pollutes_itmask(self):
+        # regression (r4 review): an instance type whose allocatable cannot
+        # even hold the daemon overhead on a dim the pod class does not
+        # request must not survive in a fresh slot's viable set — it would
+        # later win the per-IT headroom max for cpu-only classes and
+        # over-commit the slot, mass-deferring pods to the host fallback
+        from karpenter_core_tpu.metrics import wiring as m
+        from karpenter_core_tpu.cloudprovider.types import (
+            InstanceType,
+            Offering,
+            Offerings,
+        )
+        from karpenter_core_tpu.scheduling import Requirements
+
+        def it(name, cpu, mem_gib):
+            reqs = Requirements.from_labels({
+                L.LABEL_INSTANCE_TYPE: name,
+                L.LABEL_ARCH: "amd64",
+                L.LABEL_OS: "linux",
+            })
+            return InstanceType(
+                name=name,
+                requirements=reqs,
+                offerings=Offerings([
+                    Offering(
+                        requirements=Requirements.from_labels({
+                            L.LABEL_TOPOLOGY_ZONE: "zone-a",
+                            L.CAPACITY_TYPE_LABEL_KEY: "on-demand",
+                        }),
+                        price=cpu * 0.01,
+                        available=True,
+                    )
+                ]),
+                capacity={"cpu": float(cpu), "memory": mem_gib * GIB,
+                          "pods": 110.0},
+            )
+
+        catalog = [it("big-cpu-tiny-mem", 100, 1.2), it("balanced", 16, 32.0)]
+        daemon = make_pod(cpu=0.5, memory_gib=2.0, name="ds")
+        daemon.is_daemonset = True
+        pods = [
+            make_pod(cpu=3.0, memory_gib=0.2, name=f"a{i}") for i in range(4)
+        ] + [
+            make_pod(cpu=1.0, memory_gib=0.001, name=f"b{i}")
+            for i in range(10)
+        ]
+        before = sum(m.SOLVER_HOST_FALLBACK_PODS.values.values())
+        d = DeviceScheduler(
+            [make_nodepool()], {"default": catalog},
+            daemonset_pods=[daemon], max_slots=64,
+        )
+        res = d.solve(pods)
+        assert res.all_pods_scheduled(), res.pod_errors
+        after = sum(m.SOLVER_HOST_FALLBACK_PODS.values.values())
+        assert after == before, "device placement regressed to host fallback"
+        for c in res.new_node_claims:
+            for t in c.instance_type_options:
+                assert t.allocatable()["memory"] >= 2.0 * GIB
