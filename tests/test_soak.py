@@ -1,0 +1,92 @@
+"""Deterministic soak: many cycles of workload churn through the full
+operator loop with disruption enabled. At every stable point the cluster
+must be coherent — all pods bound, no orphan NodeClaims/Nodes, bindings
+consistent with capacity, state cache synced (the failure-detection /
+recovery story of SURVEY §5 exercised end-to-end, not per-controller).
+"""
+import random
+
+from tests.helpers import make_nodepool, make_pod
+from tests.test_e2e import new_operator, replicated
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.api.objects import NodeSelectorRequirement
+
+
+def assert_coherent(op):
+    pods = op.kube.list_pods()
+    nodes = {n.name for n in op.kube.list_nodes()}
+    for p in pods:
+        assert p.node_name, f"{p.name} unbound at stable point"
+        assert p.node_name in nodes, f"{p.name} bound to ghost {p.node_name}"
+    # claim <-> node coherence: every registered claim's node exists and
+    # every managed node traces to a claim
+    claims = op.kube.list_nodeclaims()
+    for c in claims:
+        if c.status.node_name:
+            assert c.status.node_name in nodes, f"claim {c.name} orphaned"
+    by_pid = {c.status.provider_id for c in claims if c.status.provider_id}
+    for n in op.kube.list_nodes():
+        if n.labels.get(L.NODEPOOL_LABEL_KEY):
+            assert n.provider_id in by_pid, f"node {n.name} has no claim"
+    # per-node requests within allocatable
+    for n in op.kube.list_nodes():
+        used = 0.0
+        for p in pods:
+            if p.node_name == n.name:
+                used += p.resource_requests.get("cpu", 0.0)
+        assert used <= n.status.allocatable.get("cpu", 0.0) + 1e-9, n.name
+    assert op.cluster.synced()
+    assert not op.disruption.in_flight
+
+
+def test_churn_soak_20_cycles():
+    rng = random.Random(7)
+    op = new_operator()
+    op.kube.create(make_nodepool(requirements=[NodeSelectorRequirement(
+        L.LABEL_TOPOLOGY_ZONE, "In", ("zone-a", "zone-b", "zone-c"))]))
+    live = {}
+    serial = 0
+
+    for cycle in range(20):
+        # add a wave of workload
+        for _ in range(rng.randint(3, 10)):
+            name = f"w{serial}"
+            serial += 1
+            kwargs = {}
+            if rng.random() < 0.25:
+                kwargs["spread_zone"] = True
+            p = replicated(make_pod(
+                cpu=rng.choice([0.25, 0.5, 1.0, 2.0, 4.0]),
+                memory_gib=rng.choice([0.5, 1.0, 2.0]),
+                name=name,
+                **kwargs,
+            ))
+            op.kube.create(p)
+            live[name] = p
+        # remove a random slice of the old workload
+        for name in rng.sample(sorted(live), min(len(live), rng.randint(0, 6))):
+            pod = op.kube.get(type(live[name]), name)
+            if pod is not None:
+                op.kube.delete(pod)
+            del live[name]
+        op.run_until_idle(max_iters=200)
+        # age the cluster so consolidation conditions mature and fire
+        op.clock.step(rng.choice([5.0, 45.0, 400.0]))
+        op.run_until_idle(max_iters=200)
+        assert_coherent(op)
+
+    # final deep consolidation pass: drop most of the load and verify the
+    # cluster shrinks without stranding anything
+    nodes_before = len(op.kube.list_nodes())
+    for name in sorted(live)[: max(len(live) - 3, 0)]:
+        pod = op.kube.get(type(live[name]), name)
+        if pod is not None:
+            op.kube.delete(pod)
+        del live[name]
+    for _ in range(6):
+        op.clock.step(60.0)
+        op.run_until_idle(max_iters=200)
+    assert_coherent(op)
+    assert len(op.kube.list_nodes()) < nodes_before
+    assert len(op.kube.list_pods()) == len(live)
