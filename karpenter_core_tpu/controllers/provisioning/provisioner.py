@@ -248,7 +248,25 @@ class Provisioner:
                 projected = resutil.merge(usage, max_cap)
                 errs = pool.spec.limits.exceeded_by(projected)
                 if errs:
-                    continue  # skip launch; pods stay pending
+                    # pods stay pending, but VISIBLY (the greedy solve
+                    # reports limit failures in-solve; the device solve
+                    # reports them here at claim-creation time)
+                    if self.recorder is not None:
+                        from karpenter_core_tpu.events import Event
+
+                        self.recorder.publish(*[
+                            Event(
+                                involved_object=f"Pod/{p.key()}",
+                                type="Warning",
+                                reason="FailedScheduling",
+                                message=(
+                                    f"nodepool {pool.name!r} limit "
+                                    f"exceeded: {'; '.join(errs)}"
+                                ),
+                            )
+                            for p in claim.pods
+                        ])
+                    continue  # skip launch
                 usage_by_pool[pool.name] = projected
             nc = claim.template.to_node_claim(
                 claim.requirements, claim.instance_type_options, claim.requests

@@ -18,9 +18,14 @@ Pipeline per solve:
  6. host: relaxation outer loop re-runs 1-5 for still-unschedulable pods
     (preferences.go:38-57)
 
-NodePool resource limits are enforced host-side after decode (the greedy
-path is authoritative when limits are tight — scheduler.go:389-434's
-pessimistic subtract-max); round-1 device solve does not model limits.
+NodePool resource limits are enforced exactly at claim-creation time
+(provision() drops over-limit claims and errors their pods — no silent
+livelock); the device solve itself does not model limits because a
+per-pool budget cannot spill a class across templates the way the greedy
+loop does (place_pod tries the next template when one pool's limit is
+exhausted), and a budget without spill falsely errors schedulable pods.
+The host-fallback path passes the pool's remaining resources through, so
+fallback placements respect limits exactly like greedy.
 """
 from __future__ import annotations
 
@@ -189,6 +194,18 @@ class DeviceScheduler:
         self.daemonset_pods = list(daemonset_pods or [])
         self.max_slots = max_slots
         self.validate = validate
+        # NodePool limits minus existing usage (scheduler.go:85-88,336-340)
+        self.remaining_resources: Dict[str, dict] = {
+            np_.name: dict(np_.spec.limits)
+            for np_ in self.nodepools
+            if np_.spec.limits
+        }
+        for node in self.existing_nodes:
+            if node.nodepool_name in self.remaining_resources:
+                self.remaining_resources[node.nodepool_name] = resutil.subtract(
+                    self.remaining_resources[node.nodepool_name],
+                    node.capacity or node.available,
+                )
         self.domains_universe = domain_universe(
             nodepools, instance_types, self.existing_nodes
         )
@@ -229,6 +246,11 @@ class DeviceScheduler:
         all_pods = list(pods)
         errors: Dict[str, str] = {}
         claims: List[InFlightNodeClaim] = []
+        # fresh per-solve copy: place_pod subtracts from it as fallback
+        # claims open, and a reused scheduler must not accumulate rounds
+        self._round_remaining = {
+            k: dict(v) for k, v in self.remaining_resources.items()
+        }
         existing_sims: List[ExistingNodeSim] = []
         max_slots = self.max_slots
         while max_slots < len(self.existing_nodes):
@@ -1432,9 +1454,9 @@ class DeviceScheduler:
         topo: Topology,
         pod_requests: Optional[dict] = None,
     ) -> Optional[str]:
-        """Host placement via the shared greedy policy (place_pod). Round-1
-        device path does not track NodePool limits, so remaining_resources is
-        empty here — the greedy path is authoritative when limits are tight."""
+        """Host placement via the shared greedy policy (place_pod), with the
+        pools' remaining limits so fallback claims respect NodePool limits
+        exactly like the greedy path (scheduler.go:417-434)."""
         if pod_requests is None:
             pod_requests = resutil.requests_for_pods(pod)
         return place_pod(
@@ -1445,5 +1467,5 @@ class DeviceScheduler:
             self.templates,
             {id(t): o for t, o in zip(self.templates, self.daemon_overhead)},
             topo,
-            {},
+            getattr(self, "_round_remaining", {}),
         )

@@ -470,3 +470,36 @@ class TestDaemonOverheadParity:
         for c in res.new_node_claims:
             for t in c.instance_type_options:
                 assert t.allocatable()["memory"] >= 2.0 * GIB
+
+
+class TestDeviceLimits:
+    def test_limit_overflow_is_visible_not_silent(self):
+        # limits are enforced at claim-creation time on the device path:
+        # the overflow pods stay pending WITH FailedScheduling events
+        # (never a silent livelock), and the launched claims respect the
+        # pool limit
+        from tests.test_e2e import new_operator, replicated
+        from karpenter_core_tpu.api.objects import Node
+
+        for solver in ("greedy", "tpu"):
+            op = new_operator(solver)
+            op.kube.create(make_nodepool(limits={"cpu": 32.0}))
+            for i in range(6):
+                op.kube.create(replicated(make_pod(cpu=9.0, name=f"p{i}")))
+            op.run_until_idle()
+            nodes = op.kube.list_nodes()
+            total_cpu = sum(
+                n.status.capacity.get("cpu", 0.0) for n in nodes
+            )
+            assert total_cpu <= 32.0 + 1e-9, (solver, total_cpu)
+            bound = [p for p in op.kube.list_pods() if p.node_name]
+            pending = [p for p in op.kube.list_pods() if not p.node_name]
+            assert pending, solver
+            assert len(bound) + len(pending) == 6
+            # the overflow surfaced: FailedScheduling events exist
+            failures = op.recorder.with_reason("FailedScheduling")
+            assert failures, f"{solver}: limit overflow was silent"
+
+    def test_no_limits_unbounded_parity(self):
+        assert_parity(lambda: [make_pod(cpu=9.0, name=f"p{i}")
+                               for i in range(6)])
