@@ -1,0 +1,35 @@
+"""Tier-4 scale e2e (SURVEY §4 blueprint item (d), CI-sized): thousands of
+pods through the FULL operator stack — batcher, tpu solve, NodeClaim
+lifecycle, kwok node materialization, binding — not just the solver.
+"""
+import random
+
+from tests.helpers import make_nodepool, make_pod
+from tests.test_e2e import new_operator, replicated
+
+
+def test_two_thousand_pods_bind_through_the_operator():
+    rng = random.Random(0)
+    op = new_operator("tpu")
+    op.kube.create(make_nodepool())
+    for i in range(2000):
+        op.kube.create(replicated(make_pod(
+            cpu=rng.choice([0.1, 0.25, 0.5, 1.0, 2.0]),
+            memory_gib=rng.choice([0.25, 0.5, 1.0, 2.0]),
+            name=f"w{i}",
+        )))
+    op.run_until_idle(max_iters=300)
+    pods = op.kube.list_pods()
+    assert all(p.node_name for p in pods), sum(
+        1 for p in pods if not p.node_name
+    )
+    nodes = op.kube.list_nodes()
+    assert nodes and len(nodes) < 400  # packed, not one-pod-per-node
+    assert op.cluster.synced()
+    # every node's bound cpu stays within allocatable
+    by_node = {}
+    for p in pods:
+        by_node.setdefault(p.node_name, 0.0)
+        by_node[p.node_name] += p.resource_requests.get("cpu", 0.0)
+    for n in nodes:
+        assert by_node.get(n.name, 0.0) <= n.status.allocatable["cpu"] + 1e-9
