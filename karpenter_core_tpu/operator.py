@@ -139,8 +139,11 @@ class Operator:
         timesource.set_source(self.clock.now)
         self.kube = kube or KubeStore(self.clock)
         self.options = options or Options()
-        self.cloud_provider = cloud_provider or KwokCloudProvider(
-            self.kube, instance_types
+        from karpenter_core_tpu.cloudprovider.metrics import MetricsDecorator
+
+        self.cloud_provider = MetricsDecorator(
+            cloud_provider
+            or KwokCloudProvider(self.kube, instance_types)
         )
         self.cluster = Cluster(self.kube, self.clock)
         self.recorder = Recorder(self.clock)
