@@ -293,3 +293,60 @@ class TestDoNotDisrupt:
         op.run_until_idle()
         # the precious pod's node may not be disrupted
         assert op.kube.get(Pod, "precious").node_name in nodes_before
+
+    def test_fewer_than_15_cheaper_options_declines(self):
+        # single-node spot-to-spot needs >= 15 cheaper spot types or the
+        # replacement would churn straight back (consolidation.go:48-49);
+        # a thin catalog must keep the node
+        from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+
+        thin = build_catalog(
+            cpu_grid=[8, 16], mem_factors=[2], oses=["linux"],
+            arches=["amd64"],
+        )
+        op = new_operator(
+            feature_gates={"SpotToSpotConsolidation": True},
+            catalog=thin,
+        )
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=12.0, name="big")))
+        op.kube.create(replicated(make_pod(cpu=0.2, name="small")))
+        op.run_until_idle(disrupt=False)
+        big = op.kube.get(Pod, "big")
+        big.metadata.owner_references = []
+        op.kube.delete(big)
+        caps_before = sorted(
+            n.status.capacity.get("cpu", 0) for n in op.kube.list_nodes()
+        )
+        op.run_until_idle()
+        assert sorted(
+            n.status.capacity.get("cpu", 0) for n in op.kube.list_nodes()
+        ) == caps_before
+
+    def test_replacement_claim_truncated_to_15_types(self):
+        # the launched claim's instance-type flexibility stays inside the
+        # 15-cheapest set so the launched node can't re-trigger
+        # consolidation (consolidation.go:283-298)
+        op = new_operator(feature_gates={"SpotToSpotConsolidation": True})
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=12.0, name="big")))
+        op.kube.create(replicated(make_pod(cpu=0.2, name="small")))
+        op.run_until_idle(disrupt=False)
+        big = op.kube.get(Pod, "big")
+        big.metadata.owner_references = []
+        op.kube.delete(big)
+        claims_before = {c.name for c in op.kube.list_nodeclaims()}
+        op.run_until_idle()
+        new_claims = [
+            c for c in op.kube.list_nodeclaims()
+            if c.name not in claims_before
+        ]
+        assert new_claims, "no replacement launched"
+        for c in new_claims:
+            it_req = next(
+                (r for r in c.spec.requirements
+                 if r.key == L.LABEL_INSTANCE_TYPE),
+                None,
+            )
+            assert it_req is not None
+            assert 0 < len(it_req.values) <= 15, len(it_req.values)
