@@ -168,6 +168,12 @@ class KubeStore:
     def _remove(self, kind: str, key: str, obj) -> None:
         self._objects[kind].pop(key, None)
         self._notify(DELETED, kind, obj)
+        # a deleted pod releases its volume attachments like the CSI driver
+        # would (evict() handles the graceful path; this covers force
+        # deletes, e.g. TGP-expired drains — without it the node's
+        # detach-wait would block forever)
+        if kind == "Pod" and obj.node_name:
+            self._detach_unreferenced(obj, obj.node_name)
 
     # -- typed listings ---------------------------------------------------
 

@@ -114,3 +114,39 @@ class TestPriorityGroupedDrain:
         op.run_until_idle()
         assert op.kube.get(Node, node.name) is None
         assert all(p.node_name for p in op.kube.list_pods())
+
+
+class TestTGPWithVolumes:
+    def test_forced_drain_releases_volume_attachments(self):
+        # a PDB-blocked volume pod force-deleted at the TGP deadline must
+        # release its VolumeAttachment, or the node's detach-wait wedges
+        # the termination forever
+        from tests.test_pdb import make_pdb
+        from tests.test_volumes import make_pvc, make_zonal_pv, pod_with_pvc
+
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(make_zonal_pv("pv-1", "zone-a"))
+        op.kube.create(make_pvc("c1", volume_name="pv-1"))
+        p = replicated(pod_with_pvc("vol-pod", "c1"))
+        p.metadata.labels["app"] = "web"
+        p.termination_grace_period_seconds = 30.0
+        op.kube.create(p)
+        op.run_until_idle()
+        claim = op.kube.list_nodeclaims()[0]
+        claim.spec.termination_grace_period = 300.0
+        op.kube.update(claim)
+        op.kube.create(make_pdb(min_available=1, app="web"))
+        node = op.kube.list_nodes()[0]
+        op.kube.delete(node)
+        op.run_until_idle()
+        assert op.kube.get(Node, node.name) is not None  # PDB blocks drain
+        op.clock.step(300.0)
+        op.run_until_idle()
+        # force-delete fired, the attachment released, the node finished
+        assert op.kube.get(Node, node.name) is None
+        assert not [
+            va
+            for va in op.kube.list_volume_attachments()
+            if va.node_name == node.name
+        ]
