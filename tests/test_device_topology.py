@@ -351,3 +351,108 @@ class TestMinDomainsParity:
         for res in (rg, rd):
             zc = zone_counts(res)
             assert set(zc.values()) == {2}, zc
+
+
+class TestNamespaceScoping:
+    def test_affinity_defaults_to_own_namespace(self):
+        # a required pod-affinity term without namespaces only sees pods in
+        # the POD'S OWN namespace (topology.go _namespace_list); a target in
+        # another namespace must not satisfy it
+        from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+            Topology, domain_universe,
+        )
+
+        def solve(cls):
+            pool = three_zone_pool()
+            tgt = make_pod(cpu=0.1, labels={"app": "db"}, name="tgt")
+            tgt.metadata.namespace = "other"
+            tgt.node_name = "n1"
+            topo = Topology(
+                domains={k: set(v) for k, v in domain_universe(
+                    [pool], {"default": CATALOG}, []).items()},
+                existing_pods=[(
+                    tgt,
+                    {L.LABEL_TOPOLOGY_ZONE: "zone-b"},
+                    "n1",
+                )],
+            )
+            s = cls([pool], {"default": CATALOG}, topology=topo)
+            follower = make_pod(
+                cpu=0.5, affinity_to={"app": "db"}, name="follower",
+                labels={"app": "follower"},
+            )
+            return s.solve([follower])
+
+        rg, rd = solve(Scheduler), solve(DeviceScheduler)
+        # the cross-namespace target is invisible: the self-unselected
+        # affinity has no positive domain and no bootstrap -> unschedulable
+        # (each solve builds its own pods, so compare counts not uids)
+        assert not rg.all_pods_scheduled()
+        assert not rd.all_pods_scheduled()
+        assert len(rg.pod_errors) == len(rd.pod_errors) == 1
+
+    def test_explicit_namespaces_cross_boundary(self):
+        from karpenter_core_tpu.api.objects import (
+            Affinity, LabelSelector, PodAffinity, PodAffinityTerm,
+        )
+        from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+            Topology, domain_universe,
+        )
+
+        def solve(cls):
+            pool = three_zone_pool()
+            tgt = make_pod(cpu=0.1, labels={"app": "db"}, name="tgt")
+            tgt.metadata.namespace = "other"
+            tgt.node_name = "n1"
+            topo = Topology(
+                domains={k: set(v) for k, v in domain_universe(
+                    [pool], {"default": CATALOG}, []).items()},
+                existing_pods=[(
+                    tgt,
+                    {L.LABEL_TOPOLOGY_ZONE: "zone-b"},
+                    "n1",
+                )],
+            )
+            s = cls([pool], {"default": CATALOG}, topology=topo)
+            follower = make_pod(cpu=0.5, name="follower")
+            follower.affinity = Affinity(pod_affinity=PodAffinity(required=[
+                PodAffinityTerm(
+                    topology_key=L.LABEL_TOPOLOGY_ZONE,
+                    label_selector=LabelSelector(
+                        match_labels=(("app", "db"),)
+                    ),
+                    namespaces=("other",),
+                )
+            ]))
+            return s.solve([follower])
+
+        rg, rd = solve(Scheduler), solve(DeviceScheduler)
+        for res in (rg, rd):
+            assert res.all_pods_scheduled(), res.pod_errors
+            (claim,) = [c for c in res.new_node_claims if c.pods]
+            assert claim_zone(claim) == "zone-b"
+
+
+class TestScheduleAnywayDevice:
+    def test_soft_spread_relaxes_on_device(self):
+        # ScheduleAnyway zone spread with impossible skew over a one-zone
+        # pool: the device relaxation loop must strip it and schedule
+        # (preferences.go:38-57)
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement(L.LABEL_TOPOLOGY_ZONE, "In", ("zone-a",))
+        ])
+        pods = []
+        for _ in range(3):
+            p = make_pod(cpu=1.0, spread_zone=True)
+            p.topology_spread_constraints = [
+                type(p.topology_spread_constraints[0])(
+                    max_skew=1,
+                    topology_key=L.LABEL_TOPOLOGY_ZONE,
+                    when_unsatisfiable="ScheduleAnyway",
+                    label_selector=p.topology_spread_constraints[0].label_selector,
+                )
+            ]
+            pods.append(p)
+        d = DeviceScheduler([pool], {"default": CATALOG}, max_slots=64)
+        res = d.solve(pods)
+        assert res.all_pods_scheduled(), res.pod_errors
