@@ -174,23 +174,56 @@ class TestSchedulerMinValues:
 
     @pytest.mark.parametrize("solver", ["greedy", "tpu"])
     def test_min_values_with_gt_operator(self, solver):
-        # Gt over a numeric label: only types above the bound count toward
-        # minValues (instance_selection_test.go:723)
-        from karpenter_core_tpu.cloudprovider.kwok import build_catalog as bc
-
-        catalog = []
-        for it in CATALOG:
-            catalog.append(it)
+        # Gt over the kwok numeric cpu label: only types above the bound
+        # remain, and minValues demands at least 2 of them
+        # (instance_selection_test.go:723)
         pool = make_nodepool(requirements=[
             NodeSelectorRequirement(
                 "karpenter.kwok.sh/instance-cpu", "Gt", ("2",), min_values=2
             )
         ])
         cls = Scheduler if solver == "greedy" else DeviceScheduler
-        s = cls([pool], {"default": list(catalog)})
+        s = cls([pool], {"default": list(CATALOG)})
         res = s.solve([make_pod(cpu=0.5, name="p0")])
-        # the kwok catalog may not carry the cpu label; either every claim
-        # satisfies the bound or the pod fails — both are consistent
-        if res.all_pods_scheduled():
-            (claim,) = res.new_node_claims
-            assert len(claim.instance_type_options) >= 2
+        assert res.all_pods_scheduled(), res.pod_errors
+        (claim,) = res.new_node_claims
+        names = {it.name for it in claim.instance_type_options}
+        assert len(names) >= 2
+        for it in claim.instance_type_options:
+            cpu_req = it.requirements.get("karpenter.kwok.sh/instance-cpu")
+            (value,) = cpu_req.sorted_values()
+            assert int(value) > 2, it.name
+
+    @pytest.mark.parametrize("solver", ["greedy", "tpu"])
+    def test_lt_operator_excludes_big_types(self, solver):
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement(
+                "karpenter.kwok.sh/instance-cpu", "Lt", ("8",)
+            )
+        ])
+        cls = Scheduler if solver == "greedy" else DeviceScheduler
+        s = cls([pool], {"default": list(CATALOG)})
+        res = s.solve([make_pod(cpu=0.5, name="p0")])
+        assert res.all_pods_scheduled(), res.pod_errors
+        (claim,) = res.new_node_claims
+        for it in claim.instance_type_options:
+            (value,) = it.requirements.get(
+                "karpenter.kwok.sh/instance-cpu"
+            ).sorted_values()
+            assert int(value) < 8, it.name
+
+    @pytest.mark.parametrize("solver", ["greedy", "tpu"])
+    def test_gt_lt_band_unsatisfiable(self, solver):
+        # Gt 4 ∧ Lt 8 over a {1,2,4,8,16} grid leaves nothing
+        pool = make_nodepool(requirements=[
+            NodeSelectorRequirement(
+                "karpenter.kwok.sh/instance-cpu", "Gt", ("4",)
+            ),
+            NodeSelectorRequirement(
+                "karpenter.kwok.sh/instance-cpu", "Lt", ("8",)
+            ),
+        ])
+        cls = Scheduler if solver == "greedy" else DeviceScheduler
+        s = cls([pool], {"default": list(CATALOG)})
+        res = s.solve([make_pod(cpu=0.5, name="p0")])
+        assert not res.all_pods_scheduled()
