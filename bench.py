@@ -315,7 +315,11 @@ def _consolidation_bench(n_nodes=2000, n_candidates=100, repeats=3):
     from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
         Topology,
     )
-    from karpenter_core_tpu.models.consolidation import _prefix_scan, prefix_batches
+    from karpenter_core_tpu.models.consolidation import (
+        _it_price_vector,
+        _prefix_scan,
+        prefix_batches,
+    )
     from karpenter_core_tpu.models.provisioner import DeviceScheduler
 
     catalog = bench_catalog(400)
@@ -366,6 +370,8 @@ def _consolidation_bench(n_nodes=2000, n_candidates=100, repeats=3):
         prep.statics,
         jnp.asarray(kind_batch),
         jnp.asarray(count_batch),
+        jnp.asarray(_it_price_vector(prep)),
+        jnp.int32(len(sched.existing_nodes)),
     )
     import jax
 

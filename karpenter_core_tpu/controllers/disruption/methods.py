@@ -310,12 +310,13 @@ class MultiNodeConsolidation(_ConsolidationBase):
         if self.ctx.provisioner.solver == "tpu":
             frontier_sizes = self._device_frontier(candidates)
         if frontier_sizes:
+            passing, dubious = frontier_sizes
             # host-exact validation (price filters, spot rules) walks the
             # device-viable ladder: the largest few outright, then a binary
             # search over the REMAINING viable sizes — never the full [2,n]
             # range the reference probes (host validity is monotone in
             # prefix size, the same assumption its binary search makes)
-            head, tail = frontier_sizes[:4], frontier_sizes[4:]
+            head, tail = passing[:4], passing[4:]
             for size in head:
                 ok, cmd = self._host_validate(candidates, size)
                 if ok:
@@ -332,8 +333,27 @@ class MultiNodeConsolidation(_ConsolidationBase):
                         lo = mid + 1
                     else:
                         hi = mid - 1
+            if best.decision == "no-op" and dubious:
+                # the device price bound said these sizes can't beat the
+                # candidates' price, but the bound is only sound when the
+                # device packed the fresh node like the host would — probe
+                # the largest once; if the bound was wrong, search them all
+                ok, cmd = self._host_validate(candidates, dubious[0])
+                if ok:
+                    best = cmd
+                elif len(dubious) > 1:
+                    asc = dubious[::-1]
+                    lo, hi = 0, len(asc) - 2  # largest already probed
+                    while lo <= hi:
+                        mid = (lo + hi) // 2
+                        ok, cmd = self._host_validate(candidates, asc[mid])
+                        if ok:
+                            best = cmd
+                            lo = mid + 1
+                        else:
+                            hi = mid - 1
         if best.decision == "no-op":
-            if frontier_sizes == []:
+            if frontier_sizes == ([], []):
                 # the device proved no prefix schedulable, but its FFD is
                 # conservative (K_MARGIN under-placement, first-fit rather
                 # than emptiest-first), so probe the easiest host prefix
@@ -389,8 +409,11 @@ class MultiNodeConsolidation(_ConsolidationBase):
         return ok, cmd
 
     def _device_frontier(self, candidates: List[Candidate]):
-        """Prefix sizes to try, largest-first, from the one-call device
-        evaluation; None -> fall back to binary search."""
+        """(passing, dubious) prefix-size lists, each largest-first, from
+        the one-call device evaluation; None -> fall back to binary search.
+        `passing` sizes beat the device price lower bound; `dubious` sizes
+        did not, but stay reachable because the bound is only sound when
+        the device packed the fresh node the way the host would."""
         from karpenter_core_tpu.models.consolidation import (
             schedulability_frontier,
         )
@@ -401,13 +424,26 @@ class MultiNodeConsolidation(_ConsolidationBase):
         if frontier is None:
             return None
         # viable prefixes: everything reschedules into at most one new node
-        sizes = [
-            p + 1
-            for p, (ok, n_new) in enumerate(frontier)
-            if ok and n_new <= 1
-        ]
-        sizes.sort(reverse=True)
-        return sizes
+        # AND the device price lower bound undercuts the prefix's summed
+        # candidate price — a replacement at or above it would fail the
+        # host's cheaper-than-candidates filter anyway, so those sizes never
+        # reach a host simulation (SURVEY §7.7's device-side price filter)
+        prefix_price = []
+        acc = 0.0
+        for c in candidates:
+            acc += c.price()
+            prefix_price.append(acc)
+        passing, dubious = [], []
+        for p, (ok, n_new, price_lb) in enumerate(frontier):
+            if not ok or n_new > 1:
+                continue
+            if n_new == 0 or price_lb < prefix_price[p]:
+                passing.append(p + 1)
+            else:
+                dubious.append(p + 1)
+        passing.sort(reverse=True)
+        dubious.sort(reverse=True)
+        return passing, dubious
 
     @staticmethod
     def _filter_out_same_type(replacement, consolidate: List[Candidate]) -> None:

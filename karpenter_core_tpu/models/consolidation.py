@@ -36,22 +36,34 @@ from karpenter_core_tpu.ops.ffd import ClassStep, SlotState, ffd_step
 from karpenter_core_tpu.solver.snapshot import _spec_signature
 
 
-def _ffd_scan(state, classes, statics):
+def _ffd_scan(state, classes, statics, it_price, n_existing):
     final, (takes, unplaced) = jax.lax.scan(
         lambda st, c: ffd_step(st, c, statics), state, classes
     )
-    return final.next_free, jnp.sum(unplaced), final.overflow
+    # price lower bound of the fresh nodes this prefix would launch: each
+    # fresh slot's cheapest still-viable type (its final option set is a
+    # SUPERSET of the claim the host would build, so this never exceeds the
+    # true replacement price — a sound skip-filter for the host's
+    # cheaper-than-candidates rule, SURVEY §7.7's device price tensors)
+    idx = jnp.arange(final.kind.shape[0])
+    fresh = (idx >= n_existing) & (idx < final.next_free)
+    slot_price = jnp.min(
+        jnp.where(final.itmask, it_price[None, :], jnp.inf), axis=1
+    )
+    price_lb = jnp.sum(jnp.where(fresh, slot_price, 0.0))
+    return final.next_free, jnp.sum(unplaced), final.overflow, price_lb
 
 
 @jax.jit
-def _prefix_scan(state: SlotState, classes: ClassStep, statics, kind_batch, count_batch):
+def _prefix_scan(state: SlotState, classes: ClassStep, statics, kind_batch,
+                 count_batch, it_price, n_existing):
     """vmap the FFD scan over the prefix axis: only the slot kinds and the
     class counts vary per prefix; masks/capacities/statics are shared."""
 
     def one(kind, counts):
         st = state._replace(kind=kind)
         cl = classes._replace(count=counts)
-        return _ffd_scan(st, cl, statics)
+        return _ffd_scan(st, cl, statics, it_price, n_existing)
 
     return jax.vmap(one)(kind_batch, count_batch)
 
@@ -94,10 +106,15 @@ def schedulability_frontier(
     cluster,
     candidates: List,
     max_slots: int = 1024,
-) -> Optional[List[Tuple[bool, int]]]:
-    """Per-prefix (all pods scheduled, new nodes needed) for prefixes
-    1..len(candidates). None when the batched path can't represent the
-    problem (topology-coupled pods) — callers binary-search instead."""
+) -> Optional[List[Tuple[bool, int, float]]]:
+    """Per-prefix (all pods scheduled, new nodes needed, fresh-node price
+    lower bound) for prefixes 1..len(candidates). The price bound is the
+    sum over fresh slots of the cheapest still-viable type — a true lower
+    bound only when the device packed the fresh nodes like the host
+    simulation would (callers must treat bound-failing sizes as
+    deprioritized, not impossible). None when the batched path can't
+    represent the problem (topology-coupled pods) — callers binary-search
+    instead."""
     base_pods = provisioner.pending_pods() + provisioner.deleting_node_pods()
     if any(has_topology_constraints(p) for p in base_pods):
         return None
@@ -152,19 +169,38 @@ def schedulability_frontier(
         count_batch = np.pad(
             count_batch, ((0, 0), (0, Jp - count_batch.shape[1]))
         )
-    next_free, unplaced, overflow = _prefix_scan(
+    next_free, unplaced, overflow, price_lb = _prefix_scan(
         prep.init_state,
         classes,
         prep.statics,
         jnp.asarray(kind_batch),
         jnp.asarray(count_batch),
+        jnp.asarray(_it_price_vector(prep)),
+        jnp.int32(E),
     )
     next_free = np.asarray(next_free)
     unplaced = np.asarray(unplaced)
     overflow = np.asarray(overflow)
+    price_lb = np.asarray(price_lb)
     # an overflowed prefix silently counted spilled pods as placed — it is
     # NOT schedulable evidence
     return [
-        (int(unplaced[p]) == 0 and not bool(overflow[p]), int(next_free[p]) - E)
+        (
+            int(unplaced[p]) == 0 and not bool(overflow[p]),
+            int(next_free[p]) - E,
+            float(price_lb[p]),
+        )
         for p in range(P)
     ]
+
+
+def _it_price_vector(prep) -> np.ndarray:
+    """Cheapest available offering price per catalog type, padded to the
+    statics' bucketed T axis with +inf (never cheapest)."""
+    Tp = int(prep.statics.it_alloc.shape[0])
+    out = np.full((Tp,), np.inf, dtype=np.float32)
+    for ti, it in enumerate(prep.catalog):
+        available = it.offerings.available()
+        if available:
+            out[ti] = min(o.price for o in available)
+    return out

@@ -62,7 +62,7 @@ class TestFrontierParity:
             op.provisioner, op.cluster, candidates
         )
         assert frontier is not None
-        for p, (ok_device, n_new) in enumerate(frontier):
+        for p, (ok_device, n_new, price_lb) in enumerate(frontier):
             results = simulate_scheduling(
                 op.provisioner, op.cluster, candidates[: p + 1]
             )
@@ -70,6 +70,11 @@ class TestFrontierParity:
             assert ok_device == ok_host, (p, results.pod_errors)
             if ok_host:
                 assert n_new == results.node_count(), p
+                if n_new:
+                    # the bound is a positive finite price whenever a fresh
+                    # node opens (its exact relation to the host replacement
+                    # depends on matching packing, so only sanity is asserted)
+                    assert 0.0 < price_lb < float("inf"), (p, price_lb)
 
     def test_topology_pods_fall_back(self):
         op = underutilized_fleet(2)
@@ -122,7 +127,7 @@ class TestFrontierFallback:
         monkeypatch.setattr(
             methods.MultiNodeConsolidation,
             "_device_frontier",
-            lambda self, candidates: [],
+            lambda self, candidates: ([], []),
         )
         cap_before = sum(
             n.status.capacity.get("cpu", 0) for n in op.kube.list_nodes()

@@ -150,12 +150,16 @@ class TestShardedPrefixScan:
             count_batch[p, (p * 7) % C] += 3
             count_batch[p, (p * 13 + 1) % C] += 2
 
+        from karpenter_core_tpu.models.consolidation import _it_price_vector
+
         args = (
             prep.init_state,
             classes,
             prep.statics,
             jnp.asarray(kind_batch),
             jnp.asarray(count_batch),
+            jnp.asarray(_it_price_vector(prep)),
+            jnp.int32(len(sched.existing_nodes)),
         )
         ref = _prefix_scan(*args)
         jax.block_until_ready(ref)
@@ -170,11 +174,15 @@ class TestShardedPrefixScan:
             jax.tree.map(lambda _: repl, prep.statics),
             pref2,
             pref2,
+            repl,
+            repl,
         )
         step = jax.jit(
-            lambda st, cl, sx, kb, cb: _prefix_scan(st, cl, sx, kb, cb),
+            lambda st, cl, sx, kb, cb, pv, ne: _prefix_scan(
+                st, cl, sx, kb, cb, pv, ne
+            ),
             in_shardings=in_sh,
-            out_shardings=(pref, pref, pref),
+            out_shardings=(pref, pref, pref, pref),
         )
         sharded = step(*jax.device_put(args, in_sh))
         jax.block_until_ready(sharded)
