@@ -456,3 +456,77 @@ class TestScheduleAnywayDevice:
         d = DeviceScheduler([pool], {"default": CATALOG}, max_slots=64)
         res = d.solve(pods)
         assert res.all_pods_scheduled(), res.pod_errors
+
+
+class TestPreferredPodAffinityRelaxation:
+    @pytest.mark.parametrize("cls", [Scheduler, DeviceScheduler])
+    def test_unsatisfiable_preferred_pod_affinity_relaxes(self, cls):
+        # preferred pod-affinity toward a label nothing carries: the
+        # relaxation loop strips the soft term and the pod schedules
+        # (preferences.go:38-57 order: preferred pod-affinity first)
+        from karpenter_core_tpu.api.objects import (
+            Affinity,
+            LabelSelector,
+            PodAffinity,
+            PodAffinityTerm,
+            WeightedPodAffinityTerm,
+        )
+
+        p = make_pod(cpu=1.0, name="soft")
+        p.affinity = Affinity(pod_affinity=PodAffinity(preferred=[
+            WeightedPodAffinityTerm(
+                weight=100,
+                pod_affinity_term=PodAffinityTerm(
+                    topology_key=L.LABEL_TOPOLOGY_ZONE,
+                    label_selector=LabelSelector(
+                        match_labels=(("app", "ghost"),)
+                    ),
+                ),
+            )
+        ]))
+        s = cls([three_zone_pool()], {"default": CATALOG}, max_slots=16) \
+            if cls is DeviceScheduler else cls(
+                [three_zone_pool()], {"default": CATALOG})
+        res = s.solve([p])
+        assert res.all_pods_scheduled(), res.pod_errors
+
+    @pytest.mark.parametrize("cls", [Scheduler, DeviceScheduler])
+    def test_satisfiable_preferred_pod_affinity_honored(self, cls):
+        # a satisfiable soft term pulls the pod toward the target's zone
+        from karpenter_core_tpu.api.objects import (
+            Affinity,
+            LabelSelector,
+            PodAffinity,
+            PodAffinityTerm,
+            WeightedPodAffinityTerm,
+        )
+        from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+            Topology, domain_universe,
+        )
+
+        pool = three_zone_pool()
+        tgt = make_pod(cpu=0.1, labels={"app": "db"}, name="tgt")
+        tgt.node_name = "n1"
+        topo = Topology(
+            domains={k: set(v) for k, v in domain_universe(
+                [pool], {"default": CATALOG}, []).items()},
+            existing_pods=[(tgt, {L.LABEL_TOPOLOGY_ZONE: "zone-b"}, "n1")],
+        )
+        kwargs = {"max_slots": 16} if cls is DeviceScheduler else {}
+        s = cls([pool], {"default": CATALOG}, topology=topo, **kwargs)
+        p = make_pod(cpu=1.0, name="soft")
+        p.affinity = Affinity(pod_affinity=PodAffinity(preferred=[
+            WeightedPodAffinityTerm(
+                weight=100,
+                pod_affinity_term=PodAffinityTerm(
+                    topology_key=L.LABEL_TOPOLOGY_ZONE,
+                    label_selector=LabelSelector(
+                        match_labels=(("app", "db"),)
+                    ),
+                ),
+            )
+        ]))
+        res = s.solve([p])
+        assert res.all_pods_scheduled(), res.pod_errors
+        (claim,) = [c for c in res.new_node_claims if c.pods]
+        assert claim_zone(claim) == "zone-b"
