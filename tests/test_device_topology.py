@@ -530,3 +530,46 @@ class TestPreferredPodAffinityRelaxation:
         assert res.all_pods_scheduled(), res.pod_errors
         (claim,) = [c for c in res.new_node_claims if c.pods]
         assert claim_zone(claim) == "zone-b"
+
+
+class TestMultiConstraintPods:
+    def test_zone_and_hostname_spread_on_one_pod(self):
+        # one pod owning BOTH a zone spread (water-fill sub-steps) and a
+        # hostname spread (per-slot count caps): the kernel applies both
+        # simultaneously — at most one per host AND balanced across zones
+        pods = [
+            make_pod(cpu=0.5, spread_zone=True, spread_hostname=True,
+                     name=f"both-{i}")
+            for i in range(6)
+        ]
+        rg, rd = both_solve(pods)
+        assert rg.all_pods_scheduled() and rd.all_pods_scheduled(), (
+            rg.pod_errors, rd.pod_errors)
+        for res in (rg, rd):
+            for group in pods_per_node(res):
+                assert sum(
+                    1 for p in group
+                    if p.metadata.labels.get("app") == "spread"
+                ) <= 1
+            zc = zone_counts(res)
+            assert max(zc.values()) - min(zc.values()) <= 1, zc
+        assert_node_parity(rg, rd, tol=1)
+
+    def test_spread_plus_anti_affinity_pod(self):
+        # zone spread + hostname self-anti-affinity on the same pod
+        pods = [
+            make_pod(cpu=0.5, spread_zone=True,
+                     anti_affinity_to={"app": "spread"},
+                     affinity_key=L.LABEL_HOSTNAME,
+                     name=f"sa-{i}")
+            for i in range(6)
+        ]
+        rg, rd = both_solve(pods)
+        assert rg.all_pods_scheduled() and rd.all_pods_scheduled(), (
+            rg.pod_errors, rd.pod_errors)
+        for res in (rg, rd):
+            for group in pods_per_node(res):
+                assert len(group) <= 1  # anti: one per host
+            zc = zone_counts(res)
+            assert max(zc.values()) - min(zc.values()) <= 1, zc
+        assert_node_parity(rg, rd, tol=1)
