@@ -177,7 +177,6 @@ class DeviceScheduler:
         existing_nodes: Optional[List[SimNode]] = None,
         daemonset_pods: Optional[List[Pod]] = None,
         max_slots: int = 256,
-        validate: bool = False,
         topology: Optional[Topology] = None,
     ):
         # a supplied Topology carries cluster context (existing pods,
@@ -193,7 +192,6 @@ class DeviceScheduler:
         )
         self.daemonset_pods = list(daemonset_pods or [])
         self.max_slots = max_slots
-        self.validate = validate
         # NodePool limits minus existing usage (scheduler.go:85-88,336-340)
         self.remaining_resources: Dict[str, dict] = {
             np_.name: dict(np_.spec.limits)
