@@ -189,3 +189,69 @@ class TestEventsAndMetrics:
         assert 'karpenter_pods_scheduled_total{nodepool="default"} 3' in text
         assert "karpenter_scheduling_duration_seconds_bucket" in text
         assert h.percentile(0.5) == 0.5
+
+
+class TestNodePoolValidationMatrix:
+    """CEL-adjacent runtime validation matrix (reference
+    pkg/apis/v1/*_cel_test.go scenarios, enforced by the validation
+    controller rather than the apiserver)."""
+
+    def _ready(self, mutate):
+        from karpenter_core_tpu.api.nodepool import (
+            COND_NODEPOOL_VALIDATION_SUCCEEDED,
+        )
+
+        op = new_operator()
+        pool = make_nodepool()
+        mutate(pool)
+        op.kube.create(pool)
+        op.run_until_idle(disrupt=False)
+        return not op.kube.list_nodepools()[0].conditions.is_false(
+            COND_NODEPOOL_VALIDATION_SUCCEEDED
+        )
+
+    def test_empty_taint_key_rejected(self):
+        from karpenter_core_tpu.api.objects import Taint
+
+        assert not self._ready(
+            lambda p: p.spec.template.taints.append(
+                Taint(key="", effect="NoSchedule")
+            )
+        )
+
+    def test_in_operator_without_values_rejected(self):
+        from karpenter_core_tpu.api.objects import NodeSelectorRequirement
+
+        assert not self._ready(
+            lambda p: p.spec.template.requirements.append(
+                NodeSelectorRequirement("size", "In", ())
+            )
+        )
+
+    def test_gt_with_non_integer_rejected(self):
+        from karpenter_core_tpu.api.objects import NodeSelectorRequirement
+
+        assert not self._ready(
+            lambda p: p.spec.template.requirements.append(
+                NodeSelectorRequirement("size", "Gt", ("big",))
+            )
+        )
+
+    def test_restricted_label_rejected(self):
+        assert not self._ready(
+            lambda p: p.spec.template.labels.update(
+                {"kubernetes.io/hostname": "x"}
+            )
+        )
+
+    def test_budget_schedule_without_duration_rejected(self):
+        from karpenter_core_tpu.api.nodepool import Budget
+
+        assert not self._ready(
+            lambda p: p.spec.disruption.budgets.append(
+                Budget(nodes="1", schedule="0 9 * * *")
+            )
+        )
+
+    def test_valid_pool_ready(self):
+        assert self._ready(lambda p: None)
