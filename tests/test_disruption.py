@@ -350,3 +350,40 @@ class TestDoNotDisrupt:
             )
             assert it_req is not None
             assert 0 < len(it_req.values) <= 15, len(it_req.values)
+
+
+class TestCronBudgetWindows:
+    def test_zero_budget_window_blocks_then_lifts(self):
+        # a maintenance-freeze budget (nodes=0 during a cron window) blocks
+        # consolidation while active and lifts when the window closes
+        # (nodepool.go:353-367 Budget.IsActive end-to-end)
+        import calendar
+
+        from karpenter_core_tpu.api.nodepool import Budget
+
+        window_start = calendar.timegm((2026, 7, 29, 9, 0, 0, 0, 0, 0))
+        op = new_operator()
+        op.clock.set(float(window_start) + 600.0)  # inside the window
+        pool = make_nodepool()
+        pool.spec.disruption.budgets = [
+            Budget(nodes="0", schedule="0 9 * * *", duration=3600.0)
+        ]
+        op.kube.create(pool)
+        op.kube.create(replicated(make_pod(cpu=7.0, name="big")))
+        op.kube.create(replicated(make_pod(cpu=0.2, name="small")))
+        op.run_until_idle(disrupt=False)
+        big = op.kube.get(Pod, "big")
+        big.metadata.owner_references = []
+        op.kube.delete(big)
+        nodes_before = len(op.kube.list_nodes())
+        op.clock.step(60.0)
+        op.run_until_idle()
+        # frozen: the underutilized node survives the window
+        assert len(op.kube.list_nodes()) == nodes_before
+        # jump past the window end; consolidation proceeds
+        op.clock.step(3600.0)
+        op.run_until_idle()
+        assert len(op.kube.list_nodes()) < nodes_before or sum(
+            n.status.capacity.get("cpu", 0) for n in op.kube.list_nodes()
+        ) < 16.0
+        assert all(p.node_name for p in op.kube.list_pods())
