@@ -539,6 +539,36 @@ class DeviceScheduler:
         )
 
         C = len(classes)
+
+        # dispatch the device compat kernels NOW and fetch after the host
+        # loops below — jax dispatch is async, so the [C, T] intersect and
+        # [C, S] compatible computes overlap the rvec/offering Python work
+        # instead of blocking back-to-back.
+        # class axis buckets before the jitted kernels, or a drifting class
+        # count recompiles them every solve (the shape-churn cliff)
+        cm, im, tm = class_masks, it_masks, tmpl_masks
+        Cp = _bucket(C)
+
+        def cpad(a, fill):
+            return _pad(a, {0: Cp}, fill)
+
+        cmask_p = np.where(
+            cpad(cm.defines, False)[:, :, None], cpad(cm.mask, False), True
+        )
+        class_it_dev = mops.intersects(
+            cmask_p, cpad(cm.defines, False), cpad(cm.concrete, False),
+            cpad(cm.negative, True), cpad(cm.gt, GT_NONE),
+            cpad(cm.lt, LT_NONE),
+            im.mask, im.defines, im.concrete, im.negative, im.gt, im.lt,
+        ) if C and T else None
+        tmpl_compat_dev = mops.compatible(
+            cmask_p, cpad(cm.defines, False), cpad(cm.concrete, False),
+            cpad(cm.negative, True), cpad(cm.gt, GT_NONE),
+            cpad(cm.lt, LT_NONE),
+            tm.mask, tm.defines, tm.concrete, tm.negative, tm.gt, tm.lt,
+            jnp.asarray(well_known),
+        ) if C and S else None
+
         def rvec64(rl: dict) -> np.ndarray:
             return np.array(
                 [rl.get(n, 0.0) for n in resource_names], dtype=np.float64
@@ -574,39 +604,21 @@ class DeviceScheduler:
                 if z is not None and c_ is not None:
                     off_avail[ti, z, c_] = True
 
-        # device compat precomputes
-        # class axis buckets before the jitted mask kernels, or a drifting
-        # class count recompiles them every solve (the shape-churn cliff)
-        cm, im, tm = class_masks, it_masks, tmpl_masks
-        Cp = _bucket(C)
-
-        def cpad(a, fill):
-            return _pad(a, {0: Cp}, fill)
-
-        cmask_p = np.where(
-            cpad(cm.defines, False)[:, :, None], cpad(cm.mask, False), True
+        # fetch the device compat results dispatched before the host loops
+        class_it = (
+            np.asarray(class_it_dev)[:C]
+            if class_it_dev is not None
+            else np.zeros((C, T), dtype=bool)
         )
-        class_it = np.asarray(
-            mops.intersects(
-                cmask_p, cpad(cm.defines, False), cpad(cm.concrete, False),
-                cpad(cm.negative, True), cpad(cm.gt, GT_NONE),
-                cpad(cm.lt, LT_NONE),
-                im.mask, im.defines, im.concrete, im.negative, im.gt, im.lt,
-            )
-        )[:C] if C and T else np.zeros((C, T), dtype=bool)
         if class_it.shape[1] < pad_T:
             class_it = np.pad(
                 class_it, ((0, 0), (0, pad_T - class_it.shape[1]))
             )
-        tmpl_compat = np.asarray(
-            mops.compatible(
-                cmask_p, cpad(cm.defines, False), cpad(cm.concrete, False),
-                cpad(cm.negative, True), cpad(cm.gt, GT_NONE),
-                cpad(cm.lt, LT_NONE),
-                tm.mask, tm.defines, tm.concrete, tm.negative, tm.gt, tm.lt,
-                jnp.asarray(well_known),
-            )
-        )[:C] if C and S else np.zeros((C, pad_S), dtype=bool)
+        tmpl_compat = (
+            np.asarray(tmpl_compat_dev)[:C]
+            if tmpl_compat_dev is not None
+            else np.zeros((C, pad_S), dtype=bool)
+        )
 
         taint_ok = np.array(
             [
