@@ -6,6 +6,8 @@ recovery story of SURVEY §5 exercised end-to-end, not per-controller).
 """
 import random
 
+import pytest
+
 from tests.helpers import make_nodepool, make_pod
 from tests.test_e2e import new_operator, replicated
 
@@ -40,9 +42,10 @@ def assert_coherent(op):
     assert not op.disruption.in_flight
 
 
-def test_churn_soak_20_cycles():
+@pytest.mark.parametrize("solver", ["greedy", "tpu"])
+def test_churn_soak_20_cycles(solver):
     rng = random.Random(7)
-    op = new_operator()
+    op = new_operator(solver)
     op.kube.create(make_nodepool(requirements=[NodeSelectorRequirement(
         L.LABEL_TOPOLOGY_ZONE, "In", ("zone-a", "zone-b", "zone-c"))]))
     live = {}
