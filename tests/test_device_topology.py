@@ -573,3 +573,45 @@ class TestMultiConstraintPods:
             zc = zone_counts(res)
             assert max(zc.values()) - min(zc.values()) <= 1, zc
         assert_node_parity(rg, rd, tol=1)
+
+
+class TestInverseAntiAffinityDevice:
+    @pytest.mark.parametrize("cls", [Scheduler, DeviceScheduler])
+    def test_existing_guard_excludes_its_zone(self, cls):
+        # an EXISTING pod with anti-affinity to app=web parks in zone-a; a
+        # new app=web pod must land elsewhere even though it carries no
+        # constraints of its own (topology.go:224-269 inverse topologies),
+        # on the device path via the inverse owner/sel swap in topoplan
+        from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+            Topology, domain_universe,
+        )
+
+        pool = three_zone_pool()
+        existing_node = SimNode(
+            name="existing-a",
+            labels={
+                L.NODEPOOL_LABEL_KEY: "default",
+                L.LABEL_TOPOLOGY_ZONE: "zone-a",
+            },
+            taints=[],
+            available={"cpu": 16.0, "memory": 32 * GIB, "pods": 100.0},
+        )
+        guard = make_pod(
+            cpu=1.0, labels={"app": "guard"}, anti_affinity_to={"app": "web"}
+        )
+        guard.node_name = "existing-a"
+        guard.phase = "Running"
+        topo = Topology(
+            domains=domain_universe(
+                [pool], {"default": CATALOG}, [existing_node]
+            ),
+            existing_pods=[(guard, dict(existing_node.labels), "existing-a")],
+        )
+        kwargs = {"max_slots": 16} if cls is DeviceScheduler else {}
+        s = cls([pool], {"default": CATALOG},
+                existing_nodes=[existing_node], topology=topo, **kwargs)
+        res = s.solve([make_pod(cpu=1.0, labels={"app": "web"}, name="web")])
+        assert res.all_pods_scheduled(), res.pod_errors
+        assert not res.existing_nodes[0].pods
+        (claim,) = [c for c in res.new_node_claims if c.pods]
+        assert not claim.requirements.get(L.LABEL_TOPOLOGY_ZONE).has("zone-a")
