@@ -255,3 +255,40 @@ class TestNodePoolValidationMatrix:
 
     def test_valid_pool_ready(self):
         assert self._ready(lambda p: None)
+
+
+class TestLivenessTTL:
+    def test_unregistered_claim_reaped_after_ttl(self):
+        # a claim whose machine never joins is reaped after the 15-min
+        # registration TTL (liveness.go:41), and the pods re-provision onto
+        # a fresh claim once a working provider path exists
+        from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+        from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+        from karpenter_core_tpu.controllers.nodeclaim.lifecycle import (
+            REGISTRATION_TTL,
+        )
+        from karpenter_core_tpu.kube.store import KubeStore
+        from karpenter_core_tpu.operator import Operator, Options
+        from karpenter_core_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        kube = KubeStore(clock)
+        provider = FakeCloudProvider(
+            build_catalog(cpu_grid=[1, 2, 4], mem_factors=[2])
+        )
+        op = Operator(
+            kube=kube, cloud_provider=provider, clock=clock,
+            options=Options(),
+        )
+        op.kube.create(make_nodepool())
+        op.kube.create(make_pod(cpu=1.0, name="p0"))
+        op.run_until_idle(max_iters=10)
+        # fake provider creates instances but no Node ever registers
+        claims = op.kube.list_nodeclaims()
+        assert claims and not claims[0].is_registered()
+        name = claims[0].name
+        op.clock.step(REGISTRATION_TTL + 1.0)
+        op.run_until_idle(max_iters=10)
+        from karpenter_core_tpu.api.nodeclaim import NodeClaim
+
+        assert op.kube.get(NodeClaim, name) is None, "liveness did not reap"
