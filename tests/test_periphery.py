@@ -292,3 +292,44 @@ class TestLivenessTTL:
         from karpenter_core_tpu.api.nodeclaim import NodeClaim
 
         assert op.kube.get(NodeClaim, name) is None, "liveness did not reap"
+
+
+class TestPodEventsConsolidatable:
+    def test_pod_churn_resets_the_consolidatable_window(self):
+        # consolidateAfter counts from the LAST pod event: fresh churn on a
+        # node defers Consolidatable; quiet time matures it
+        # (podevents/controller.go:41-99, disruption/consolidation.go:40-78)
+        from karpenter_core_tpu.api.nodeclaim import COND_CONSOLIDATABLE
+        from karpenter_core_tpu.controllers.nodeclaim.disruption import (
+            POD_EVENT_DEDUPE,
+        )
+
+        from karpenter_core_tpu.api.duration import NillableDuration
+
+        op = new_operator()
+        pool = make_nodepool()
+        pool.spec.disruption.consolidate_after = NillableDuration(30.0)
+        op.kube.create(pool)
+        op.kube.create(make_pod(cpu=1.0, name="p0"))
+        op.run_until_idle(disrupt=False)
+        claim = op.kube.list_nodeclaims()[0]
+        assert claim.status.last_pod_event_time is not None
+
+        # churn within the dedupe window does not re-stamp
+        stamped = claim.status.last_pod_event_time
+        op.kube.create(make_pod(cpu=0.1, name="p1"))
+        op.run_until_idle(disrupt=False)
+        assert claim.status.last_pod_event_time == stamped
+
+        # churn after the dedupe window re-stamps and defers consolidation
+        op.clock.step(POD_EVENT_DEDUPE + 1.0)
+        op.kube.create(make_pod(cpu=0.1, name="p2"))
+        op.run_until_idle(disrupt=False)
+        assert claim.status.last_pod_event_time > stamped
+        assert not claim.conditions.is_true(COND_CONSOLIDATABLE)
+
+        # quiet time past consolidateAfter matures the condition
+        op.clock.step(40.0)
+        op.run_until_idle(disrupt=False)
+        claim = op.kube.list_nodeclaims()[0]
+        assert claim.conditions.is_true(COND_CONSOLIDATABLE)
