@@ -503,3 +503,26 @@ class TestDeviceLimits:
     def test_no_limits_unbounded_parity(self):
         assert_parity(lambda: [make_pod(cpu=9.0, name=f"p{i}")
                                for i in range(6)])
+
+
+class TestWeightedPoolsDevice:
+    def test_weighted_pool_preferred_on_device(self):
+        plain = make_nodepool("plain", weight=0)
+        preferred = make_nodepool("preferred", weight=10)
+        its = {"plain": list(CATALOG), "preferred": list(CATALOG)}
+        d = DeviceScheduler([plain, preferred], its, max_slots=16)
+        res = d.solve([make_pod(cpu=1.0, name="p0")])
+        assert res.all_pods_scheduled(), res.pod_errors
+        assert res.new_node_claims[0].template.nodepool_name == "preferred"
+
+    def test_weight_ties_break_by_name(self):
+        # equal weights: template order falls back to pool name
+        a = make_nodepool("a-pool", weight=5)
+        b = make_nodepool("b-pool", weight=5)
+        its = {"a-pool": list(CATALOG), "b-pool": list(CATALOG)}
+        for cls in (Scheduler, DeviceScheduler):
+            kwargs = {"max_slots": 16} if cls is DeviceScheduler else {}
+            s = cls([b, a], its, **kwargs)
+            res = s.solve([make_pod(cpu=1.0, name="p0")])
+            assert res.all_pods_scheduled()
+            assert res.new_node_claims[0].template.nodepool_name == "a-pool"
