@@ -333,3 +333,27 @@ class TestPodEventsConsolidatable:
         op.run_until_idle(disrupt=False)
         claim = op.kube.list_nodeclaims()[0]
         assert claim.conditions.is_true(COND_CONSOLIDATABLE)
+
+
+class TestGarbageCollectionLeakedInstance:
+    def test_leaked_cloud_instance_terminated(self):
+        # direction 2 of the GC sweep: a cloud instance with no owning
+        # NodeClaim (leaked — e.g. the claim was force-deleted) terminates
+        # (garbagecollection/controller.go:59-116)
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(make_pod(cpu=1.0, name="p0"))
+        op.run_until_idle(disrupt=False)
+        claim = op.kube.list_nodeclaims()[0]
+        pid = claim.status.provider_id
+        assert any(
+            c.status.provider_id == pid for c in op.cloud_provider.list()
+        )
+        # drop the claim object without running the termination flow
+        claim.metadata.finalizers = []
+        op.kube.delete(claim)
+        op.clock.step(121.0)  # past the sweep interval
+        op.run_until_idle()
+        assert not any(
+            c.status.provider_id == pid for c in op.cloud_provider.list()
+        ), "leaked instance survived the GC sweep"
