@@ -14,10 +14,10 @@ pod's requirements to the next admissible domain:
 * inverse anti-affinity: OTHER pods' anti-affinity terms, so a new pod whose
   labels match an existing term's selector avoids that pod's domains.
 
-Device-side note: per-group domain-count vectors + the skew rule are the
-count tensors of SURVEY §2.4; round 1 evaluates them host-side (pods with
-topology constraints take the host path; the device FFD handles the
-topology-free mass) — the device formulation is a later milestone.
+Device-side note: these groups lower to the kernel's count tensors
+(ops/topoplan.py — zone count vectors, per-slot hostname counts, skew
+rules in ops/ffd.py); the host algebra here is the parity oracle and the
+fallback for shapes the planner rules device-ineligible.
 
 Deliberate ordering deviation from the reference: ``register`` also inserts
 the domain into the universe (`self.domains`), so groups created after an
@@ -86,7 +86,8 @@ def has_required_pod_anti_affinity(pod: Pod) -> bool:
 
 def has_topology_constraints(pod: Pod) -> bool:
     """Pods with any topology-coupled constraint take the host scheduling
-    path; the device FFD only batches topology-free pods (round 1)."""
+    path; the device FFD batches the dominant constraint shapes and falls
+    back here for the exotic rest (ops/topoplan.py eligibility)."""
     return bool(
         pod.topology_spread_constraints
         or (

@@ -17,7 +17,7 @@ new-node count) is the quantity the binary search was probing; the exact
 host pipeline (price filters, spot rules) then runs once at the frontier.
 
 Pods with topology constraints take the host path (callers fall back to
-binary search when any candidate carries them — round-1 scope).
+binary search when any candidate carries them).
 """
 from __future__ import annotations
 
