@@ -355,8 +355,8 @@ class MultiNodeConsolidation(_ConsolidationBase):
         if best.decision == "no-op":
             if frontier_sizes == ([], []):
                 # the device proved no prefix schedulable, but its FFD is
-                # conservative (K_MARGIN under-placement, first-fit rather
-                # than emptiest-first), so probe the easiest host prefix
+                # conservative (sub-unit ceil/floor quantization, first-fit
+                # rather than emptiest-first), so probe the easiest host prefix
                 # once; under the monotonicity the binary search itself
                 # assumes (larger prefixes only harder), a failed size-2
                 # probe means nothing larger passes — steady-state cycles

@@ -71,7 +71,6 @@ from karpenter_core_tpu.ops import masks as mops
 from karpenter_core_tpu.ops import topoplan
 from karpenter_core_tpu.ops.ffd import (
     BIG,
-    K_MARGIN,
     RANK_NONE,
     ClassStep,
     FFDStatics,
@@ -495,8 +494,58 @@ class DeviceScheduler:
         )
         R = len(resource_names)
 
+        # Integer-unit quantization: the device planes hold integer-valued
+        # float32 (milli-units for cpu and counts, Mi for memory-like
+        # resources), so every in-kernel sum/difference/division is EXACT
+        # below 2^24 and exact-boundary fits are neither rejected (the old
+        # K_MARGIN shaved floor((alloc-req)/r) by one at exact fits, opening
+        # a fresh node where the greedy oracle's float64 math packs the last
+        # pod) nor spuriously accepted. Requests round UP, capacity rounds
+        # DOWN — the device stays conservative at sub-unit granularity and
+        # the float64 decode refit repairs any residual optimism.
+        # cpu is the only fractional k8s resource (milli-granular); memory
+        # and hugepages quantize to Mi (exact up to 2^24 Mi = 16 TiB per
+        # slot sum), ephemeral-storage to Gi (NVMe-dense nodes reach tens
+        # of TB; Gi keeps them far under 2^24); everything else (pods,
+        # integral extended resources) keeps unit granularity so the 24-bit
+        # exact-integer headroom isn't burned on a pointless inflation.
+        _MI, _GI = 2.0**20, 2.0**30
+        quant = np.array(
+            [
+                _GI
+                if n == "ephemeral-storage"
+                else _MI
+                if n == "memory" or n.startswith("hugepages-")
+                else 1e-3
+                if n == "cpu"
+                else 1.0
+                for n in resource_names
+            ],
+            dtype=np.float64,
+        )
+        # the exactness invariant the margin-free kernel floor rests on:
+        # quantized values
+        # must stay integer-representable in float32. Clamping is the
+        # enforcement — capacity clamps low (conservative), and a clamped
+        # request exceeds every real node anyway; the float64 decode refit
+        # repairs either direction.
+        _QMAX = float(2**24 - 1)
+
+        def _qraw(rl: dict) -> np.ndarray:
+            raw = np.array(
+                [rl.get(n, 0.0) for n in resource_names], dtype=np.float64
+            )
+            return raw / quant
+
         def rvec(rl: dict) -> np.ndarray:
-            return np.array([rl.get(n, 0.0) for n in resource_names], dtype=np.float32)
+            """Requests-side quantization (ceil)."""
+            x = np.ceil(_qraw(rl) * (1.0 - 1e-12) - 1e-9)
+            return np.minimum(x, _QMAX).astype(np.float32)
+
+        def rvec_cap(rl: dict) -> np.ndarray:
+            """Capacity-side quantization (floor)."""
+            x = np.floor(_qraw(rl) * (1.0 + 1e-12) + 1e-9)
+            return np.minimum(x, _QMAX).astype(np.float32)
 
         class_masks = _neutralize(
             encode_requirements_batch(frozen, [c.requirements for c in classes])
@@ -586,7 +635,7 @@ class DeviceScheduler:
         it_alloc = np.zeros((pad_T, R), dtype=np.float32)
         it_alloc64 = np.zeros((pad_T, R), dtype=np.float64)
         for ti, it in enumerate(catalog):
-            it_alloc[ti] = rvec(it.allocatable())
+            it_alloc[ti] = rvec_cap(it.allocatable())
             it_alloc64[ti] = rvec64(it.allocatable())
 
         # offerings tensor [T, Z, CT] over the zone/ct vocab rows
@@ -663,8 +712,8 @@ class DeviceScheduler:
                 r = class_requests[ci]
                 with np.errstate(divide="ignore", invalid="ignore"):
                     per_dim = np.where(r[None, :] > 0, head / np.where(r > 0, r, 1.0), np.inf)
-                # same conservative margin as the device kernel
-                k_it = np.floor(per_dim.min(axis=1) - K_MARGIN)
+                # same exact quantized arithmetic as the device kernel
+                k_it = np.floor(per_dim.min(axis=1))
                 k_it = np.where(viable & off_ok, k_it, -1)
                 if k_it.max() >= 1:
                     new_template[ci] = si
@@ -705,7 +754,7 @@ class DeviceScheduler:
             gt[ei] = exist_masks.gt[ei]
             lt[ei] = exist_masks.lt[ei]
             requests[ei] = rvec(sim.requests)
-            capacity[ei] = rvec(sim.cached_available)
+            capacity[ei] = rvec_cap(sim.cached_available)
             kind[ei] = 1
 
         exist_taint_ok = np.ones((C, N), dtype=bool)

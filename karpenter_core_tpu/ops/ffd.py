@@ -191,12 +191,13 @@ def _offering_ok(statics: FFDStatics, joined_valmask):
     return joint > 0
 
 
-# Conservative floor margin: float32 division overestimates exact-boundary
-# fits (head = 112.0000076 where float64 says 111.9999...), and every such
-# overestimate costs a host-fallback pod at decode. Shaving the margin
-# under-places at most one pod per slot at exact boundaries; the leftover
-# opens a fresh slot on device instead.
-K_MARGIN = 1e-4
+# No floor margin on the per-slot take counts. Requests and capacities
+# reach the device as integer-valued float32 (milli/Mi quantization in
+# models/provisioner rvec/rvec_cap), so sums, differences, and divisions of
+# these integers are exact below 2^24 and floor((alloc-req)/r) needs no
+# guard: a margin here would reject exact-boundary fits the greedy oracle's
+# float64 math accepts — one fresh node per shaved fit (the r4 cfg3 parity
+# gap). Any residual optimism is repaired by the float64 decode refit.
 
 
 def _k_max(state: SlotState, c: ClassStep, statics: FFDStatics, viable_it):
@@ -204,20 +205,20 @@ def _k_max(state: SlotState, c: ClassStep, statics: FFDStatics, viable_it):
 
     The per-IT counts double as the post-take fit check — k_raw[n,t] >=
     take ⇔ the slot's cumulative requests after taking still fit type t
-    (same conservative K_MARGIN) — so ffd_step's itmask update needs no
+    (same exact integer arithmetic) — so ffd_step's itmask update needs no
     second [N, T, R] reduction."""
     r = c.requests  # [R]
     safe_r = jnp.where(r > 0, r, 1.0)
     # new slots: per viable instance type
     head = (statics.it_alloc[None, :, :] - state.requests[:, None, :]) / safe_r
     head = jnp.where(r[None, None, :] > 0, head, BIG)
-    k_raw = jnp.floor(jnp.min(head, axis=-1) - K_MARGIN)  # [N, T]
+    k_raw = jnp.floor(jnp.min(head, axis=-1))  # [N, T]
     k_it = jnp.where(viable_it, k_raw, -1.0)
     k_new = jnp.max(k_it, axis=-1)  # [N]
     # existing slots: fixed available capacity
     head_e = (state.capacity - state.requests) / safe_r
     head_e = jnp.where(r[None, :] > 0, head_e, BIG)
-    k_exist = jnp.floor(jnp.min(head_e, axis=-1) - K_MARGIN)  # [N]
+    k_exist = jnp.floor(jnp.min(head_e, axis=-1))  # [N]
     k = jnp.where(state.kind == 1, k_exist, k_new)
     return jnp.clip(k, 0.0, 2**30).astype(jnp.int32), k_raw
 
@@ -573,7 +574,7 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics,
         head_f,
         jnp.where(statics.it_alloc >= oh[None, :], BIG, -1.0),
     )
-    k_fresh = jnp.floor(jnp.min(head_f, axis=-1) - K_MARGIN)  # [T]
+    k_fresh = jnp.floor(jnp.min(head_f, axis=-1))  # [T]
     off_fresh = _offering_ok(
         statics, (statics.tmpl_mask[s] & eff_mask)[None, :, :]
     )[0]  # [T]

@@ -119,8 +119,9 @@ class TestEndToEndBatched:
 
 class TestFrontierFallback:
     def test_empty_frontier_still_binary_searches(self, monkeypatch):
-        """The device FFD is conservative (K_MARGIN, first-fit), so an empty
-        frontier must NOT suppress the host binary search (ADVICE r1 #3)."""
+        """The device FFD is conservative (sub-unit quantization, first-fit),
+        so an empty frontier must NOT suppress the host binary search
+        (ADVICE r1 #3)."""
         from karpenter_core_tpu.controllers.disruption import methods
 
         op = underutilized_fleet(4, solver="tpu")

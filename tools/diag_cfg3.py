@@ -1,0 +1,106 @@
+"""Diagnose the cfg3 topology parity gap: dump per-node packing for the
+device solver vs the greedy oracle on the identical pod set and diff the
+fleet composition. Run: JAX_PLATFORMS=cpu python tools/diag_cfg3.py [n]
+"""
+from __future__ import annotations
+
+import collections
+import copy
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+from karpenter_core_tpu.cloudprovider.kwok import bench_catalog  # noqa: E402
+
+
+def kind_of(pod_name: str) -> int:
+    return int(pod_name[1:]) % 6
+
+
+KIND_NAMES = ["generic", "zonal-aff", "selector", "spread-z", "spread-h", "anti-h"]
+
+
+def describe(claims, tag):
+    print(f"\n=== {tag}: {len(claims)} nodes ===")
+    rows = []
+    for c in claims:
+        kinds = collections.Counter(kind_of(p.metadata.name) for p in c.pods)
+        cpu = c.requests.get("cpu", 0.0)
+        mem = c.requests.get("memory", 0.0) / 2**30
+        # cheapest viable instance type = what provision() would pick
+        best = None
+        for it in c.instance_type_options:
+            offs = it.offerings.available().compatible(c.requirements)
+            for o in offs:
+                if best is None or o.price < best[1]:
+                    best = (it, o.price)
+        it_name = best[0].name if best else "?"
+        itc = best[0].capacity if best else {}
+        rows.append(
+            dict(
+                npods=len(c.pods),
+                cpu=cpu,
+                mem=mem,
+                it=it_name,
+                itcpu=itc.get("cpu", 0),
+                itmem=itc.get("memory", 0) / 2**30,
+                price=best[1] if best else 0,
+                kinds=dict(sorted(kinds.items())),
+            )
+        )
+    rows.sort(key=lambda r: (-r["npods"], r["it"]))
+    total_price = sum(r["price"] for r in rows)
+    it_hist = collections.Counter(r["it"] for r in rows)
+    fill_cpu = [r["cpu"] / r["itcpu"] for r in rows if r["itcpu"]]
+    fill_mem = [r["mem"] / r["itmem"] for r in rows if r["itmem"]]
+    print(f"total price {total_price:.3f}")
+    print("instance types:", dict(it_hist.most_common()))
+    print(
+        "fill cpu avg %.3f mem avg %.3f"
+        % (sum(fill_cpu) / len(fill_cpu), sum(fill_mem) / len(fill_mem))
+    )
+    # nodes by dominant kind content
+    kind_nodes = collections.Counter()
+    for r in rows:
+        key = tuple(sorted(r["kinds"].items()))
+        kind_nodes[key] += 1
+    print("node kind-compositions (top 25):")
+    for key, n in kind_nodes.most_common(25):
+        lbl = ",".join(f"{KIND_NAMES[k]}x{v}" for k, v in key)
+        print(f"  {n:4d}  {lbl}")
+    return rows
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 5000
+    pods = bench._topology_pods(n)
+    pools = [bench._pool()]
+    catalog = bench_catalog(400)
+
+    from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+        Scheduler,
+    )
+    from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+    its = {p.name: list(catalog) for p in pools}
+    g = Scheduler(copy.deepcopy(pools), its)
+    gres = g.solve(copy.deepcopy(pods))
+    assert gres.all_pods_scheduled(), list(gres.pod_errors.items())[:3]
+
+    d = DeviceScheduler(pools, its, max_slots=2048)
+    dres = d.solve(pods)
+    assert dres.all_pods_scheduled(), list(dres.pod_errors.items())[:3]
+
+    grows = describe(gres.new_node_claims, "greedy")
+    drows = describe(dres.new_node_claims, "device")
+    print(
+        f"\nDELTA: device {len(drows)} - greedy {len(grows)} = "
+        f"{len(drows) - len(grows)}"
+    )
+
+
+if __name__ == "__main__":
+    main()

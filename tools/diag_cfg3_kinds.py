@@ -1,0 +1,66 @@
+"""Which cfg3 pod kind drives the device-vs-greedy node delta?
+Runs sub-mixes of the cfg3 kinds and reports node counts for both solvers.
+JAX_PLATFORMS=cpu python tools/diag_cfg3_kinds.py
+"""
+from __future__ import annotations
+
+import copy
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+from karpenter_core_tpu.cloudprovider.kwok import bench_catalog  # noqa: E402
+
+KIND_NAMES = ["generic", "zonal-aff", "selector", "spread-z", "spread-h", "anti-h"]
+
+
+def run(kinds, n=5000):
+    pods = [
+        p
+        for p in bench._topology_pods(n)
+        if int(p.metadata.name[1:]) % 6 in kinds
+    ]
+    pools = [bench._pool()]
+    catalog = bench_catalog(400)
+    its = {p.name: list(catalog) for p in pools}
+
+    from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+        Scheduler,
+    )
+    from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+    g = Scheduler(copy.deepcopy(pools), its)
+    gres = g.solve(copy.deepcopy(pods))
+    assert gres.all_pods_scheduled(), list(gres.pod_errors.items())[:3]
+
+    d = DeviceScheduler(pools, its, max_slots=2048)
+    dres = d.solve(pods)
+    assert dres.all_pods_scheduled(), list(dres.pod_errors.items())[:3]
+
+    lbl = "+".join(KIND_NAMES[k] for k in kinds)
+    print(
+        f"{lbl:45s} pods={len(pods):5d} greedy={gres.node_count():4d} "
+        f"device={dres.node_count():4d} delta={dres.node_count() - gres.node_count():+d}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    for kinds in (
+        (0,),
+        (3,),
+        (4,),
+        (5,),
+        (0, 1, 2),
+        (3, 4),
+        (4, 5),
+        (3, 4, 5),
+        (0, 3),
+        (0, 4),
+        (0, 5),
+        (0, 1, 2, 3, 4, 5),
+    ):
+        run(kinds)

@@ -1,0 +1,127 @@
+"""Trace WHICH class/pod opens each fresh node in device vs greedy on cfg3.
+JAX_PLATFORMS=cpu python tools/diag_cfg3_trace.py [n]
+"""
+from __future__ import annotations
+
+import collections
+import copy
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402
+from karpenter_core_tpu.cloudprovider.kwok import bench_catalog  # noqa: E402
+
+KIND_NAMES = ["generic", "zonal-aff", "selector", "spread-z", "spread-h", "anti-h"]
+
+
+def kind_of(name):
+    return int(name[1:]) % 6
+
+
+def device_trace(pods, pools, catalog):
+    from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+        Topology,
+    )
+    from karpenter_core_tpu.models.provisioner import DeviceScheduler
+    from karpenter_core_tpu.ops import topoplan
+    from karpenter_core_tpu.ops.ffd import ffd_solve
+    import jax
+
+    its = {p.name: list(catalog) for p in pools}
+    d = DeviceScheduler(pools, its, max_slots=2048)
+    d._round_remaining = {}
+    topo = Topology(domains={k: set(v) for k, v in d.domains_universe.items()})
+    topo.ensure_inverse_initialized()
+    for p in pods:
+        if p.topology_spread_constraints or p.affinity is not None:
+            topo.update(p)
+    classes = d._sorted_classes(pods, topo)
+    plan = topoplan.plan_topology(classes, topo)
+    d._final_filter_cache = {}
+    prep = d._prepare_with_vocab(plan, 2048, topo)
+    state, takes, unplaced = ffd_solve(
+        prep.init_state, d._class_steps(prep), prep.statics,
+        level_iters=prep.level_iters,
+    )
+    takes = np.asarray(jax.device_get(takes))
+    kindarr = np.asarray(jax.device_get(state.kind))
+    J = len(plan.steps)
+    takes = takes[:J]
+    print(f"device: {J} class steps, unplaced total "
+          f"{int(np.asarray(jax.device_get(unplaced))[:J].sum())}")
+
+    # first class to take on each NEW slot = the opener
+    new_slots = np.where(kindarr == 2)[0]
+    openers = collections.Counter()
+    per_class_opened = collections.Counter()
+    for n in new_slots:
+        col = takes[:, n]
+        jj = np.where(col > 0)[0]
+        if len(jj) == 0:
+            continue
+        j0 = int(jj[0])
+        ci = plan.steps[j0].class_idx
+        k = kind_of(plan.device_classes[ci].pods[0].metadata.name)
+        openers[KIND_NAMES[k]] += 1
+        per_class_opened[ci] += 1
+    print("device fresh nodes opened, by opener kind:", dict(openers))
+    multi = {j: c for j, c in per_class_opened.items() if c > 1}
+    print(f"device classes opening >1 node: {len(multi)} "
+          f"(total extra {sum(c - 1 for c in multi.values())})")
+    # biggest multi-openers
+    for j, c in sorted(multi.items(), key=lambda kv: -kv[1])[:10]:
+        cl = plan.device_classes[j]
+        # j is a class index here
+        k = kind_of(cl.pods[0].metadata.name)
+        print(f"  step {j}: opened {c} nodes, class kind={KIND_NAMES[k]} "
+              f"npods={len(cl.pods)} cpu={cl.requests.get('cpu', 0):.2f} "
+              f"mem={cl.requests.get('memory', 0) / 2**30:.2f}")
+    return openers
+
+
+def greedy_trace(pods, pools, catalog):
+    from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+        Scheduler,
+    )
+
+    its = {p.name: list(catalog) for p in pools}
+    s = Scheduler(copy.deepcopy(pools), its)
+    openers = collections.Counter()
+    orig_add = s._add
+
+    def traced_add(pod):
+        before = len(s.new_node_claims)
+        err = orig_add(pod)
+        if len(s.new_node_claims) > before:
+            openers[KIND_NAMES[kind_of(pod.metadata.name)]] += 1
+        return err
+
+    s._add = traced_add
+    res = s.solve(copy.deepcopy(pods))
+    assert res.all_pods_scheduled()
+    print(f"greedy: {res.node_count()} nodes")
+    print("greedy fresh nodes opened, by opener kind:", dict(openers))
+    return openers
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 5000
+    pods = bench._topology_pods(n)
+    pools = [bench._pool()]
+    catalog = bench_catalog(400)
+    g = greedy_trace(pods, pools, catalog)
+    d = device_trace(pods, pools, catalog)
+    print("\nopener-kind delta (device - greedy):")
+    for k in KIND_NAMES:
+        if d.get(k, 0) or g.get(k, 0):
+            print(f"  {k:10s} {d.get(k, 0) - g.get(k, 0):+d} "
+                  f"(device {d.get(k, 0)}, greedy {g.get(k, 0)})")
+
+
+if __name__ == "__main__":
+    main()
