@@ -265,13 +265,43 @@ class PodVolume:
     ephemeral: bool = False  # generic ephemeral volume -> PVC "<pod>-<name>"
 
 
+# Native-sidecar restart policy marker (k8s ContainerRestartPolicyAlways).
+CONTAINER_RESTART_ALWAYS = "Always"
+
+
+@dataclass
+class Container:
+    """One container spec entry — just the scheduling-relevant surface.
+
+    ``restart_policy`` only matters on init containers: "Always" marks a
+    native sidecar whose requests persist for the pod's lifetime
+    (resources.go:96-128 podRequests)."""
+
+    name: str = ""
+    resource_requests: ResourceList = field(default_factory=dict)
+    resource_limits: ResourceList = field(default_factory=dict)
+    restart_policy: Optional[str] = None
+
+
 @dataclass
 class Pod:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
-    # Aggregated resource requests (the reference computes this from container
-    # specs via resources.RequestsForPods, reference:
-    # pkg/utils/resources/resources.go:28; tests construct it directly).
+    # Aggregated resource requests. When ``containers``/``init_containers``
+    # are present this is DERIVED at construction via the reference's
+    # ceiling rule (max of container sum vs init-container peaks, plus
+    # overhead — resources.go:96-128); providing it directly is the
+    # flat-request convenience path for workloads without container specs.
     resource_requests: ResourceList = field(default_factory=dict)
+    # Derived alongside requests when container specs are present
+    # (resources.go podLimits; exported by the node metrics exporter via
+    # utils/resources.limits_for_pods, statenode.go:429's consumer role).
+    resource_limits: ResourceList = field(default_factory=dict)
+    # Container-level spec (utils/resources.ceiling derives the aggregate).
+    containers: list = field(default_factory=list)
+    init_containers: list = field(default_factory=list)
+    # RuntimeClass pod overhead, added on top of the container aggregate
+    # (resources.go:124-126).
+    overhead: ResourceList = field(default_factory=dict)
     node_selector: dict = field(default_factory=dict)
     affinity: Optional[Affinity] = None
     tolerations: list = field(default_factory=list)
@@ -298,6 +328,26 @@ class Pod:
     conditions: list = field(default_factory=list)
     is_daemonset: bool = False
     is_mirror: bool = False
+
+    def __post_init__(self):
+        if self.containers or self.init_containers:
+            from karpenter_core_tpu.utils import resources as _res
+
+            self.resource_requests = _res.pod_requests(self)
+            self.resource_limits = _res.pod_limits(self)
+        elif self.overhead:
+            # flat-request pods with RuntimeClass overhead: overhead lands on
+            # top of the provided requests (resources.go:124-126), it does
+            # not replace them
+            from karpenter_core_tpu.utils import resources as _res
+
+            self.resource_requests = _res.merge(
+                self.resource_requests, self.overhead
+            )
+            if self.resource_limits:
+                self.resource_limits = _res.merge(
+                    self.resource_limits, self.overhead
+                )
 
     @property
     def uid(self) -> str:

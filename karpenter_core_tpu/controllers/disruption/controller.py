@@ -94,20 +94,25 @@ class DisruptionController:
             SingleNodeConsolidation(ctx),
         ]
         self.in_flight: List[InFlightCommand] = []
-        self.pending: Optional[PendingCommand] = None
+        self.pending: List[PendingCommand] = []
 
     # -- the 10s poll body (controller.go:104-197) -------------------------
 
     def reconcile(self) -> Optional[Command]:
         self._reconcile_orchestration()
-        # in-flight commands run CONCURRENTLY (orchestration/queue.go:108-141);
-        # double-disruption is prevented by the candidates' marked_for_deletion
-        # gate in new_candidate — the HasAny guard of queue.go:305. Validation
-        # of a newly computed command stays serial.
-        if self.pending is not None:
-            return self._reconcile_pending()
+        # in-flight commands run CONCURRENTLY (orchestration/queue.go:108-141),
+        # and so do pending validations: each command waits out its own 15s
+        # TTL (per-command computed_at), the way every reference command gets
+        # its own IsValid window (validation.go:83-101). Double-disruption is
+        # prevented two ways: executed candidates by the marked_for_deletion
+        # gate in new_candidate (the HasAny guard of queue.go:305), and
+        # still-pending candidates by the busy-name filter below.
+        executed = self._reconcile_pending()
         from karpenter_core_tpu.metrics import wiring as m
 
+        busy = {
+            c.name for p in self.pending for c in p.command.candidates
+        }
         for method in self.methods:
             candidates = get_candidates(
                 self.clock,
@@ -116,6 +121,7 @@ class DisruptionController:
                 self.cloud_provider,
                 method.should_disrupt,
             )
+            candidates = [c for c in candidates if c.name not in busy]
             m.DISRUPTION_ELIGIBLE_NODES.set(
                 len(candidates), {"reason": method.reason}
             )
@@ -128,50 +134,64 @@ class DisruptionController:
             if command.decision == "no-op":
                 continue
             if getattr(method, "validation", None) is not None:
-                # hold for the TTL; validated on a later pass
-                self.pending = PendingCommand(
-                    command=command,
-                    method=method,
-                    computed_at=self.clock.now(),
+                # hold for the TTL; validated on a later pass while other
+                # commands keep computing against the remaining candidates
+                self.pending.append(
+                    PendingCommand(
+                        command=command,
+                        method=method,
+                        computed_at=self.clock.now(),
+                    )
                 )
-                return None
+                busy.update(c.name for c in command.candidates)
+                continue
             self._execute(command)
             return command
-        self.cluster.mark_consolidated()
+        if executed:
+            return executed[-1]
+        if not self.pending:
+            self.cluster.mark_consolidated()
         return None
 
     def validation_wait_remaining(self) -> float:
-        """Seconds until the pending command's TTL elapses (0 when none)."""
-        if self.pending is None:
+        """Seconds until the NEXT pending command's TTL elapses (0 if none)."""
+        if not self.pending:
             return 0.0
-        return max(
-            CONSOLIDATION_TTL - self.clock.since(self.pending.computed_at), 0.0
+        return min(
+            max(CONSOLIDATION_TTL - self.clock.since(p.computed_at), 0.0)
+            for p in self.pending
         )
 
-    def _reconcile_pending(self) -> Optional[Command]:
-        if self.validation_wait_remaining() > 0:
-            return None
+    def _reconcile_pending(self) -> List[Command]:
+        """Validate + execute every pending command whose TTL has elapsed."""
         from karpenter_core_tpu.metrics import wiring as m
 
-        pending, self.pending = self.pending, None
-        err = validate_command(self.ctx, pending.method, pending.command)
-        if err is not None:
-            # invalidated: drop; the next poll recomputes from fresh state
-            m.DISRUPTION_VALIDATION_FAILURES.inc(
-                {"reason": pending.method.reason}
-            )
-            if self.recorder is not None:
-                from karpenter_core_tpu.events import Event
+        executed: List[Command] = []
+        still_waiting: List[PendingCommand] = []
+        for pending in self.pending:
+            if self.clock.since(pending.computed_at) < CONSOLIDATION_TTL:
+                still_waiting.append(pending)
+                continue
+            err = validate_command(self.ctx, pending.method, pending.command)
+            if err is not None:
+                # invalidated: drop; the next poll recomputes from fresh state
+                m.DISRUPTION_VALIDATION_FAILURES.inc(
+                    {"reason": pending.method.reason}
+                )
+                if self.recorder is not None:
+                    from karpenter_core_tpu.events import Event
 
-                self.recorder.publish(Event(
-                    involved_object="Deployment/karpenter",
-                    type="Normal",
-                    reason="DisruptionValidationFailed",
-                    message=err,
-                ))
-            return None
-        self._execute(pending.command)
-        return pending.command
+                    self.recorder.publish(Event(
+                        involved_object="Deployment/karpenter",
+                        type="Normal",
+                        reason="DisruptionValidationFailed",
+                        message=err,
+                    ))
+                continue
+            self._execute(pending.command)
+            executed.append(pending.command)
+        self.pending = still_waiting
+        return executed
 
     # -- execution (controller.go:203-247) ---------------------------------
 

@@ -269,22 +269,50 @@ class _ConsolidationBase:
         return out
 
 
+# singlenodeconsolidation.go:30 — per-poll budget on host simulations
+SINGLE_NODE_CONSOLIDATION_TIMEOUT = 3 * 60.0
+
+
 class SingleNodeConsolidation(_ConsolidationBase):
-    """One candidate at a time (singlenodeconsolidation.go:44-101)."""
+    """One candidate at a time, bounded per poll
+    (singlenodeconsolidation.go:29-101): a 3-minute wall-clock budget stops
+    the sweep mid-list, and a persistent cursor rotates the starting
+    candidate across polls so the tail of a large cluster is eventually
+    evaluated instead of being starved behind the same cheap prefix."""
 
     consolidation_type = "single"
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self._cursor = 0
 
     def compute_command(
         self, budgets: BudgetMapping, candidates: List[Candidate]
     ) -> Command:
+        from karpenter_core_tpu.metrics import wiring as m
+
         candidates = self._budget_filter(
             budgets, sorted(candidates, key=lambda c: c.disruption_cost)
         )
-        for c in candidates:
+        if not candidates:
+            return Command()
+        start = self._cursor % len(candidates)
+        rotated = candidates[start:] + candidates[:start]
+        deadline = self.ctx.clock.now() + SINGLE_NODE_CONSOLIDATION_TIMEOUT
+        for i, c in enumerate(rotated):
+            if self.ctx.clock.now() > deadline:
+                m.CONSOLIDATION_TIMEOUTS.inc(
+                    {"consolidation_type": self.consolidation_type}
+                )
+                # resume AFTER the last candidate evaluated this poll
+                self._cursor = (start + i) % len(candidates)
+                return Command()
             cmd, _ = self.compute_consolidation([c])
             if cmd.decision != "no-op":
                 budgets.consume(c.nodepool.name, self.reason)
+                self._cursor = (start + i + 1) % len(candidates)
                 return cmd
+        self._cursor = 0  # full coverage this poll; restart at the cheapest
         return Command()
 
 

@@ -13,6 +13,12 @@ from karpenter_core_tpu.utils import pod as podutil
 
 _CRITICAL_PRIORITY_CLASSES = ("system-cluster-critical", "system-node-critical")
 
+# per-pod eviction retry backoff, the eviction queue's
+# ItemExponentialFailureRateLimiter curve (terminator/eviction.go:95,
+# orchestration/queue.go:50-54): 1s doubling to a 10s ceiling
+EVICT_BACKOFF_BASE = 1.0
+EVICT_BACKOFF_CAP = 10.0
+
 
 def _is_critical(pod) -> bool:
     return pod.priority_class_name in _CRITICAL_PRIORITY_CLASSES
@@ -25,12 +31,30 @@ class NodeTermination:
         self.cloud_provider = cloud_provider
         self.clock = clock
         self.recorder = recorder
+        # pod key -> (not-before time, current delay); entries drop on
+        # success so a repeatedly PDB-blocked (429) pod retries at 1, 2, 4,
+        # 8, 10, 10... seconds instead of hammering the apiserver every pass
+        self._evict_backoff: dict = {}
+
+    def backoff_wait_remaining(self) -> float:
+        """Seconds until the nearest eviction retry unblocks (0 when none);
+        lets a fake-clock driver elapse the backoff instead of idling."""
+        now = self.clock.now()
+        waits = [nb - now for nb, _ in self._evict_backoff.values() if nb > now]
+        return min(waits) if waits else 0.0
 
     def reconcile(self, node: Node) -> None:
         if node.metadata.deletion_timestamp is None:
             return
         if apilabels.TERMINATION_FINALIZER not in node.metadata.finalizers:
             return
+        # bound the backoff map: pods force-deleted mid-backoff (TGP) would
+        # otherwise leave entries forever
+        if len(self._evict_backoff) > 256:
+            live = {p.key() for p in self.kube.list_pods()}
+            self._evict_backoff = {
+                k: v for k, v in self._evict_backoff.items() if k in live
+            }
 
         # delete owning NodeClaims first (controller.go:178-188)
         claims = [
@@ -78,12 +102,25 @@ class NodeTermination:
             [p for p in evictable if not _is_critical(p)],
             [p for p in evictable if _is_critical(p)],
         ]
+        now = self.clock.now()
         for group in groups:
             if group:
                 for p in group:
+                    not_before, delay = self._evict_backoff.get(
+                        p.key(), (0.0, 0.0)
+                    )
+                    if now < not_before:
+                        continue  # still backing off from a prior 429
                     try:
                         self.kube.evict(p)
+                        self._evict_backoff.pop(p.key(), None)
                     except TooManyRequestsError as e:
+                        delay = (
+                            EVICT_BACKOFF_BASE
+                            if delay == 0.0
+                            else min(delay * 2.0, EVICT_BACKOFF_CAP)
+                        )
+                        self._evict_backoff[p.key()] = (now + delay, delay)
                         if self.recorder is not None:
                             from karpenter_core_tpu.events import Event
 

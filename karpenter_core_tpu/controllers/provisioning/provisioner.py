@@ -45,6 +45,11 @@ class Provisioner:
         self.solver = solver
         self.device_scheduler_opts = device_scheduler_opts or {}
         self.recorder = recorder
+        # host+device profiling hook (reference pprof, operator.go:159-175):
+        # set by the operator from --profile-solves / --profile-dir
+        self.profile_solves = 0
+        self.profile_dir = ""
+        self._profiled = 0
 
     # -- input assembly ----------------------------------------------------
 
@@ -89,6 +94,37 @@ class Provisioner:
                 p.is_daemonset = True
                 out.append(p)
         return out
+
+    def _profiled_solve(self, scheduler, pods):
+        """cProfile the host path + capture a jax.profiler trace of the
+        device path for one solve (the pprof/xprof stand-in)."""
+        import cProfile
+        import os
+
+        os.makedirs(self.profile_dir or ".", exist_ok=True)
+        n = self._profiled
+        self._profiled += 1
+        prof = cProfile.Profile()
+        trace_dir = os.path.join(self.profile_dir, f"solve-{n}-xla")
+        try:
+            import jax
+
+            jax.profiler.start_trace(trace_dir)
+            traced = True
+        except Exception:
+            traced = False
+        prof.enable()
+        try:
+            return scheduler.solve(pods)
+        finally:
+            prof.disable()
+            if traced:
+                import jax
+
+                jax.profiler.stop_trace()
+            prof.dump_stats(
+                os.path.join(self.profile_dir, f"solve-{n}.pprof")
+            )
 
     # -- the solve ---------------------------------------------------------
 
@@ -143,7 +179,10 @@ class Provisioner:
             return Results([], [], volume_errors), []
         scheduler = self.new_scheduler(pods)
         with m.SCHEDULING_DURATION.time():
-            results = scheduler.solve(pods)
+            if self._profiled < self.profile_solves:
+                results = self._profiled_solve(scheduler, pods)
+            else:
+                results = scheduler.solve(pods)
         results.pod_errors.update(volume_errors)
         m.UNSCHEDULABLE_PODS.set(len(results.pod_errors))
         if self.recorder is not None and results.pod_errors:
@@ -250,7 +289,13 @@ class Provisioner:
                 if errs:
                     # pods stay pending, but VISIBLY (the greedy solve
                     # reports limit failures in-solve; the device solve
-                    # reports them here at claim-creation time)
+                    # reports them here at claim-creation time). The counter
+                    # makes near-limit solve→drop→re-solve churn observable.
+                    from karpenter_core_tpu.metrics import wiring as m
+
+                    m.SOLVER_LIMIT_DROPPED_CLAIMS.inc(
+                        {"nodepool": pool.name}
+                    )
                     if self.recorder is not None:
                         from karpenter_core_tpu.events import Event
 

@@ -43,6 +43,33 @@ DISRUPTION_VALIDATION_FAILURES = REGISTRY.counter(
     "voluntary_disruption_validation_failures_total",
     "Commands invalidated during the validation TTL",
 )
+CONSOLIDATION_TIMEOUTS = REGISTRY.counter(
+    "consolidation_timeouts_total",
+    "Consolidation sweeps abandoned at their per-poll time budget, by type"
+    " (metrics.go ConsolidationTimeoutsTotal)",
+)
+
+NODES_POD_REQUESTS = REGISTRY.gauge(
+    "nodes_total_pod_requests",
+    "Bound pods' aggregate requests, by resource"
+    " (metrics/node/controller.go exporter)",
+)
+NODES_POD_LIMITS = REGISTRY.gauge(
+    "nodes_total_pod_limits",
+    "Bound pods' aggregate limits, by resource"
+    " (metrics/node/controller.go exporter; statenode.go:429 LimitsForPods)",
+)
+
+# -- status conditions (operatorpkg status controllers, controllers.go:103-105)
+
+STATUS_CONDITION_TRANSITIONS = REGISTRY.counter(
+    "operator_status_condition_transitions_total",
+    "Condition flips on NodeClaims/NodePools, by kind/type/status",
+)
+STATUS_CONDITION_COUNT = REGISTRY.gauge(
+    "operator_status_condition_count",
+    "Current conditions by kind/type/status",
+)
 
 # -- cluster state (state/metrics.go:36-67) --------------------------------
 
@@ -88,6 +115,11 @@ SOLVER_HOST_FALLBACK_PODS = REGISTRY.counter(
     "solver_host_fallback_pods_total",
     "Pods that left the device path, by cause "
     "(ineligible|deferred|divergent) — the silent-divergence signal",
+)
+SOLVER_LIMIT_DROPPED_CLAIMS = REGISTRY.counter(
+    "solver_limit_dropped_claims_total",
+    "Solved claims dropped at provision() by NodePool limits — near-limit"
+    " solve/drop/re-solve churn the greedy in-solve check never hits",
 )
 SOLVER_RELAX_ROUNDS = REGISTRY.counter(
     "solver_relaxation_rounds_total",

@@ -152,10 +152,17 @@ class _Prepared:
     topo: Topology
     plan: topoplan.TopoPlan
     smask: np.ndarray  # [C, K, V] strict (pod_domains) value masks
-    # numpy twins for the vectorized decode
-    it_alloc64: np.ndarray  # [pad_T, R] float64
-    class_requests64: np.ndarray  # [C, R] float64
-    tmpl_overhead64: np.ndarray  # [pad_S, R] float64
+    # float64 decode twins, quantized to the device's integer units
+    # (unclamped — float64 is exact to 2^53): every decode refit runs in
+    # the SAME arithmetic regime as the kernel, so slots the kernel packed
+    # exactly full are never rejected over raw-float drift (repeated raw
+    # adds drift ~1e-13 at exact boundaries — the r4 50k-topology decode
+    # cliff, where whole slots deferred to the per-pod host path).
+    # Ceil-requests/floor-capacity stays conservative vs true decimal
+    # quantities (k8s resource.Quantity is fixed-point, resources.go:28-66).
+    it_alloc64q: np.ndarray  # [pad_T, R] float64 (floor-quantized)
+    class_requests64q: np.ndarray  # [C, R] float64 (ceil-quantized)
+    tmpl_overhead64q: np.ndarray  # [pad_S, R] float64 (ceil-quantized)
     off_avail_np: np.ndarray  # [pad_T, Z, CT] bool
     tmpl_it_np: np.ndarray  # [pad_S, pad_T] bool
     tmpl_mask_np: np.ndarray  # [pad_S, K, V] bool
@@ -232,6 +239,32 @@ class DeviceScheduler:
         ]
 
     # ------------------------------------------------------------------
+
+    def prewarm(self, class_buckets: Sequence[int] = (8, 64, 256)) -> None:
+        """Compile (or load from the persistent compile cache) the FFD
+        kernels for the common class-count buckets before the first real
+        batch. Kernel shapes bucket on the class axis (_bucket), so a
+        synthetic solve with N distinct pod shapes warms the same jit entry
+        a real N-class batch hits; on a restarted operator with the on-disk
+        XLA cache (utils/jaxenv.enable_persistent_compile_cache) this turns
+        the first-batch compile cliff into a cache load (VERDICT r4 item 4).
+        The jit cache is process-global — any DeviceScheduler instance
+        warms every later one with the same catalog/pool shapes."""
+        GIB = 2.0**30
+        from karpenter_core_tpu.api.objects import ObjectMeta
+
+        for target in class_buckets:
+            pods = [
+                Pod(
+                    metadata=ObjectMeta(name=f"prewarm-{target}-{i}"),
+                    resource_requests={
+                        "cpu": 0.001 * (1 + i % 64),
+                        "memory": 0.125 * GIB * (1 + i // 64),
+                    },
+                )
+                for i in range(target)
+            ]
+            self.solve(pods)
 
     def solve(self, pods: List[Pod]) -> Results:
         """Device solve + host decode + relaxation outer loop.
@@ -618,25 +651,26 @@ class DeviceScheduler:
             jnp.asarray(well_known),
         ) if C and S else None
 
-        def rvec64(rl: dict) -> np.ndarray:
-            return np.array(
-                [rl.get(n, 0.0) for n in resource_names], dtype=np.float64
-            )
+        def rvec64q(rl: dict) -> np.ndarray:
+            """Requests-side quantization, float64 (ceil, unclamped)."""
+            return np.ceil(_qraw(rl) * (1.0 - 1e-12) - 1e-9)
+
+        def rvec64q_cap(rl: dict) -> np.ndarray:
+            """Capacity-side quantization, float64 (floor, unclamped)."""
+            return np.floor(_qraw(rl) * (1.0 + 1e-12) + 1e-9)
 
         class_requests = np.stack(
             [rvec(resutil.requests_for_pods(c.pods[0])) for c in classes]
         ) if classes else np.zeros((0, R), dtype=np.float32)
-        # float64 twins: the vectorized decode must match the host algebra's
-        # float64 arithmetic exactly
-        class_requests64 = np.stack(
-            [rvec64(resutil.requests_for_pods(c.pods[0])) for c in classes]
+        class_requests64q = np.stack(
+            [rvec64q(resutil.requests_for_pods(c.pods[0])) for c in classes]
         ) if classes else np.zeros((0, R), dtype=np.float64)
 
         it_alloc = np.zeros((pad_T, R), dtype=np.float32)
-        it_alloc64 = np.zeros((pad_T, R), dtype=np.float64)
+        it_alloc64q = np.zeros((pad_T, R), dtype=np.float64)
         for ti, it in enumerate(catalog):
             it_alloc[ti] = rvec_cap(it.allocatable())
-            it_alloc64[ti] = rvec64(it.allocatable())
+            it_alloc64q[ti] = rvec64q_cap(it.allocatable())
 
         # offerings tensor [T, Z, CT] over the zone/ct vocab rows
         zone_kid = frozen.keys.get(apilabels.LABEL_TOPOLOGY_ZONE, 0)
@@ -687,8 +721,8 @@ class DeviceScheduler:
         tmpl_overhead = np.stack(
             [rvec(o) for o in self.daemon_overhead]
         ) if S else np.zeros((pad_S, R), dtype=np.float32)
-        tmpl_overhead64 = np.stack(
-            [rvec64(o) for o in self.daemon_overhead]
+        tmpl_overhead64q = np.stack(
+            [rvec64q(o) for o in self.daemon_overhead]
         ) if S else np.zeros((pad_S, R), dtype=np.float64)
 
         # fresh-node viability + kstar per class (first template wins)
@@ -908,9 +942,9 @@ class DeviceScheduler:
             topo=topo,
             plan=plan,
             smask=smask,
-            it_alloc64=it_alloc64,
-            class_requests64=class_requests64,
-            tmpl_overhead64=tmpl_overhead64,
+            it_alloc64q=it_alloc64q,
+            class_requests64q=class_requests64q,
+            tmpl_overhead64q=tmpl_overhead64q,
             off_avail_np=off_avail,
             tmpl_it_np=tmpl_it,
             tmpl_mask_np=tmpl_masks.mask,
@@ -1137,7 +1171,59 @@ class DeviceScheduler:
                 kept.append(c)
             else:
                 c.destroy()
+        if can_group:
+            kept = self._repack_sparse_claims(kept)
         return kept, prep.existing_sims, failed
+
+    def _repack_sparse_claims(
+        self, claims: List[InFlightNodeClaim]
+    ) -> List[InFlightNodeClaim]:
+        """Eliminate class-batched tail fragmentation.
+
+        The kernel opens ceil(rem/kstar) identical fresh slots per class
+        (ops/ffd.py), which can strand a near-empty tail node the
+        pod-at-a-time oracle never creates. Walk claims sparsest-first and
+        try to re-place each one's pods into the other claims through the
+        host algebra; a claim whose pods all move is dropped. Stops at the
+        first claim that cannot fully drain (denser ones won't either).
+        Topology-free solves only (the caller gates on can_group): moving a
+        pod never touches domain counters here. A partial drain keeps the
+        claim with its remaining pods — still a valid packing, requests
+        intentionally left conservative (stale high) on the source."""
+        if len(claims) < 2:
+            return claims
+        claims = sorted(claims, key=lambda c: len(c.pods))
+        out = list(claims)
+        for claim in claims:
+            others = sorted(
+                (c for c in out if c is not claim), key=lambda c: len(c.pods)
+            )
+            moved: List[Pod] = []
+            ok = True
+            for p in list(claim.pods):
+                req = resutil.requests_for_pods(p)
+                placed = False
+                for o in others:
+                    try:
+                        o.add(p, req)
+                        placed = True
+                        break
+                    except IncompatibleError:
+                        continue
+                if not placed:
+                    ok = False
+                    break
+                moved.append(p)
+            if not ok:
+                # keep the claim with whatever didn't move; a moved pod
+                # stays moved (both homes are valid, only one lists it)
+                moved_ids = {id(p) for p in moved}
+                claim.pods = [p for p in claim.pods if id(p) not in moved_ids]
+                break
+            claim.pods = []
+            claim.destroy()
+            out.remove(claim)
+        return out
 
     # -- topology decode ---------------------------------------------------
 
@@ -1271,18 +1357,21 @@ class DeviceScheduler:
             and not prep.classes[ci].requirements.has_min_values()
             for ci, pods in entries
         )
-        req_vec = prep.tmpl_overhead64[si].copy()
+        # quantized-integer refit (exact under repeated addition): the same
+        # arithmetic regime as the device kernel, so a slot the kernel packed
+        # exactly full is not deferred over a 1e-13 raw-float drift
+        req_vec = prep.tmpl_overhead64q[si].copy()
         requests = dict(self.daemon_overhead[si])
         for ci, pods in entries:
             for _ in range(len(pods)):
-                req_vec += prep.class_requests64[ci]
+                req_vec += prep.class_requests64q[ci]
             requests = resutil.merge_repeated(
                 requests, resutil.requests_for_pods(pods[0]), len(pods)
             )
         opt_idx = [
             int(t)
             for t in np.nonzero(itmask[n, :T])[0]
-            if np.all(req_vec <= prep.it_alloc64[t])
+            if np.all(req_vec <= prep.it_alloc64q[t])
         ]
         if not plane_ok or not opt_idx:
             for ci, pods in entries:
@@ -1434,7 +1523,7 @@ class DeviceScheduler:
         cm = prep.class_masks
         T = len(prep.catalog)
         mask = prep.tmpl_it_np[si].copy()
-        req_vec = prep.tmpl_overhead64[si].copy()
+        req_vec = prep.tmpl_overhead64q[si].copy()
         zmask = prep.tmpl_mask_np[si, prep.zone_kid, :Z].copy()
         ctmask = prep.tmpl_mask_np[si, prep.ct_kid, :CT].copy()
         requests = dict(self.daemon_overhead[si])
@@ -1449,13 +1538,15 @@ class DeviceScheduler:
             pod_cursor[ci] = start + k
             if not pods:
                 continue
-            # repeated addition, matching the host merge-per-pod rounding
+            # quantized-integer accumulation — the device kernel's exact
+            # arithmetic, so slots the kernel packed exactly full are not
+            # rejected over raw-float drift (see _Prepared twin comments)
             trial_req = req_vec.copy()
             for _ in range(k):
-                trial_req += prep.class_requests64[ci]
+                trial_req += prep.class_requests64q[ci]
             trial_z = zmask & cm.mask[ci, prep.zone_kid, :Z]
             trial_ct = ctmask & cm.mask[ci, prep.ct_kid, :CT]
-            fits = (trial_req[None, :] <= prep.it_alloc64).all(axis=1)
+            fits = (trial_req[None, :] <= prep.it_alloc64q).all(axis=1)
             off_ok = (
                 prep.off_avail_np
                 & trial_z[None, :, None]
@@ -1491,8 +1582,11 @@ class DeviceScheduler:
             shape = (si, tuple(zip(committed, counts)))
             remaining = self._final_filter_cache.get(shape)
             if remaining is None:
+                # requirements-only narrowing: the resource fit was already
+                # decided in the quantized-exact regime above; re-checking
+                # with raw-float requests would re-reject exactly-full slots
                 remaining = filter_instance_types(
-                    options, claim.requirements, requests
+                    options, claim.requirements, {}
                 ).remaining
                 self._final_filter_cache[shape] = remaining
             if not remaining:

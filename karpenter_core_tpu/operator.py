@@ -67,6 +67,11 @@ class Options:
     max_iters: int = 0  # CLI loop bound (0 = until interrupted)
     feature_gates: Dict[str, bool] = field(default_factory=dict)
     device_scheduler_opts: Dict = field(default_factory=dict)
+    # host/device profiling hooks (the reference's pprof surface,
+    # operator.go:159-175): cProfile the next N solves + a jax.profiler
+    # trace per profiled solve, written under profile_dir
+    profile_solves: int = 0
+    profile_dir: str = "/tmp/karpenter-profiles"
 
     _FLAGS = {
         "solver": ("--solver", "KARPENTER_SOLVER", str),
@@ -79,6 +84,10 @@ class Options:
         "log_level": ("--log-level", "KARPENTER_LOG_LEVEL", str),
         "poll_interval": ("--poll-interval", "KARPENTER_POLL_INTERVAL", float),
         "max_iters": ("--max-iters", "KARPENTER_MAX_ITERS", int),
+        "profile_solves": (
+            "--profile-solves", "KARPENTER_PROFILE_SOLVES", int,
+        ),
+        "profile_dir": ("--profile-dir", "KARPENTER_PROFILE_DIR", str),
     }
 
     @classmethod
@@ -156,6 +165,8 @@ class Operator:
             device_scheduler_opts=self.options.device_scheduler_opts,
             recorder=self.recorder,
         )
+        self.provisioner.profile_solves = self.options.profile_solves
+        self.provisioner.profile_dir = self.options.profile_dir
         self.lifecycle = NodeClaimLifecycle(
             self.kube, self.cluster, self.cloud_provider, self.clock
         )
@@ -195,6 +206,9 @@ class Operator:
             self.clock,
             enabled=self.options.feature_gates.get("NodeRepair", False),
         )
+        from karpenter_core_tpu.controllers.status import StatusController
+
+        self.status = StatusController(self.kube, self.recorder, self.clock)
         # pod-trigger batching gates the solve (batcher.go:33-110); the
         # store's synchronous watch is the trigger controller
         # (provisioning/controller.go:54-76)
@@ -262,11 +276,15 @@ class Operator:
                 self._provision()
         if disrupt:
             self.disruption.reconcile()
+        self.status.reconcile()
         self._export_metrics()
 
     def _export_metrics(self) -> None:
         """State gauges + pod/node/nodepool exporters (state/metrics.go:36-67,
-        pkg/controllers/metrics/{pod,node,nodepool})."""
+        pkg/controllers/metrics/{pod,node,nodepool}). Multi-series gauges
+        reset before re-export so a phase/nodepool/resource that disappears
+        drops its series instead of freezing at the last value (the
+        reference's gauge stores delete stale series on every update)."""
         from karpenter_core_tpu.metrics import wiring as m
         from karpenter_core_tpu.utils import resources as resutil
 
@@ -275,13 +293,25 @@ class Operator:
         by_phase: Dict[str, int] = {}
         for p in self.kube.list_pods():
             by_phase[p.phase] = by_phase.get(p.phase, 0) + 1
+        m.PODS_STATE.reset()
         for phase, n in by_phase.items():
             m.PODS_STATE.set(n, {"phase": phase})
         alloc: Dict[str, float] = {}
         for node in self.kube.list_nodes():
             alloc = resutil.merge(alloc, node.status.allocatable)
+        m.NODES_ALLOCATABLE.reset()
         for name, qty in alloc.items():
             m.NODES_ALLOCATABLE.set(qty, {"resource_type": name})
+        bound = [p for p in self.kube.list_pods() if p.node_name]
+        m.NODES_POD_REQUESTS.reset()
+        m.NODES_POD_LIMITS.reset()
+        if bound:
+            for name, qty in resutil.requests_for_pods(*bound).items():
+                m.NODES_POD_REQUESTS.set(qty, {"resource_type": name})
+            for name, qty in resutil.limits_for_pods(*bound).items():
+                m.NODES_POD_LIMITS.set(qty, {"resource_type": name})
+        m.NODEPOOL_USAGE.reset()
+        m.NODEPOOL_LIMIT.reset()
         for pool in self.kube.list_nodepools():
             for name, qty in (pool.status.resources or {}).items():
                 m.NODEPOOL_USAGE.set(
@@ -305,6 +335,7 @@ class Operator:
             self.reconcile_once(disrupt=disrupt)
             if self.kube.mutations == before and not self.disruption.in_flight:
                 waits = [self.batcher.wait_remaining()]
+                waits.append(self.termination.backoff_wait_remaining())
                 if disrupt:
                     waits.append(self.disruption.validation_wait_remaining())
                 waits = [w for w in waits if w > 0]

@@ -387,3 +387,103 @@ class TestCronBudgetWindows:
             n.status.capacity.get("cpu", 0) for n in op.kube.list_nodes()
         ) < 16.0
         assert all(p.node_name for p in op.kube.list_pods())
+
+
+class TestSingleNodeBounding:
+    """singlenodeconsolidation.go:29-101: per-poll time budget + rotation."""
+
+    def _method(self, clock):
+        from types import SimpleNamespace
+
+        from karpenter_core_tpu.controllers.disruption.controller import (
+            DisruptionContext,
+        )
+        from karpenter_core_tpu.controllers.disruption.methods import (
+            SingleNodeConsolidation,
+        )
+
+        ctx = DisruptionContext(
+            kube=None, cluster=None, provisioner=None,
+            cloud_provider=None, clock=clock,
+        )
+        method = SingleNodeConsolidation(ctx)
+        evaluated = []
+
+        def fake_compute(cands):
+            from karpenter_core_tpu.controllers.disruption.types import Command
+
+            evaluated.append(cands[0].state_node.name)
+            clock.step(100.0)  # each host simulation "costs" 100s
+            return Command(), None
+
+        method.compute_consolidation = fake_compute
+        return method, evaluated
+
+    def _candidates(self, n):
+        from types import SimpleNamespace
+
+        from karpenter_core_tpu.controllers.disruption.types import Candidate
+
+        return [
+            Candidate(
+                state_node=SimpleNamespace(name=f"n{i}"),
+                node_claim=None,
+                nodepool=SimpleNamespace(name="default"),
+                instance_type=None,
+                zone="zone-a",
+                capacity_type="on-demand",
+                reschedulable_pods=[object()],
+                disruption_cost=float(i),
+            )
+            for i in range(n)
+        ]
+
+    def test_timeout_bounds_sims_per_poll(self):
+        from karpenter_core_tpu.controllers.disruption.helpers import (
+            BudgetMapping,
+        )
+        from karpenter_core_tpu.metrics.wiring import CONSOLIDATION_TIMEOUTS
+
+        clock = FakeClock()
+        method, evaluated = self._method(clock)
+        before = CONSOLIDATION_TIMEOUTS.value(
+            {"consolidation_type": "single"}
+        )
+        cmd = method.compute_command(BudgetMapping({}), self._candidates(50))
+        assert cmd.decision == "no-op"
+        # 180s budget / 100s per sim -> exactly 2 sims before the deadline
+        assert evaluated == ["n0", "n1"]
+        assert CONSOLIDATION_TIMEOUTS.value(
+            {"consolidation_type": "single"}
+        ) == before + 1
+
+    def test_cursor_rotates_to_full_coverage(self):
+        from karpenter_core_tpu.controllers.disruption.helpers import (
+            BudgetMapping,
+        )
+
+        clock = FakeClock()
+        method, evaluated = self._method(clock)
+        cands = self._candidates(5)
+        for _ in range(3):  # 3 polls x 2 sims each cover all 5 candidates
+            method.compute_command(BudgetMapping({}), cands)
+        assert set(evaluated) >= {f"n{i}" for i in range(5)}
+
+    def test_no_timeout_evaluates_all_and_resets(self):
+        from karpenter_core_tpu.controllers.disruption.helpers import (
+            BudgetMapping,
+        )
+
+        clock = FakeClock()
+        method, evaluated = self._method(clock)
+
+        def cheap(cands):
+            from karpenter_core_tpu.controllers.disruption.types import Command
+
+            evaluated.append(cands[0].state_node.name)
+            return Command(), None
+
+        method.compute_consolidation = cheap
+        method.compute_command(BudgetMapping({}), self._candidates(4))
+        assert evaluated == ["n0", "n1", "n2", "n3"]
+        assert method._cursor == 0

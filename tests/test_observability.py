@@ -64,3 +64,82 @@ class TestMetricsWiring:
         assert m.SOLVER_DECODE_DURATION.totals
         after_fallback = sum(m.SOLVER_HOST_FALLBACK_PODS.values.values())
         assert after_fallback > before_fallback, "fallback went uncounted"
+
+
+class TestConditionTransitions:
+    """Status-controller role (controllers.go:103-105): every condition flip
+    emits a transition counter + event; deleted objects drop their series."""
+
+    def test_transitions_counted_and_events_published(self):
+        op = new_operator()
+        before = sum(m.STATUS_CONDITION_TRANSITIONS.values.values())
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=1.0, name="p0")))
+        op.run_until_idle()
+        after = sum(m.STATUS_CONDITION_TRANSITIONS.values.values())
+        # a claim went Launched/Registered/Initialized at minimum
+        assert after - before >= 3
+        assert m.STATUS_CONDITION_TRANSITIONS.value(
+            {"kind": "NodeClaim", "type": "Launched", "status": "True"}
+        ) >= 1
+        assert any(
+            e.involved_object.startswith("NodeClaim/")
+            and "Initialized" in e.reason
+            for e in op.recorder.events
+        )
+
+    def test_repeat_reconcile_does_not_recount(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=1.0, name="p0")))
+        op.run_until_idle()
+        snap = dict(m.STATUS_CONDITION_TRANSITIONS.values)
+        op.run_until_idle()
+        assert dict(m.STATUS_CONDITION_TRANSITIONS.values) == snap
+
+    def test_deleted_object_drops_condition_series(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=1.0, name="p0")))
+        op.run_until_idle()
+        assert m.STATUS_CONDITION_COUNT.value(
+            {"kind": "NodeClaim", "type": "Launched", "status": "True"}
+        ) >= 1
+        pod = op.kube.get(
+            __import__("karpenter_core_tpu.api.objects", fromlist=["Pod"]).Pod,
+            "p0",
+        )
+        pod.metadata.owner_references = []
+        op.kube.delete(pod)
+        op.run_until_idle()  # consolidation deletes the empty node + claim
+        assert not op.kube.list_nodeclaims()
+        assert m.STATUS_CONDITION_COUNT.value(
+            {"kind": "NodeClaim", "type": "Launched", "status": "True"}
+        ) == 0
+
+
+class TestStaleGaugeCleanup:
+    def test_phase_and_nodepool_series_clear(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=1.0, name="p0")))
+        op.run_until_idle()
+        assert m.PODS_STATE.value({"phase": "Running"}) == 1
+        assert m.NODEPOOL_USAGE.value(
+            {"nodepool": "default", "resource_type": "cpu"}
+        ) > 0
+        pod = op.kube.get(
+            __import__("karpenter_core_tpu.api.objects", fromlist=["Pod"]).Pod,
+            "p0",
+        )
+        pod.metadata.owner_references = []
+        op.kube.delete(pod)
+        for pool in op.kube.list_nodepools():
+            op.kube.delete(pool)
+        op.run_until_idle()
+        # the Running phase and the nodepool usage series are gone, not
+        # frozen at their last values
+        assert m.PODS_STATE.value({"phase": "Running"}) == 0
+        assert m.NODEPOOL_USAGE.value(
+            {"nodepool": "default", "resource_type": "cpu"}
+        ) == 0

@@ -143,3 +143,31 @@ class TestHealthProbes:
         op.kube.create(make_pod(cpu=1.0, name="p0"))
         op.run_until_idle()
         assert op.readyz()
+
+
+class TestProfilingHook:
+    def test_profile_solves_writes_pprof(self, tmp_path):
+        from tests.helpers import make_nodepool, make_pod
+        from tests.test_e2e import new_operator, replicated
+
+        op = new_operator()
+        op.provisioner.profile_solves = 1
+        op.provisioner.profile_dir = str(tmp_path)
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=1.0, name="p0")))
+        op.run_until_idle()
+        files = [f.name for f in tmp_path.iterdir()]
+        assert "solve-0.pprof" in files
+        import pstats
+
+        stats = pstats.Stats(str(tmp_path / "solve-0.pprof"))
+        assert stats.total_calls > 0
+
+    def test_profile_flags_parse(self):
+        from karpenter_core_tpu.operator import Options
+
+        opts = Options.parse(
+            ["--profile-solves", "3", "--profile-dir", "/tmp/x"]
+        )
+        assert opts.profile_solves == 3
+        assert opts.profile_dir == "/tmp/x"

@@ -66,8 +66,12 @@ class TestTGPEnforcement:
         op.kube.create(make_pdb(min_available=1, app="web"))
         node = op.kube.list_nodes()[0]
         op.kube.delete(node)
-        op.run_until_idle()
-        # PDB blocks the graceful drain; node still present
+        # PDB blocks the graceful drain: bounded reconciles (staying well
+        # under the TGP deadline — run_until_idle would elapse the eviction
+        # backoff timers all the way to the forced deadline) leave the node
+        for _ in range(5):
+            op.reconcile_once()
+            op.clock.step(2.0)
         assert op.kube.get(Node, node.name) is not None
         assert op.kube.get(Pod, "w0") is not None
         # cross the force-delete threshold: deadline - podGracePeriod
@@ -139,7 +143,11 @@ class TestTGPWithVolumes:
         op.kube.create(make_pdb(min_available=1, app="web"))
         node = op.kube.list_nodes()[0]
         op.kube.delete(node)
-        op.run_until_idle()
+        # bounded reconciles below the TGP deadline (run_until_idle would
+        # elapse eviction backoff all the way to the forced deadline)
+        for _ in range(5):
+            op.reconcile_once()
+            op.clock.step(2.0)
         assert op.kube.get(Node, node.name) is not None  # PDB blocks drain
         op.clock.step(300.0)
         op.run_until_idle()
@@ -150,3 +158,41 @@ class TestTGPWithVolumes:
             for va in op.kube.list_volume_attachments()
             if va.node_name == node.name
         ]
+
+
+class TestEvictionBackoff:
+    def test_429_retries_follow_exponential_curve(self):
+        """PDB-blocked evictions retry at 1,2,4,8,10,10... seconds
+        (the eviction queue's rate-limiter curve, terminator/eviction.go:95,
+        orchestration/queue.go:50-54) instead of every reconcile pass."""
+        from tests.test_pdb import make_pdb
+
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        p = replicated(make_pod(cpu=0.5, name="w0", labels={"app": "web"}))
+        op.kube.create(p)
+        op.run_until_idle()
+        op.kube.create(make_pdb(min_available=1, app="web"))
+        node = op.kube.list_nodes()[0]
+        evictions = []
+        orig = op.kube.evict
+
+        def spying_evict(pod):
+            evictions.append(op.clock.now())
+            return orig(pod)
+
+        op.kube.evict = spying_evict
+        op.kube.delete(node)
+        t0 = op.clock.now()
+        # drive many passes with fine-grained clock steps; attempts must
+        # thin out along the backoff curve, not fire every pass
+        for _ in range(40):
+            op.reconcile_once()
+            op.clock.step(0.5)
+        rel = [round(t - t0, 1) for t in evictions]
+        assert len(rel) >= 4
+        gaps = [round(b - a, 1) for a, b in zip(rel, rel[1:])]
+        # first retry after ~1s, then ~2s, then ~4s (>= allows pass quantum)
+        assert gaps[0] >= 1.0 and gaps[0] < 2.0, gaps
+        assert gaps[1] >= 2.0 and gaps[1] < 3.0, gaps
+        assert gaps[2] >= 4.0 and gaps[2] < 5.0, gaps

@@ -1,0 +1,246 @@
+"""Container-level request derivation — ported scenario battery.
+
+Re-expresses the reference's resources suite
+(pkg/utils/resources/suite_test.go:38-602) against utils/resources.ceiling:
+sidecar (restartPolicy=Always init) containers add to the running sum, each
+non-restartable init container's needs stack on the sidecars started before
+it, the pod total is the max of the two, and RuntimeClass overhead lands on
+top (resources.go:96-162).
+"""
+from karpenter_core_tpu.api.objects import (
+    CONTAINER_RESTART_ALWAYS,
+    Container,
+    ObjectMeta,
+    Pod,
+)
+from karpenter_core_tpu.utils import resources as res
+
+GI = 2.0**30
+
+
+def c(cpu, mem_gi, restart=None, limits=None):
+    rl = {"cpu": float(cpu), "memory": mem_gi * GI}
+    return Container(
+        resource_requests=dict(rl),
+        resource_limits=dict(rl) if limits is None else limits,
+        restart_policy=restart,
+    )
+
+
+def sidecar(cpu, mem_gi):
+    return c(cpu, mem_gi, restart=CONTAINER_RESTART_ALWAYS)
+
+
+def pod(containers=(), inits=(), overhead=None):
+    return Pod(
+        metadata=ObjectMeta(name="p"),
+        containers=list(containers),
+        init_containers=list(inits),
+        overhead=dict(overhead or {}),
+    )
+
+
+def expect(p, cpu, mem_gi):
+    reqs, lims = res.ceiling(p)
+    assert reqs["cpu"] == cpu, (reqs["cpu"], cpu)
+    assert reqs["memory"] == mem_gi * GI, (reqs["memory"] / GI, mem_gi)
+    assert lims["cpu"] == cpu
+    assert lims["memory"] == mem_gi * GI
+
+
+# --- ported scenarios (suite_test.go:40-567) ---------------------------------
+
+
+def test_sum_of_containers_and_sidecars():
+    expect(pod([c(2, 1)], [sidecar(1, 2)]), 3, 3)
+
+
+def test_containers_sidecars_inits_and_overhead():
+    p = pod(
+        [c(2, 1)],
+        [c(4, 2), sidecar(3, 3)],
+        overhead={"cpu": 5.0, "memory": 1 * GI},
+    )
+    expect(p, 10, 5)
+
+
+def test_init_after_sidecar_exceeds_containers():
+    expect(pod([c(2, 1)], [sidecar(4, 2), c(10, 2)]), 14, 4)
+
+
+def test_init_after_sidecar_does_not_exceed_containers():
+    expect(pod([c(2, 2)], [sidecar(4, 2), c(1, 1)]), 6, 4)
+
+
+def test_init_after_multiple_sidecars_exceeds():
+    p = pod(
+        [c(3, 3)],
+        [sidecar(2, 2), sidecar(1, 1), sidecar(3, 3), sidecar(5, 5), c(20, 20)],
+    )
+    expect(p, 31, 31)
+
+
+def test_init_after_multiple_sidecars_does_not_exceed():
+    p = pod(
+        [c(3, 3)],
+        [sidecar(2, 2), sidecar(1, 1), sidecar(3, 3), sidecar(5, 5), c(1, 1)],
+    )
+    expect(p, 14, 14)
+
+
+def test_first_init_exceeds_all_sidecars_and_containers():
+    p = pod(
+        [c(3, 3)],
+        [
+            c(25, 25),
+            sidecar(1, 1),
+            c(3, 3),
+            c(1, 1),
+            sidecar(5, 5),
+            c(1, 1),
+            c(1, 1),
+            sidecar(1, 1),
+        ],
+    )
+    expect(p, 25, 25)
+
+
+def test_multiple_interspersed_sidecars_and_inits():
+    p = pod(
+        [c(3, 3)],
+        [
+            c(2, 2),
+            sidecar(1, 1),
+            c(3, 3),
+            c(1, 1),
+            sidecar(5, 5),
+            c(1, 1),
+            c(1, 1),
+            sidecar(1, 1),
+            c(2, 1),
+        ],
+    )
+    expect(p, 10, 10)
+
+
+def test_first_init_exceeds_cpu_but_not_memory():
+    p = pod([c(3, 3)], [c(25, 4), sidecar(1, 1), sidecar(5, 5)])
+    expect(p, 25, 9)
+
+
+def test_first_init_exceeds_memory_but_not_cpu():
+    p = pod([c(3, 3)], [c(4, 25), sidecar(1, 1), sidecar(5, 5)])
+    expect(p, 9, 25)
+
+
+def test_init_after_sidecar_exceeds_cpu_but_not_memory():
+    p = pod([c(2, 4)], [sidecar(4, 2), c(10, 2)])
+    expect(p, 14, 6)
+
+
+def test_init_after_sidecar_exceeds_memory_but_not_cpu():
+    p = pod([c(10, 2)], [sidecar(4, 2), c(2, 4)])
+    expect(p, 14, 6)
+
+
+# --- resource merging (suite_test.go:569-601) --------------------------------
+
+
+def test_limits_merge_into_requests_when_no_request():
+    container = Container(resource_limits={"cpu": 2.0, "memory": 1 * GI})
+    merged = res.merge_limits_into_requests(container)
+    assert merged == {"cpu": 2.0, "memory": 1 * GI}
+
+
+def test_limits_merge_into_requests_sidecar():
+    container = Container(
+        resource_limits={"cpu": 2.0, "memory": 1 * GI},
+        restart_policy=CONTAINER_RESTART_ALWAYS,
+    )
+    p = pod([c(1, 1)], [container])
+    assert p.resource_requests["cpu"] == 3.0
+    assert p.resource_requests["memory"] == 2 * GI
+
+
+def test_limits_do_not_fall_back_to_requests():
+    # a container with requests but no limits contributes nothing to limits
+    container = Container(resource_requests={"cpu": 2.0})
+    p = pod([container])
+    assert p.resource_requests["cpu"] == 2.0
+    assert p.resource_limits.get("cpu", 0.0) == 0.0
+
+
+# --- framework integration ---------------------------------------------------
+
+
+def test_flat_request_path_still_works():
+    p = Pod(metadata=ObjectMeta(name="p"), resource_requests={"cpu": 1.0})
+    assert p.resource_requests == {"cpu": 1.0}
+
+
+def test_derived_requests_flow_into_requests_for_pods():
+    p = pod([c(2, 1)], [sidecar(1, 2)])
+    total = res.requests_for_pods(p)
+    assert total["cpu"] == 3.0
+    assert total["memory"] == 3 * GI
+    assert total["pods"] == 1.0
+
+
+def test_scheduler_consumes_derived_requests():
+    """A container-built pod schedules identically to its flat twin."""
+    import copy
+
+    from karpenter_core_tpu.api.nodepool import NodePool, NodePoolSpec
+    from karpenter_core_tpu.cloudprovider.kwok import bench_catalog
+    from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+        Scheduler,
+    )
+
+    pool = NodePool(metadata=ObjectMeta(name="default"))
+    pool.spec = NodePoolSpec()
+    catalog = bench_catalog(120)
+
+    flat = [
+        Pod(metadata=ObjectMeta(name=f"f{i}"),
+            resource_requests={"cpu": 3.0, "memory": 3 * GI})
+        for i in range(20)
+    ]
+    built = [
+        pod([c(2, 1)], [sidecar(1, 2)]) for _ in range(20)
+    ]
+    for i, p in enumerate(built):
+        p.metadata.name = f"b{i}"
+
+    s1 = Scheduler([copy.deepcopy(pool)], {"default": list(catalog)})
+    s2 = Scheduler([copy.deepcopy(pool)], {"default": list(catalog)})
+    r1 = s1.solve(flat)
+    r2 = s2.solve(built)
+    assert r1.all_pods_scheduled() and r2.all_pods_scheduled()
+    assert r1.node_count() == r2.node_count()
+
+
+def test_flat_requests_plus_overhead_add_not_replace():
+    """Overhead on a flat-request pod lands on top of the provided requests
+    (resources.go:124-126) — it must not zero them out."""
+    p = Pod(
+        metadata=ObjectMeta(name="p"),
+        resource_requests={"cpu": 4.0},
+        overhead={"cpu": 0.1},
+    )
+    assert p.resource_requests["cpu"] == 4.1
+
+
+def test_node_limits_exporter_uses_derived_limits():
+    from tests.helpers import make_nodepool
+    from tests.test_e2e import new_operator, replicated
+
+    from karpenter_core_tpu.metrics import wiring as m
+
+    op = new_operator()
+    op.kube.create(make_nodepool())
+    p = pod([c(1, 1, limits={"cpu": 2.0, "memory": 2 * GI})])
+    p.metadata.name = "lim0"
+    op.kube.create(replicated(p))
+    op.run_until_idle()
+    assert m.NODES_POD_REQUESTS.value({"resource_type": "cpu"}) >= 1.0
+    assert m.NODES_POD_LIMITS.value({"resource_type": "cpu"}) == 2.0

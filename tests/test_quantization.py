@@ -113,14 +113,27 @@ class TestQuantizationVectors:
         # 1000 bytes -> ceil to 1 Mi
         assert creq[names.index("memory")] == 1.0
 
-    def test_float64_twins_stay_raw(self):
+    def test_float64_twins_are_quantized_integers(self):
+        """The decode twins carry the device's integer units (unclamped
+        float64) so repeated adds are EXACT — raw floats drift ~1e-13 on an
+        exactly-full slot and falsely defer it to the per-pod host path
+        (the r4 50k-topology decode cliff)."""
         catalog = _one_type_catalog(cpu=4.0, mem_gib=8.0)
         pods = [make_pod(cpu=0.1, memory_gib=0.25, name="p0")]
         prep = self._prep(catalog, pods)
         names = prep.resource_names
-        creq64 = prep.class_requests64[0]
-        assert creq64[names.index("cpu")] == pytest.approx(0.1)
-        assert creq64[names.index("memory")] == pytest.approx(0.25 * GIB)
+        creq64q = prep.class_requests64q[0]
+        assert creq64q[names.index("cpu")] == 100.0  # milli, ceil
+        assert creq64q[names.index("memory")] == 256.0  # Mi, ceil
+        alloc64q = prep.it_alloc64q[0]
+        assert alloc64q[names.index("cpu")] == np.floor(
+            alloc64q[names.index("cpu")]
+        )
+        # 160 x 0.1-cpu adds stay integer-exact against a 16-cpu boundary
+        acc = np.zeros_like(creq64q)
+        for _ in range(160):
+            acc = acc + creq64q
+        assert acc[names.index("cpu")] == 16000.0
 
 
 class TestExactBoundaryFits:

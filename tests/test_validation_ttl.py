@@ -43,12 +43,12 @@ class TestValidationTTL:
         # command is computed; it must be HELD, not executed
         for _ in range(10):
             op.reconcile_once()
-            if op.disruption.pending is not None:
+            if op.disruption.pending:
                 break
-        assert op.disruption.pending is not None
+        assert op.disruption.pending
         n_nodes = len(op.kube.list_nodes())
         op.reconcile_once()
-        assert op.disruption.pending is not None, "executed before the TTL"
+        assert op.disruption.pending, "executed before the TTL"
         assert len(op.kube.list_nodes()) == n_nodes
         # run_until_idle steps the fake clock through the TTL; the command
         # validates and executes
@@ -66,10 +66,10 @@ class TestValidationTTL:
         # drive until a command is pending (but TTL not elapsed)
         for _ in range(10):
             op.reconcile_once()
-            if op.disruption.pending is not None:
+            if op.disruption.pending:
                 break
-        assert op.disruption.pending is not None
-        held = op.disruption.pending
+        assert op.disruption.pending
+        held = list(op.disruption.pending)
         # a burst of pending pods lands inside the validation window,
         # large enough that the candidates' capacity is needed again
         for i in range(8):
@@ -77,7 +77,7 @@ class TestValidationTTL:
         # elapse the TTL; validation must reject the stale command
         op.clock.step(CONSOLIDATION_TTL + 1.0)
         op.reconcile_once()
-        assert op.disruption.pending is not held
+        assert op.disruption.pending != held
         # no candidate node was deleted by the aborted command: the burst
         # pods bind, and nothing thrashes
         op.run_until_idle()
@@ -97,4 +97,53 @@ class TestValidationTTL:
         # drift disruption proceeded: old claim replaced without TTL stall
         claims = op.kube.list_nodeclaims()
         assert claim.name not in {c.name for c in claims}
+        assert all(p.node_name for p in op.kube.list_pods())
+
+    def test_concurrent_pending_commands_share_one_window(self):
+        """Two independent commands (one emptiness, one consolidation) wait
+        out their TTLs simultaneously — per-command clocks, not one pending
+        slot serializing at a command per 15s."""
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        # two 12-cpu pods split across two 16-cpu nodes; the small pod
+        # first-fits onto node1. Deleting the bigs leaves node1
+        # underutilized (consolidation command) and node2 empty (emptiness
+        # command) — two candidates, two independent commands.
+        pods = [
+            replicated(make_pod(cpu=12.0, name="big0")),
+            replicated(make_pod(cpu=12.0, name="big1")),
+            replicated(make_pod(cpu=0.6, name="small")),
+        ]
+        for p in pods:
+            op.kube.create(p)
+        op.run_until_idle()
+        nodes_before = len(op.kube.list_nodes())
+        assert nodes_before >= 2
+        Pod = __import__(
+            "karpenter_core_tpu.api.objects", fromlist=["Pod"]
+        ).Pod
+        for name in ("big0", "big1"):
+            big = op.kube.get(Pod, name)
+            big.metadata.owner_references = []
+            op.kube.delete(big)
+        op.clock.step(40.0)
+        # drive reconciles WITHOUT advancing past the TTL: both commands
+        # must stack up pending (their candidates do not overlap)
+        for _ in range(12):
+            op.reconcile_once()
+            if len(op.disruption.pending) >= 2:
+                break
+        assert len(op.disruption.pending) >= 2, (
+            f"only {len(op.disruption.pending)} pending; serialized"
+        )
+        names = [
+            c.name for p in op.disruption.pending for c in p.command.candidates
+        ]
+        assert len(names) == len(set(names)), "double-disruption overlap"
+        # one shared window elapses -> BOTH execute on the next pass
+        op.clock.step(CONSOLIDATION_TTL + 1.0)
+        op.reconcile_once()
+        assert not op.disruption.pending
+        op.run_until_idle()
+        assert len(op.kube.list_nodes()) < nodes_before
         assert all(p.node_name for p in op.kube.list_pods())

@@ -1,0 +1,99 @@
+"""Diagnose the cfg3 topology parity gap: device vs greedy node contents.
+
+Runs the bench's cfg3 workload (deterministic), solves with both solvers,
+then buckets the resulting nodes by (instance type, pod-kind histogram) and
+prints the diff so the extra device nodes are attributable to a pod family.
+"""
+from __future__ import annotations
+
+import copy
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 5000
+
+
+def kind_of(pod_name: str) -> str:
+    i = int(pod_name[1:])
+    return ["generic", "zonal", "selector", "spread-z", "spread-h", "anti-h"][i % 6]
+
+
+def describe(res):
+    nodes = []
+    for claim in res.new_node_claims:
+        opts = claim.instance_type_options
+        it = opts[0].name if opts else "?"
+        kinds = Counter(kind_of(p.name) for p in claim.pods)
+        cpu = sum(p.resource_requests.get("cpu", 0) for p in claim.pods)
+        nodes.append((it, tuple(sorted(kinds.items())), len(claim.pods), round(cpu, 1)))
+    return nodes
+
+
+def main():
+    from karpenter_core_tpu.cloudprovider.kwok import bench_catalog
+
+    pods = bench._topology_pods(N)
+    pools = [bench._pool()]
+    catalog = bench_catalog(400)
+
+    from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+        Scheduler,
+    )
+    from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+    its = {p.name: list(catalog) for p in pools}
+    g = Scheduler(copy.deepcopy(pools), {k: list(v) for k, v in its.items()})
+    gres = g.solve(copy.deepcopy(pods))
+    assert gres.all_pods_scheduled()
+
+    d = DeviceScheduler(pools, its, max_slots=2048)
+    dres = d.solve(pods)
+    assert dres.all_pods_scheduled()
+
+    gn = describe(gres)
+    dn = describe(dres)
+    print(f"greedy nodes: {len(gn)}   device nodes: {len(dn)}  delta {len(dn)-len(gn)}")
+
+    # histogram by instance type
+    git = Counter(n[0] for n in gn)
+    dit = Counter(n[0] for n in dn)
+    print("\nby instance type (device - greedy):")
+    for it in sorted(set(git) | set(dit)):
+        diff = dit[it] - git[it]
+        if diff:
+            print(f"  {it:30s} greedy={git[it]:3d} device={dit[it]:3d} diff={diff:+d}")
+
+    # histogram by dominant pod kind on the node
+    def dom(n):
+        return max(n[1], key=lambda kv: kv[1])[0] if n[1] else "?"
+
+    gk = Counter(dom(n) for n in gn)
+    dk = Counter(dom(n) for n in dn)
+    print("\nby dominant pod kind (device - greedy):")
+    for k in sorted(set(gk) | set(dk)):
+        print(f"  {k:10s} greedy={gk[k]:3d} device={dk[k]:3d} diff={dk[k]-gk[k]:+d}")
+
+    # pods-per-node distribution
+    print("\npods/node: greedy total pods", sum(n[2] for n in gn),
+          "device", sum(n[2] for n in dn))
+    gpp = sorted((n[2] for n in gn))
+    dpp = sorted((n[2] for n in dn))
+    print("greedy pods/node min/p50/max:", gpp[0], gpp[len(gpp)//2], gpp[-1])
+    print("device pods/node min/p50/max:", dpp[0], dpp[len(dpp)//2], dpp[-1])
+
+    # cpu utilization per node
+    print("\nnodes sorted by pod count (device):")
+    for n in sorted(dn, key=lambda x: x[2])[:15]:
+        print("  ", n)
+    print("\nnodes sorted by pod count (greedy):")
+    for n in sorted(gn, key=lambda x: x[2])[:15]:
+        print("  ", n)
+
+
+if __name__ == "__main__":
+    main()
