@@ -447,12 +447,17 @@ def _run_restart_probe() -> dict:
     import subprocess
     import sys
 
-    proc = subprocess.run(
-        [sys.executable, __file__, "--restart-probe"],
-        capture_output=True, text=True, timeout=600,
-        env={**os.environ, "BENCH_PODS": str(N_PODS),
-             "BENCH_TYPES": str(N_TYPES)},
-    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, __file__, "--restart-probe"],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "BENCH_PODS": str(N_PODS),
+                 "BENCH_TYPES": str(N_TYPES)},
+        )
+    except subprocess.TimeoutExpired:
+        # degrade like other child failures — the already-measured configs
+        # must still reach the JSON line
+        return {"error": "restart probe exceeded 600s"}
     for line in reversed(proc.stdout.strip().splitlines()):
         try:
             return json.loads(line)

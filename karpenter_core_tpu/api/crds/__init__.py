@@ -1,0 +1,1 @@
+"""Generated CRD manifests (tools/gen_crds.py; reference pkg/apis/crds/)."""

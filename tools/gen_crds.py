@@ -1,0 +1,146 @@
+"""Generate CRD manifests for the API surface from the dataclasses.
+
+The reference ships generated CustomResourceDefinition YAML
+(pkg/apis/crds/karpenter.sh_nodepools.yaml, _nodeclaims.yaml) produced by
+controller-gen from struct tags; here the dataclasses are the source of
+truth, so this walks their fields/types into openAPIV3Schema properties.
+Run from the repo root:
+
+    python tools/gen_crds.py          # rewrites karpenter_core_tpu/api/crds/
+
+tests/test_periphery.py asserts the checked-in artifacts are current.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import typing
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import yaml  # noqa: E402
+
+from karpenter_core_tpu.api.duration import NillableDuration  # noqa: E402
+from karpenter_core_tpu.api.nodeclaim import NodeClaim  # noqa: E402
+from karpenter_core_tpu.api.nodepool import Limits, NodePool  # noqa: E402
+from karpenter_core_tpu.api.status import ConditionSet  # noqa: E402
+
+OUT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "karpenter_core_tpu", "api", "crds",
+)
+
+_PRIMITIVES = {
+    str: {"type": "string"},
+    int: {"type": "integer"},
+    float: {"type": "number"},
+    bool: {"type": "boolean"},
+}
+
+
+def _schema(tp, seen: tuple) -> dict:
+    origin = typing.get_origin(tp)
+    args = typing.get_args(tp)
+    if tp in _PRIMITIVES:
+        return dict(_PRIMITIVES[tp])
+    if tp is NillableDuration:
+        return {
+            "type": "string",
+            "description": "duration in seconds; 'Never' disables",
+            "x-nillable-duration": True,
+        }
+    if tp is ConditionSet:
+        return {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "properties": {
+                    "type": {"type": "string"},
+                    "status": {"type": "string"},
+                    "reason": {"type": "string"},
+                    "message": {"type": "string"},
+                    "lastTransitionTime": {"type": "number"},
+                },
+            },
+        }
+    if tp is Limits or origin is dict or tp is dict:
+        return {"type": "object", "additionalProperties": True}
+    if origin in (list, tuple) or tp in (list, tuple):
+        item = _schema(args[0], seen) if args else {}
+        return {"type": "array", "items": item}
+    if origin is typing.Union:
+        non_none = [a for a in args if a is not type(None)]
+        if len(non_none) == 1:
+            s = _schema(non_none[0], seen)
+            s["nullable"] = True
+            return s
+        return {}
+    if dataclasses.is_dataclass(tp):
+        if tp in seen:  # recursion guard (Pod inside DaemonSet etc.)
+            return {"type": "object", "x-ref": tp.__name__}
+        try:
+            hints = typing.get_type_hints(tp)
+        except Exception:
+            hints = {}
+        props = {}
+        for f in dataclasses.fields(tp):
+            props[f.name] = _schema(hints.get(f.name, f.type), seen + (tp,))
+        return {"type": "object", "properties": props}
+    return {}
+
+
+def crd(cls, plural: str, scope: str = "Cluster") -> dict:
+    # resolve string annotations (from __future__ annotations) to types
+    hints = typing.get_type_hints(cls)
+    props = {
+        f.name: _schema(hints.get(f.name, f.type), (cls,))
+        for f in dataclasses.fields(cls)
+    }
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.karpenter.sh"},
+        "spec": {
+            "group": "karpenter.sh",
+            "names": {
+                "kind": cls.__name__,
+                "listKind": f"{cls.__name__}List",
+                "plural": plural,
+                "singular": cls.__name__.lower(),
+            },
+            "scope": scope,
+            "versions": [{
+                "name": "v1",
+                "served": True,
+                "storage": True,
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": props,
+                }},
+            }],
+        },
+    }
+
+
+def render() -> dict:
+    """filename -> yaml text for every CRD artifact."""
+    out = {}
+    for cls, plural in ((NodePool, "nodepools"), (NodeClaim, "nodeclaims")):
+        text = yaml.safe_dump(
+            crd(cls, plural), sort_keys=True, default_flow_style=False
+        )
+        out[f"karpenter.sh_{plural}.yaml"] = text
+    return out
+
+
+def main() -> None:
+    os.makedirs(OUT_DIR, exist_ok=True)
+    for fname, text in render().items():
+        with open(os.path.join(OUT_DIR, fname), "w") as f:
+            f.write(text)
+        print(f"wrote {fname}")
+
+
+if __name__ == "__main__":
+    main()
