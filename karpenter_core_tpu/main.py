@@ -25,6 +25,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     logger = configure(options.log_level)
 
     op = Operator(options=options)
+    health = None
+    if options.health_port:
+        from karpenter_core_tpu.healthserver import start_health_server
+
+        port = 0 if options.health_port < 0 else options.health_port
+        health = start_health_server(op, port)
+        logger.info(
+            "health/metrics on 127.0.0.1:%d (/healthz /readyz /metrics)",
+            health.server_address[1],
+        )
     logger.info(
         "operator starting: solver=%s batch=%ss/%ss gates=%s",
         options.solver,
@@ -42,6 +52,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             time.sleep(options.poll_interval)
     except KeyboardInterrupt:
         logger.info("operator interrupted after %d passes", n)
+    finally:
+        if health is not None:
+            health.shutdown()
+            health.server_close()
     return 0
 
 

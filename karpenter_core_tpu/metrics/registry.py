@@ -114,20 +114,24 @@ class Registry:
         return m
 
     def render(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format. Iterates over list() snapshots
+        so a scrape from the health server's handler thread survives the
+        operator thread registering metrics/series mid-render (single torn
+        values are acceptable scrape noise; a 'dict changed size' crash is
+        not)."""
         lines = []
-        for name, m in sorted(self.metrics.items()):
+        for name, m in sorted(list(self.metrics.items())):
             full = f"{NAMESPACE}_{name}"
             if m.help:
                 lines.append(f"# HELP {full} {m.help}")
             if isinstance(m, (Counter, Gauge)):
                 kind = "counter" if isinstance(m, Counter) else "gauge"
                 lines.append(f"# TYPE {full} {kind}")
-                for k, v in sorted(m.values.items()):
+                for k, v in sorted(list(m.values.items())):
                     lines.append(f"{full}{_fmt_labels(k)} {v:g}")
             elif isinstance(m, Histogram):
                 lines.append(f"# TYPE {full} histogram")
-                for k in sorted(m.totals):
+                for k in sorted(list(m.totals)):
                     cum = 0
                     for i, b in enumerate(m.buckets):
                         cum = m.counts[k][i]

@@ -73,7 +73,11 @@ class Options:
     profile_solves: int = 0
     profile_dir: str = "/tmp/karpenter-profiles"
 
+    # served HTTP surface (operator.go:105-198): 0 disables, -1 picks free
+    health_port: int = 0
+
     _FLAGS = {
+        "health_port": ("--health-port", "KARPENTER_HEALTH_PORT", int),
         "solver": ("--solver", "KARPENTER_SOLVER", str),
         "batch_max_duration": (
             "--batch-max-duration", "KARPENTER_BATCH_MAX_DURATION", float,

@@ -208,6 +208,44 @@ def plan_topology(classes: List[PodClass], topo: Topology) -> TopoPlan:
             fallback_classes.append(cls)
             reasons[id(cls)] = reason
 
+    # Ordering-inversion guard: fallback classes place AFTER the device
+    # scan, but a label-keyed anti-affinity OWNER placed in-kernel with an
+    # uncommitted key records every value its slot could take
+    # (topology.go:541-542 semantics), blocking selected fallback pods the
+    # greedy order schedules first. Pull such owners into the fallback set
+    # (to fixpoint — moves can cascade) so the whole interacting set
+    # resolves in host order.
+    label_anti_groups = [
+        g
+        for g in list(topo.topologies.values())
+        + list(topo.inverse_topologies.values())
+        if g.type == TYPE_ANTI_AFFINITY and g.key != apilabels.LABEL_HOSTNAME
+    ]
+    anti_owned_by_class = {
+        id(cls): [
+            g for g in label_anti_groups if g.is_owned_by(cls.pods[0].uid)
+        ]
+        for cls in device_classes
+    } if label_anti_groups else {}
+    moved = bool(anti_owned_by_class)
+    while moved:
+        moved = False
+        fb_reps = [c.pods[0] for c in fallback_classes]
+        if not fb_reps:
+            break
+        for cls in list(device_classes):
+            anti_owned = anti_owned_by_class.get(id(cls), ())
+            if any(
+                g.selects(fr) for g in anti_owned for fr in fb_reps
+            ):
+                device_classes.remove(cls)
+                fallback_classes.append(cls)
+                reasons[id(cls)] = (
+                    "label anti-affinity owner interacts with a fallback class"
+                )
+                wf_by_class.pop(id(cls), None)
+                moved = True
+
     plan = TopoPlan(
         host_groups=host_groups,
         label_groups=label_groups,

@@ -171,3 +171,42 @@ class TestProfilingHook:
         )
         assert opts.profile_solves == 3
         assert opts.profile_dir == "/tmp/x"
+
+
+class TestHealthServer:
+    def test_probes_and_metrics_served(self):
+        import urllib.request
+
+        from tests.helpers import make_nodepool, make_pod
+        from tests.test_e2e import new_operator, replicated
+
+        from karpenter_core_tpu.healthserver import start_health_server
+
+        op = new_operator()
+        srv = start_health_server(op, port=0)
+        try:
+            port = srv.server_address[1]
+
+            def get(path):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5
+                ) as r:
+                    return r.status, r.read().decode()
+
+            assert get("/healthz")[0] == 200
+            assert get("/readyz")[0] == 200
+            op.kube.create(make_nodepool())
+            op.kube.create(replicated(make_pod(cpu=1.0, name="h0")))
+            op.run_until_idle()
+            code, text = get("/metrics")
+            assert code == 200
+            assert "karpenter_provisioner_scheduling_duration_seconds" in text
+            assert "karpenter_cluster_state_node_count" in text
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_health_port_flag_parses(self):
+        from karpenter_core_tpu.operator import Options
+
+        assert Options.parse(["--health-port", "8081"]).health_port == 8081
