@@ -14,7 +14,12 @@ Secondary lines (reported in `detail`):
   cfg3_topology   the reference's diverse benchmark mix (1/6 each generic,
                   zonal, selector, zone-spread, hostname-spread, hostname
                   anti-affinity; scheduling_benchmark_test.go:233-247) at
-                  5k pods, through the device topology kernel
+                  5k pods, through the device topology kernel. Known
+                  deviation: at this scale the class-batched scan settles
+                  ~5% thinner than greedy (uniform slot sizes — see the
+                  DENSIFY knob rationale in models/provisioner.py); at 50k
+                  (cfg3_topology_50k) the same kernel BEATS greedy's node
+                  count while solving ~90x faster
 
 Every config reports `parity_nodes_delta` = device nodes − greedy nodes
 on the identical pod set (the north star demands node-count parity, not
@@ -477,7 +482,9 @@ def main():
     catalog = bench_catalog(N_TYPES)
 
     primary = _solve_bench(
-        _plain_pods(N_PODS), [_pool()], catalog, parity=not FAST
+        _plain_pods(N_PODS), [_pool()], catalog, parity=not FAST,
+        repeats=7,  # the budget guard reads this p50; extra samples damp
+        # tunnel-latency jitter on the shared chip
     )
     detail = {"primary": primary}
 

@@ -88,6 +88,22 @@ from karpenter_core_tpu.solver.vocab import (
 from karpenter_core_tpu.utils import resources as resutil
 
 
+# Densification deferral knobs (see _decode_topo): fresh topology slots at
+# or below DENSIFY_THRESHOLD x median pod count drain through the host
+# repair path, capped at DENSIFY_CAP of the fresh slots AND at
+# DENSIFY_POD_BUDGET total pods per solve (the repair is ~ms/pod of host
+# algebra, so the budget bounds the decode-time cost at any scale).
+# Deliberately conservative: the pass exists to recover genuinely sparse
+# tail slots. Uniform thinness (every slot near the median, the cfg3-5k
+# +5% equilibrium of class-batched packing) is NOT repairable this way —
+# sweeping thresholds showed median-wide deferral either re-creates the
+# same slots (spread/anti constraints force fresh hosts) or devolves into
+# a full host re-solve at ~ms/pod.
+DENSIFY_THRESHOLD = 0.5
+DENSIFY_CAP = 0.125
+DENSIFY_POD_BUDGET = 256
+
+
 def _neutralize(masks: EntityMasks) -> EntityMasks:
     """Apply the neutral-where-undefined invariant required by ffd_step."""
     d = masks.defines
@@ -360,7 +376,7 @@ class DeviceScheduler:
         # (device count state) and which fall back to the host algebra
         classes = self._sorted_classes(pods, topo)
         plan = topoplan.plan_topology(classes, topo)
-        self._final_filter_cache: Dict[tuple, list] = {}
+        self._composition_cache: Dict[tuple, tuple] = {}
 
         from karpenter_core_tpu.metrics import wiring as m
 
@@ -1256,9 +1272,11 @@ class DeviceScheduler:
         zcount = np.asarray(out["zcount"]).astype(np.int64).copy()
 
         deferred: List[Pod] = []
+        densified = 0  # densify victims inside `deferred` (metrics split)
         # (slot, class, k, slot requirements, hostname) per bulk commit
         committed: List[tuple] = []
         slot_hostnames: Dict[int, str] = {}
+        slot_claims: Dict[int, InFlightNodeClaim] = {}  # fresh slots only
 
         def defer(n: int, ci: int, pods: List[Pod]) -> None:
             self._topo_subtract(
@@ -1294,16 +1312,64 @@ class DeviceScheduler:
                     prep, n, int(slot_template[n]), groups, pod_cursor,
                     claims, committed, slot_hostnames, defer,
                     valmask, defines, complement, gt, lt, itmask,
+                    slot_claims,
                 )
+
+        # Voluntary densification deferral (the topology twin of
+        # _repack_sparse_claims): the class-batched kernel strands sparse
+        # tail slots (ceil(rem/kstar) per class) the pod-at-a-time oracle
+        # never opens. Drain the sparsest fresh slots through the existing
+        # subtract-and-repair machinery — their pods re-place one-by-one
+        # into the other claims' residual capacity via the host algebra,
+        # re-opening an equivalent node only when nothing admits them, so
+        # the pass can only densify.
+        if len(slot_claims) >= 2:
+            sizes = sorted(len(c.pods) for c in slot_claims.values())
+            median = sizes[len(sizes) // 2]
+            eligible = sorted(
+                (
+                    (n, c)
+                    for n, c in slot_claims.items()
+                    if len(c.pods) <= int(median * DENSIFY_THRESHOLD)
+                ),
+                key=lambda nc: len(nc[1].pods),
+            )[: int(len(slot_claims) * DENSIFY_CAP)]
+            victims = []
+            pod_budget = DENSIFY_POD_BUDGET
+            for n, c in eligible:
+                if len(c.pods) > pod_budget:
+                    break
+                pod_budget -= len(c.pods)
+                victims.append((n, c))
+            if victims:
+                from karpenter_core_tpu.metrics import wiring as m
+
+                densified = sum(len(c.pods) for _, c in victims)
+                m.SOLVER_HOST_FALLBACK_PODS.inc(
+                    {"cause": "densify"}, by=densified
+                )
+            for n, claim in victims:
+                for entry in [e for e in committed if e[0] == n]:
+                    _n, ci, k, _reqs, _hn = entry
+                    self._topo_subtract(
+                        plan, valmask, defines, complement, n, ci, k,
+                        hcount, zcount,
+                    )
+                    committed.remove(entry)
+                deferred.extend(claim.pods)
+                claim.pods = []
+                claim.destroy()
+                claims.remove(claim)
+                slot_hostnames.pop(n, None)
 
         self._sync_topo_counts(prep, hcount, zcount, slot_hostnames)
         self._recount_host_only(prep, committed)
 
-        if deferred:
+        if len(deferred) > densified:
             from karpenter_core_tpu.metrics import wiring as m
 
             m.SOLVER_HOST_FALLBACK_PODS.inc(
-                {"cause": "deferred"}, by=len(deferred)
+                {"cause": "deferred"}, by=len(deferred) - densified
             )
         for p in deferred:
             err = self._host_fallback_add(p, claims, prep.existing_sims, topo)
@@ -1335,6 +1401,7 @@ class DeviceScheduler:
         gt: np.ndarray,
         lt: np.ndarray,
         itmask: np.ndarray,
+        slot_claims: Optional[Dict[int, InFlightNodeClaim]] = None,
     ) -> None:
         """Materialize one fresh topology slot from the final device planes:
         float64-refit the take against the slot's final viable instance
@@ -1394,6 +1461,8 @@ class DeviceScheduler:
         claim.requests = requests
         claims.append(claim)
         slot_hostnames[n] = claim.hostname
+        if slot_claims is not None:
+            slot_claims[n] = claim
         for ci, pods in entries:
             committed.append((n, ci, len(pods), reqs, claim.hostname))
 
@@ -1519,6 +1588,54 @@ class DeviceScheduler:
             ):
                 return False
 
+        # The whole plane outcome is a pure function of the composition
+        # (si, groups) given prep — and hundreds of slots repeat a handful
+        # of compositions, so the per-class trial loop, request folding,
+        # requirement joining, and final filter all cache on that shape;
+        # per-slot work reduces to cursor advancement + claim assembly.
+        shape = (si, tuple(groups))
+        cached = self._composition_cache.get(shape)
+        if cached is None:
+            cached = self._decode_composition(prep, si, template, groups)
+            self._composition_cache[shape] = cached
+        committed_counts, remaining, requests_proto, reqs_proto = cached
+
+        committed_set = {ci for ci, _ in committed_counts}
+        pods_all: List[Pod] = []
+        for ci, k in groups:
+            cls = prep.classes[ci]
+            start = pod_cursor[ci]
+            pods = cls.pods[start : start + k]
+            pod_cursor[ci] = start + k
+            if not pods:
+                continue
+            if ci in committed_set and remaining:
+                pods_all.extend(pods)
+            else:
+                divergent.extend(pods)
+        if pods_all:
+            claim = InFlightNodeClaim(
+                template, topo, self.daemon_overhead[si], list(remaining)
+            )
+            claim.requirements.add(*(r.copy() for r in reqs_proto))
+            claim.pods = pods_all
+            claim.requests = dict(requests_proto)
+            claims.append(claim)
+        return True
+
+    def _decode_composition(
+        self, prep: _Prepared, si: int, template, groups: List[Tuple[int, int]]
+    ):
+        """Evaluate one composition shape through the plane algebra: the
+        per-group viability mask — template ITs ∧ class requirement compat
+        (class_it, the same kernels the FFD scan used, property-tested
+        against the host algebra) ∧ quantized-integer resource fit (the
+        device kernel's exact arithmetic, so slots packed exactly full are
+        not rejected over raw-float drift) ∧ offering availability under
+        the joined zone/capacity-type masks — then one final
+        requirements-only filter_instance_types against the JOINED
+        requirements (classes can be pairwise-IT-compatible yet jointly
+        narrower)."""
         Z, CT = prep.n_zones, prep.n_cts
         cm = prep.class_masks
         T = len(prep.catalog)
@@ -1527,20 +1644,12 @@ class DeviceScheduler:
         zmask = prep.tmpl_mask_np[si, prep.zone_kid, :Z].copy()
         ctmask = prep.tmpl_mask_np[si, prep.ct_kid, :CT].copy()
         requests = dict(self.daemon_overhead[si])
-        pods_all: List[Pod] = []
-        committed: List[int] = []
-        counts: List[int] = []
+        committed_counts: List[Tuple[int, int]] = []
 
         for ci, k in groups:
             cls = prep.classes[ci]
-            start = pod_cursor[ci]
-            pods = cls.pods[start : start + k]
-            pod_cursor[ci] = start + k
-            if not pods:
+            if not cls.pods:
                 continue
-            # quantized-integer accumulation — the device kernel's exact
-            # arithmetic, so slots the kernel packed exactly full are not
-            # rejected over raw-float drift (see _Prepared twin comments)
             trial_req = req_vec.copy()
             for _ in range(k):
                 trial_req += prep.class_requests64q[ci]
@@ -1554,50 +1663,29 @@ class DeviceScheduler:
             ).any(axis=(1, 2))
             trial = mask & prep.class_it[ci] & fits & off_ok
             if not trial.any():
-                divergent.extend(pods)
-                continue
+                continue  # caller diverges this class (not in committed)
             mask, req_vec, zmask, ctmask = trial, trial_req, trial_z, trial_ct
             requests = resutil.merge_repeated(
-                requests, resutil.requests_for_pods(pods[0]), k
+                requests, resutil.requests_for_pods(cls.pods[0]), k
             )
-            pods_all.extend(pods)
-            committed.append(ci)
-            counts.append(k)
+            committed_counts.append((ci, k))
 
-        if pods_all:
+        remaining: list = []
+        reqs_proto: list = []
+        if committed_counts:
             options = [prep.catalog[i] for i in np.nonzero(mask[:T])[0]]
-            claim = InFlightNodeClaim(
-                template, topo, self.daemon_overhead[si], options
-            )
-            for ci in committed:
-                claim.requirements.add(
-                    *(r.copy() for r in prep.classes[ci].requirements.values())
-                )
-            # the per-group mask narrows pairwise (class_it per class); one
-            # final host filter against the JOINED requirements makes the
-            # option list exactly what sequential add() would leave (classes
-            # can be pairwise-IT-compatible yet jointly narrower). Identical
-            # fill shapes share the result — hundreds of slots repeat a
-            # handful of compositions.
-            shape = (si, tuple(zip(committed, counts)))
-            remaining = self._final_filter_cache.get(shape)
-            if remaining is None:
-                # requirements-only narrowing: the resource fit was already
-                # decided in the quantized-exact regime above; re-checking
-                # with raw-float requests would re-reject exactly-full slots
-                remaining = filter_instance_types(
-                    options, claim.requirements, {}
-                ).remaining
-                self._final_filter_cache[shape] = remaining
+            joined = Requirements()
+            joined.add(*(r.copy() for r in template.requirements.values()))
+            for ci, _k in committed_counts:
+                reqs = prep.classes[ci].requirements
+                reqs_proto.extend(reqs.values())
+                joined.add(*(r.copy() for r in reqs.values()))
+            remaining = filter_instance_types(options, joined, {}).remaining
             if not remaining:
-                claim.destroy()
-                divergent.extend(pods_all)
-                return True
-            claim.instance_type_options = list(remaining)
-            claim.pods = pods_all
-            claim.requests = requests
-            claims.append(claim)
-        return True
+                # jointly-incompatible composition: everything diverges
+                committed_counts = []
+                reqs_proto = []
+        return committed_counts, remaining, requests, reqs_proto
 
     def _host_fallback_add(
         self,
