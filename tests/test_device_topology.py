@@ -45,7 +45,9 @@ def both_solve(pods, pools=None, existing=None, max_slots=64):
 def assert_node_parity(rg, rd, tol=0):
     assert set(rg.pod_errors) == set(rd.pod_errors), (
         rg.pod_errors, rd.pod_errors)
-    assert abs(rd.node_count() - rg.node_count()) <= tol, (
+    # one-sided: the device's host-floor-first ordering can BEAT the
+    # oracle; it must never be worse by more than tol
+    assert rd.node_count() <= rg.node_count() + tol, (
         f"device {rd.node_count()} vs greedy {rg.node_count()}")
 
 

@@ -133,6 +133,20 @@ def random_existing(rng, k):
 
 
 def check_device_invariants(res, existing):
+    # capacity: every fresh claim's cumulative requests fit at least one of
+    # its surviving instance-type options (guards the one-sided node bound:
+    # "denser than greedy" must come from packing, not dropped capacity)
+    for c in res.new_node_claims:
+        assert c.instance_type_options, c.requests
+        fits_one = any(
+            all(
+                c.requests.get(name, 0.0) <= it.allocatable().get(name, 0.0)
+                * (1 + 1e-9) + 1e-6
+                for name in c.requests
+            )
+            for it in c.instance_type_options
+        )
+        assert fits_one, (c.requests, [it.name for it in c.instance_type_options])
     groups = [(c.requirements, list(c.pods), None) for c in res.new_node_claims]
     groups += [
         (s.requirements, list(s.pods), s.node) for s in res.existing_nodes
@@ -140,6 +154,16 @@ def check_device_invariants(res, existing):
     for reqs, pods, node in groups:
         antis = [p for p in pods if p.metadata.labels.get("app") == "anti"]
         assert len(antis) <= 1, [p.name for p in antis]
+        # hostname-spread skew: fresh nodes are always creatable so the
+        # domain min floats at zero — per-node count <= maxSkew
+        hspread = [
+            p for p in pods
+            if any(
+                t.topology_key == L.LABEL_HOSTNAME
+                for t in p.topology_spread_constraints
+            )
+        ]
+        assert len(hspread) <= 1, [p.name for p in hspread]
         if node is not None and node.taints:
             from karpenter_core_tpu.scheduling import Taints
 
@@ -189,7 +213,9 @@ def test_fuzz_mixed_scenarios(seed):
     )
     assert placed_g == placed_d == len(pods) - len(rg.pod_errors)
     if rg.node_count():
-        assert abs(rd.node_count() - rg.node_count()) <= max(
+        # one-sided: the host-floor-first class ordering lets the device
+        # BEAT the oracle's node count; it must never be meaningfully worse
+        assert rd.node_count() <= rg.node_count() + max(
             2, 0.2 * rg.node_count()
         ), f"greedy={rg.node_count()} device={rd.node_count()}"
     check_device_invariants(rd, existing)

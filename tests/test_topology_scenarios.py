@@ -676,3 +676,48 @@ class TestNodePoolTaints:
         pods = [pod("p0")]
         for res in solve_both(pods, pools=[pool]):
             assert res.all_pods_scheduled(), res.pod_errors
+
+
+class TestHostFloorOrdering:
+    def test_anti_affinity_with_affinity_dependency_not_promoted(self):
+        """A class owning hostname anti-affinity PLUS a pod affinity to
+        another class must keep size order: promoted ahead of its target it
+        would find no count>0 domain and fail pods the oracle places."""
+        db = pod("db", cpu=2.0, labels={"app": "db"},
+                 node_selector={L.LABEL_TOPOLOGY_ZONE: "zone-a"})
+        followers = [
+            pod(
+                f"w{i}", cpu=0.3, labels={"app": "worker"},
+                affinity=Affinity(
+                    pod_affinity=PodAffinity(required=[PodAffinityTerm(
+                        topology_key=L.LABEL_TOPOLOGY_ZONE,
+                        label_selector=selector_for({"app": "db"}),
+                    )]),
+                    pod_anti_affinity=PodAffinity(required=[PodAffinityTerm(
+                        topology_key=L.LABEL_HOSTNAME,
+                        label_selector=selector_for({"app": "worker"}),
+                    )]),
+                ),
+            )
+            for i in range(3)
+        ]
+        for res in solve_both([db] + followers):
+            assert res.all_pods_scheduled(), res.pod_errors
+            # workers separated by host, co-zoned with db
+            assert set(domain_counts(res, L.LABEL_TOPOLOGY_ZONE)) == {"zone-a"}
+
+    def test_pure_hostname_anti_classes_promoted_pack_denser(self):
+        """The promotion itself: a diverse mix where anti-h classes run
+        first must pack at least as tight as the greedy oracle."""
+        pods = []
+        for d in range(3):
+            for i in range(6):
+                pods.append(pod(
+                    f"a{d}-{i}", cpu=0.2, labels={"app": f"anti-{d}"},
+                    affinity=pod_anti_affinity({"app": f"anti-{d}"}),
+                ))
+        for i in range(12):
+            pods.append(pod(f"g{i}", cpu=1.5, labels={"app": "bulk"}))
+        rg, rd = solve_both(pods)
+        assert rg.all_pods_scheduled() and rd.all_pods_scheduled()
+        assert rd.node_count() <= rg.node_count()
