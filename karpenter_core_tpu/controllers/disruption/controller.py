@@ -99,6 +99,7 @@ class DisruptionController:
     # -- the 10s poll body (controller.go:104-197) -------------------------
 
     def reconcile(self) -> Optional[Command]:
+        self._untaint_outdated()
         self._reconcile_orchestration()
         # in-flight commands run CONCURRENTLY (orchestration/queue.go:108-141),
         # and so do pending validations: each command waits out its own 15s
@@ -113,6 +114,18 @@ class DisruptionController:
         busy = {
             c.name for p in self.pending for c in p.command.candidates
         }
+        # ONE budget mapping per poll, shared by every method and
+        # pre-charged with still-pending commands: concurrent pending
+        # validation would otherwise let each method (and each poll) spend
+        # the full budget again — marked_for_deletion only counts after
+        # execution (helpers.go:197-245 counts the disrupting state; the
+        # pending window is this design's addition, so it must consume too)
+        budgets = build_disruption_budget_mapping(
+            self.clock, self.cluster, self.kube
+        )
+        for p in self.pending:
+            for c in p.command.candidates:
+                budgets.consume(c.nodepool.name, p.method.reason)
         for method in self.methods:
             candidates = get_candidates(
                 self.clock,
@@ -127,9 +140,6 @@ class DisruptionController:
             )
             if not candidates:
                 continue
-            budgets = build_disruption_budget_mapping(
-                self.clock, self.cluster, self.kube
-            )
             command = method.compute_command(budgets, candidates)
             if command.decision == "no-op":
                 continue
@@ -192,6 +202,30 @@ class DisruptionController:
             executed.append(pending.command)
         self.pending = still_waiting
         return executed
+
+    def _untaint_outdated(self) -> None:
+        """Crash recovery (controller.go:127-141): nodes carrying the
+        disruption taint that belong to no active command — a restarted
+        operator has an empty in-flight list while the store still shows
+        taints from interrupted commands — get untainted so they rejoin
+        scheduling instead of staying cordoned forever."""
+        active = {
+            c.name
+            for cmd in self.in_flight
+            for c in cmd.command.candidates
+        } | {c.name for p in self.pending for c in p.command.candidates}
+        for node in self.kube.list_nodes():
+            if node.name in active:
+                continue
+            if node.metadata.deletion_timestamp is not None:
+                continue  # termination owns the taint during teardown
+            kept = [
+                t for t in node.taints
+                if t.key != DISRUPTED_NO_SCHEDULE_TAINT.key
+            ]
+            if len(kept) != len(node.taints):
+                node.taints = kept
+                self.kube.update(node)
 
     # -- execution (controller.go:203-247) ---------------------------------
 

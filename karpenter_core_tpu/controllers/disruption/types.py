@@ -66,6 +66,13 @@ def new_candidate(
         raise CandidateError("not initialized")
     if state_node.nominated(clock.now()):
         raise CandidateError("nominated for pods")
+    if (
+        state_node.node.metadata.annotations.get(
+            apilabels.DO_NOT_DISRUPT_ANNOTATION_KEY
+        )
+        == "true"
+    ):
+        raise CandidateError("node has do-not-disrupt annotation")
     pool = nodepools.get(state_node.nodepool_name)
     if pool is None:
         raise CandidateError(f"nodepool {state_node.nodepool_name!r} not found")

@@ -108,7 +108,9 @@ class Limits:
     def can_evict_pods(self, pods: List[Pod]) -> Optional[str]:
         """Error string naming the first fully-blocking PDB (pdb.go:56-89:
         every pod must be individually evictable; simultaneity is handled
-        by the eviction queue's retries)."""
+        by the eviction queue's retries). Non-evictable pods (mirror,
+        terminal) are skipped inside blocking_pdb, so a PDB matching only
+        them does not block (pdb.go:58-62)."""
         for pod in pods:
             key = self.blocking_pdb(pod)
             if key is not None:

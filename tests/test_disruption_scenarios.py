@@ -1,0 +1,453 @@
+"""Ported reference disruption scenario blocks: candidate gating, budget
+counting, disruption cost, taint hygiene.
+
+Re-expresses the candidate/budget/cost families of the reference's
+disruption suite (pkg/controllers/disruption/suite_test.go:654-1833 and
+types.go:71-117 gates, helpers.go:197-245 budget mapping,
+utils/disruption/disruption.go:37-79 costs) against the operator-driven
+stack: provision real nodes, mutate the state the gate reads, and assert
+whether `get_candidates` still yields them.
+"""
+import pytest
+
+from tests.helpers import make_nodepool, make_pod
+from tests.test_disruption import new_operator, provision, replicated
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.api.nodepool import Budget
+from karpenter_core_tpu.api.objects import Node, Pod
+from karpenter_core_tpu.controllers.disruption.helpers import (
+    build_disruption_budget_mapping,
+    get_candidates,
+)
+from karpenter_core_tpu.utils import disruption as disutil
+
+
+def candidates(op):
+    return get_candidates(
+        op.clock, op.cluster, op.kube, op.cloud_provider, lambda c: True
+    )
+
+
+def one_node_cluster(op=None):
+    op = op or new_operator()
+    provision(op, [make_pod(cpu=1.0, name="w0", labels={"app": "web"})])
+    assert len(op.kube.list_nodes()) == 1
+    return op
+
+
+class TestCandidateGating:
+    def test_healthy_node_is_a_candidate(self):
+        op = one_node_cluster()
+        assert len(candidates(op)) == 1
+
+    def test_do_not_disrupt_pod_blocks(self):
+        op = one_node_cluster()
+        pod = op.kube.get(Pod, "w0")
+        pod.metadata.annotations[L.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        op.kube.update(pod)
+        assert candidates(op) == []
+
+    def test_do_not_disrupt_daemonset_pod_blocks(self):
+        op = one_node_cluster()
+        node = op.kube.list_nodes()[0]
+        ds = make_pod(cpu=0.1, name="ds0")
+        ds.is_daemonset = True
+        ds.metadata.annotations[L.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        ds.node_name = node.name
+        ds.phase = "Running"
+        op.kube.create(ds)
+        op.reconcile_once(disrupt=False)
+        assert candidates(op) == []
+
+    def test_do_not_disrupt_mirror_pod_blocks(self):
+        op = one_node_cluster()
+        node = op.kube.list_nodes()[0]
+        mirror = make_pod(cpu=0.1, name="m0")
+        mirror.is_mirror = True
+        mirror.metadata.annotations[L.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        mirror.node_name = node.name
+        mirror.phase = "Running"
+        op.kube.create(mirror)
+        op.reconcile_once(disrupt=False)
+        assert candidates(op) == []
+
+    def test_do_not_disrupt_on_node_blocks(self):
+        # suite_test.go:1234 — the NODE-level annotation gates too
+        op = one_node_cluster()
+        node = op.kube.list_nodes()[0]
+        node.metadata.annotations[L.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        op.kube.update(node)
+        assert candidates(op) == []
+
+    def test_fully_blocking_pdb_blocks(self):
+        from tests.test_pdb import make_pdb
+
+        op = one_node_cluster()
+        op.kube.create(make_pdb(min_available=1, app="web"))
+        op.reconcile_once(disrupt=False)
+        assert candidates(op) == []
+
+    def test_pdb_on_mirror_pods_does_not_block(self):
+        # suite_test.go:1340 — mirror pods never hit the eviction API, so a
+        # PDB matching only them cannot gate the candidate
+        from tests.test_pdb import make_pdb
+
+        op = new_operator()
+        provision(op, [make_pod(cpu=1.0, name="w0")])
+        node = op.kube.list_nodes()[0]
+        mirror = make_pod(cpu=0.1, name="m0", labels={"app": "static"})
+        mirror.is_mirror = True
+        mirror.node_name = node.name
+        mirror.phase = "Running"
+        op.kube.create(mirror)
+        op.kube.create(make_pdb(min_available=1, app="static"))
+        op.reconcile_once(disrupt=False)
+        assert len(candidates(op)) == 1
+
+    def test_nominated_node_not_considered(self):
+        op = one_node_cluster()
+        sn = op.cluster.nodes()[0]
+        sn.nominate(op.clock.now() + 60.0)
+        assert candidates(op) == []
+
+    def test_marked_for_deletion_not_considered(self):
+        op = one_node_cluster()
+        sn = op.cluster.nodes()[0]
+        sn.marked_for_deletion = True
+        assert candidates(op) == []
+
+    def test_deleting_node_not_considered(self):
+        op = one_node_cluster()
+        node = op.kube.list_nodes()[0]
+        node.metadata.deletion_timestamp = op.clock.now()
+        op.kube.update(node)
+        assert candidates(op) == []
+
+    def test_unknown_nodepool_not_considered(self):
+        op = one_node_cluster()
+        node = op.kube.list_nodes()[0]
+        node.metadata.labels[L.NODEPOOL_LABEL_KEY] = "ghost-pool"
+        op.kube.update(node)
+        op.reconcile_once(disrupt=False)
+        assert candidates(op) == []
+
+    def test_unresolvable_instance_type_still_considered(self):
+        # suite_test.go:1750 — candidate survives with instance_type=None
+        op = one_node_cluster()
+        node = op.kube.list_nodes()[0]
+        node.metadata.labels[L.LABEL_INSTANCE_TYPE] = "retired-type"
+        op.kube.update(node)
+        op.reconcile_once(disrupt=False)
+        cands = candidates(op)
+        assert len(cands) == 1
+        assert cands[0].instance_type is None
+
+
+class TestBudgetCounting:
+    def _grow(self, op, n):
+        op.kube.create(make_nodepool())
+        for i in range(n):
+            op.kube.create(replicated(make_pod(cpu=9.0, name=f"b{i}")))
+        op.run_until_idle(disrupt=False)
+        assert len(op.kube.list_nodes()) == n
+
+    def test_percentage_budget_rounds_up_over_total(self):
+        op = new_operator()
+        self._grow(op, 3)
+        pool = op.kube.list_nodepools()[0]
+        pool.spec.disruption.budgets = [Budget(nodes="50%")]
+        mapping = build_disruption_budget_mapping(
+            op.clock, op.cluster, op.kube
+        )
+        assert mapping.remaining("default", "underutilized") == 2  # ceil(1.5)
+
+    def test_disrupting_nodes_consume_budget(self):
+        op = new_operator()
+        self._grow(op, 3)
+        pool = op.kube.list_nodepools()[0]
+        pool.spec.disruption.budgets = [Budget(nodes="2")]
+        op.cluster.nodes()[0].marked_for_deletion = True
+        mapping = build_disruption_budget_mapping(
+            op.clock, op.cluster, op.kube
+        )
+        assert mapping.remaining("default", "underutilized") == 1
+
+    def test_budget_never_negative(self):
+        op = new_operator()
+        self._grow(op, 2)
+        pool = op.kube.list_nodepools()[0]
+        pool.spec.disruption.budgets = [Budget(nodes="1")]
+        for sn in op.cluster.nodes():
+            sn.marked_for_deletion = True
+        mapping = build_disruption_budget_mapping(
+            op.clock, op.cluster, op.kube
+        )
+        assert mapping.remaining("default", "underutilized") == 0
+
+    def test_per_reason_budgets_are_separate(self):
+        op = new_operator()
+        self._grow(op, 4)
+        pool = op.kube.list_nodepools()[0]
+        pool.spec.disruption.budgets = [
+            Budget(nodes="1", reasons=["Drifted"]),
+            Budget(nodes="3", reasons=["Underutilized"]),
+        ]
+        mapping = build_disruption_budget_mapping(
+            op.clock, op.cluster, op.kube
+        )
+        assert mapping.remaining("default", "Drifted") == 1
+        assert mapping.remaining("default", "Underutilized") == 3
+
+    def test_uninitialized_nodes_not_in_total(self):
+        op = new_operator()
+        self._grow(op, 2)
+        # a managed claim that never initialized: its node joins the store
+        # but the Initialized condition stays unset
+        from karpenter_core_tpu.api.nodeclaim import NodeClaim
+        from karpenter_core_tpu.api.objects import ObjectMeta
+
+        claim = NodeClaim(metadata=ObjectMeta(
+            name="stray-claim", labels={L.NODEPOOL_LABEL_KEY: "default"}
+        ))
+        claim.status.provider_id = "stray-pid"
+        op.kube.create(claim)
+        op.kube.create(Node(
+            metadata=ObjectMeta(
+                name="stray", labels={L.NODEPOOL_LABEL_KEY: "default"}
+            ),
+            provider_id="stray-pid",
+        ))
+        op.cluster.sync()
+        pool = op.kube.list_nodepools()[0]
+        pool.spec.disruption.budgets = [Budget(nodes="50%")]
+        mapping = build_disruption_budget_mapping(
+            op.clock, op.cluster, op.kube
+        )
+        # ceil(0.5 x 2 initialized) = 1, the stray never counted
+        assert mapping.remaining("default", "underutilized") == 1
+
+
+class TestDisruptionCost:
+    def test_standard_cost(self):
+        assert disutil.eviction_cost(make_pod(cpu=1.0)) == 1.0
+
+    def test_deletion_cost_annotation_raises_cost(self):
+        lo = make_pod(cpu=1.0)
+        hi = make_pod(cpu=1.0)
+        hi.metadata.annotations[disutil.POD_DELETION_COST_ANNOTATION] = "10000"
+        assert disutil.eviction_cost(hi) > disutil.eviction_cost(lo)
+
+    def test_negative_deletion_cost_lowers(self):
+        lo = make_pod(cpu=1.0)
+        lo.metadata.annotations[disutil.POD_DELETION_COST_ANNOTATION] = "-10000"
+        assert disutil.eviction_cost(lo) < 1.0
+
+    def test_priority_raises_cost_and_clamps(self):
+        hi = make_pod(cpu=1.0)
+        hi.priority = 2**25  # one cost unit over base
+        assert disutil.eviction_cost(hi) == 2.0
+        vast = make_pod(cpu=1.0)
+        vast.priority = 10**10
+        assert disutil.eviction_cost(vast) == 10.0  # clamped (+-10)
+
+    def test_expiring_soon_costs_less(self):
+        from karpenter_core_tpu.api.duration import NillableDuration
+
+        op = one_node_cluster()
+        (cand,) = candidates(op)
+        baseline = cand.disruption_cost
+        claim = op.kube.list_nodeclaims()[0]
+        claim.spec.expire_after = NillableDuration(1000.0)
+        op.clock.step(900.0)  # 90% of lifetime burned
+        (aged,) = candidates(op)
+        assert aged.disruption_cost < baseline
+
+
+class TestTaintHygiene:
+    def test_stale_disruption_taint_removed_on_restart(self):
+        """controller.go:127-141: a taint from an interrupted command (the
+        restarted operator has no in-flight record of it) is removed."""
+        from karpenter_core_tpu.scheduling.taints import (
+            DISRUPTED_NO_SCHEDULE_TAINT,
+        )
+
+        op = one_node_cluster()
+        node = op.kube.list_nodes()[0]
+        node.taints.append(DISRUPTED_NO_SCHEDULE_TAINT)
+        op.kube.update(node)
+        assert not op.disruption.in_flight  # "restarted": no command memory
+        op.disruption.reconcile()
+        fresh = op.kube.get(Node, node.name)
+        assert all(
+            t.key != DISRUPTED_NO_SCHEDULE_TAINT.key for t in fresh.taints
+        )
+
+    def test_active_command_taint_survives(self):
+        from karpenter_core_tpu.scheduling.taints import (
+            DISRUPTED_NO_SCHEDULE_TAINT,
+        )
+
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=9.0, name="d0")))
+        op.run_until_idle(disrupt=False)
+        pool = op.kube.list_nodepools()[0]
+        pool.spec.template.labels["drifted"] = "yes"
+        op.kube.update(pool)
+        op.run_until_idle(disrupt=False)  # matures the Drifted condition
+        op.disruption.reconcile()  # drift command: taints + launches
+        assert op.disruption.in_flight
+        node = next(
+            n for n in op.kube.list_nodes()
+            if any(t.key == DISRUPTED_NO_SCHEDULE_TAINT.key for t in n.taints)
+        )
+        op.disruption.reconcile()  # next poll must NOT untaint it
+        fresh = op.kube.get(Node, node.name)
+        assert any(
+            t.key == DISRUPTED_NO_SCHEDULE_TAINT.key for t in fresh.taints
+        )
+
+
+class TestBudgetedConsolidation:
+    """consolidation_test.go:247-366 — budgets bound each decision type."""
+
+    def _empty_nodes(self, op, n, budget):
+        pool = make_nodepool()
+        pool.spec.disruption.budgets = [Budget(nodes=budget)]
+        op.kube.create(pool)
+        pods = [replicated(make_pod(cpu=9.0, name=f"e{i}")) for i in range(n)]
+        for p in pods:
+            op.kube.create(p)
+        op.run_until_idle(disrupt=False)
+        assert len(op.kube.list_nodes()) == n
+        for p in pods:
+            fresh = op.kube.get(Pod, p.name)
+            fresh.metadata.owner_references = []
+            op.kube.delete(fresh)
+        op.clock.step(40.0)  # matures Consolidatable
+
+    def test_empty_disruption_honors_node_budget(self):
+        op = new_operator()
+        self._empty_nodes(op, 5, budget="3")
+        op.disruption.reconcile()  # one emptiness command, budget-bounded
+        pending = op.disruption.pending
+        assert pending, "no emptiness command computed"
+        assert len(pending[0].command.candidates) == 3
+
+    def test_empty_disruption_budget_zero_blocks_all(self):
+        op = new_operator()
+        self._empty_nodes(op, 4, budget="0")
+        op.clock.step(100.0)
+        op.run_until_idle()
+        assert len(op.kube.list_nodes()) == 4  # nothing disrupted
+
+    def test_empty_disruption_full_budget_allows_all(self):
+        op = new_operator()
+        self._empty_nodes(op, 4, budget="100%")
+        op.run_until_idle()
+        assert len(op.kube.list_nodes()) == 0
+
+    def test_budgets_apply_per_nodepool(self):
+        # consolidation_test.go:414 — 2 from each pool
+        op = new_operator()
+        pods = []
+        for pool_name in ("alpha", "beta"):
+            pool = make_nodepool(pool_name)
+            pool.spec.disruption.budgets = [Budget(nodes="2")]
+            pool.spec.template.labels["pool"] = pool_name
+            op.kube.create(pool)
+            for i in range(3):
+                p = replicated(make_pod(
+                    cpu=9.0, name=f"{pool_name}{i}",
+                    node_selector={"pool": pool_name},
+                ))
+                pods.append(p)
+                op.kube.create(p)
+        op.run_until_idle(disrupt=False)
+        assert len(op.kube.list_nodes()) == 6
+        for p in pods:
+            fresh = op.kube.get(Pod, p.name)
+            fresh.metadata.owner_references = []
+            op.kube.delete(fresh)
+        op.clock.step(40.0)
+        op.disruption.reconcile()
+        pending = op.disruption.pending
+        assert pending
+        from collections import Counter
+
+        per_pool = Counter(
+            c.nodepool.name for p in pending for c in p.command.candidates
+        )
+        assert per_pool == {"alpha": 2, "beta": 2}
+
+    def test_budget_blocked_cluster_recovers_when_budget_opens(self):
+        # consolidation_test.go:608 family — a budget-starved cluster must
+        # keep polling and act the moment the budget allows
+        op = new_operator()
+        self._empty_nodes(op, 2, budget="0")
+        op.run_until_idle()
+        assert len(op.kube.list_nodes()) == 2  # starved
+        pool = op.kube.list_nodepools()[0]
+        pool.spec.disruption.budgets = [Budget(nodes="100%")]
+        op.kube.update(pool)
+        op.run_until_idle()
+        assert len(op.kube.list_nodes()) == 0
+
+
+class TestConsolidationEconomics:
+    def test_wont_replace_when_replacement_not_cheaper(self):
+        """consolidation_test.go:2048/2132 — a right-sized node stays."""
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        # fills its node well: replacement would be the same type
+        op.kube.create(replicated(make_pod(cpu=14.0, name="full")))
+        op.run_until_idle(disrupt=False)
+        nodes = len(op.kube.list_nodes())
+        op.clock.step(40.0)
+        op.run_until_idle()
+        assert len(op.kube.list_nodes()) == nodes
+        assert all(p.node_name for p in op.kube.list_pods())
+
+    def test_replaces_oversized_node_with_cheaper(self):
+        from karpenter_core_tpu.api.objects import NodeSelectorRequirement
+
+        op = new_operator()
+        # on-demand pool: a spot node would instead hit the spot-to-spot
+        # gate (disabled by default, consolidation.go:48-49)
+        op.kube.create(make_nodepool(requirements=[NodeSelectorRequirement(
+            L.CAPACITY_TYPE_LABEL_KEY, "In", (L.CAPACITY_TYPE_ON_DEMAND,))]))
+        big = replicated(make_pod(cpu=14.0, name="big"))
+        keeper = replicated(make_pod(cpu=0.4, name="keeper"))
+        op.kube.create(big)
+        op.kube.create(keeper)
+        op.run_until_idle(disrupt=False)
+        before = {n.name for n in op.kube.list_nodes()}
+        # the big pod leaves; its node is now oversized for the keeper
+        fresh = op.kube.get(Pod, "big")
+        fresh.metadata.owner_references = []
+        op.kube.delete(fresh)
+        op.clock.step(40.0)
+        op.run_until_idle()
+        after = op.kube.list_nodes()
+        assert all(p.node_name for p in op.kube.list_pods())
+        # consolidated: fewer nodes, or the remaining capacity shrank
+        total_cpu = sum(n.status.capacity.get("cpu", 0.0) for n in after)
+        assert total_cpu < 16.0 or {n.name for n in after} != before
+
+    def test_when_empty_policy_skips_underutilized(self):
+        op = new_operator()
+        pool = make_nodepool()
+        pool.spec.disruption.consolidation_policy = "WhenEmpty"
+        op.kube.create(pool)
+        op.kube.create(replicated(make_pod(cpu=9.0, name="big")))
+        op.kube.create(replicated(make_pod(cpu=0.3, name="small")))
+        op.run_until_idle(disrupt=False)
+        nodes = len(op.kube.list_nodes())
+        big = op.kube.get(Pod, "big")
+        big.metadata.owner_references = []
+        op.kube.delete(big)
+        op.clock.step(40.0)
+        op.run_until_idle()
+        # node is underutilized but NOT empty: WhenEmpty leaves it
+        assert len(op.kube.list_nodes()) == nodes

@@ -104,7 +104,13 @@ class TestValidationTTL:
         out their TTLs simultaneously — per-command clocks, not one pending
         slot serializing at a command per 15s."""
         op = new_operator()
-        op.kube.create(make_nodepool())
+        pool = make_nodepool()
+        # the default 10% budget allows only ONE concurrent disruption in a
+        # two-node cluster; widen it so concurrency is observable
+        from karpenter_core_tpu.api.nodepool import Budget
+
+        pool.spec.disruption.budgets = [Budget(nodes="100%")]
+        op.kube.create(pool)
         # two 12-cpu pods split across two 16-cpu nodes; the small pod
         # first-fits onto node1. Deleting the bigs leaves node1
         # underutilized (consolidation command) and node2 empty (emptiness
