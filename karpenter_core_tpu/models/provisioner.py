@@ -158,10 +158,14 @@ class _Prepared:
     class_requests: np.ndarray  # [C, R]
     classes: List[PodClass]
     templates: List[NodeClaimTemplate]
-    class_it: np.ndarray  # [C, T]
-    tmpl_ok: np.ndarray  # [C, S] compat+taints
-    new_template: np.ndarray  # [C]
-    kstar: np.ndarray  # [C]
+    # DEVICE-RESIDENT until the post-scan fetch (jax.Array at BUCKETED
+    # shapes): class_it [Cp, Tp], tmpl_ok [Cp, Sp], new_template/kstar [Cp]
+    # (ops/masks.fresh_viability outputs). _solve_once swaps class_it for
+    # the fetched numpy [Cp, T] right before decode — the only host reader.
+    class_it: object
+    tmpl_ok: object
+    new_template: object
+    kstar: object
     statics: FFDStatics
     init_state: SlotState
     exist_taint_ok: np.ndarray  # [C, N]
@@ -403,6 +407,9 @@ class DeviceScheduler:
             takes=takes,
             unplaced=unplaced,
             template=state.template,
+            # decode reads class_it host-side (_decode_composition); it
+            # rides the single post-scan fetch instead of its own sync
+            class_it=prep.class_it,
         )
         if plan.has_device_topology():
             fetch.update(
@@ -434,6 +441,7 @@ class DeviceScheduler:
             out["itmask"] = np.asarray(out["itmask"])[:, : sh["T"]]
             out["hcount"] = np.asarray(out["hcount"])[:, : sh["Gh"]]
             out["zcount"] = np.asarray(out["zcount"])[: sh["Gz"], : sh["V"]]
+        prep.class_it = np.asarray(out["class_it"])[:, : sh["T"]]
         with m.SOLVER_DECODE_DURATION.time():
             claims, existing_sims, failed = self._decode(prep, out)
 
@@ -743,22 +751,6 @@ class DeviceScheduler:
                 if z is not None and c_ is not None:
                     off_avail[ti, z, c_] = True
 
-        # fetch the device compat results dispatched before the host loops
-        class_it = (
-            np.asarray(class_it_dev)[:C]
-            if class_it_dev is not None
-            else np.zeros((C, T), dtype=bool)
-        )
-        if class_it.shape[1] < pad_T:
-            class_it = np.pad(
-                class_it, ((0, 0), (0, pad_T - class_it.shape[1]))
-            )
-        tmpl_compat = (
-            np.asarray(tmpl_compat_dev)[:C]
-            if tmpl_compat_dev is not None
-            else np.zeros((C, pad_S), dtype=bool)
-        )
-
         taint_ok = np.array(
             [
                 [_tolerates_taints(c.tolerations, t.taints) for t in self.templates]
@@ -766,7 +758,6 @@ class DeviceScheduler:
             ],
             dtype=bool,
         ) if C and S else np.zeros((C, pad_S), dtype=bool)
-        tmpl_ok = tmpl_compat & taint_ok
 
         # template-IT viability from the host prefilter (exact reference path)
         it_index = {id(it): i for i, it in enumerate(catalog)}
@@ -781,34 +772,6 @@ class DeviceScheduler:
             [rvec64q(o) for o in self.daemon_overhead]
         ) if S else np.zeros((pad_S, R), dtype=np.float64)
 
-        # fresh-node viability + kstar per class (first template wins)
-        new_template = np.full((C,), -1, dtype=np.int32)
-        kstar = np.zeros((C,), dtype=np.int32)
-        for ci in range(C):
-            zmask_c = class_masks.mask[ci, zone_kid, :Z]
-            ctmask_c = class_masks.mask[ci, ct_kid, :CT]
-            for si in range(S):
-                if not tmpl_ok[ci, si]:
-                    continue
-                viable = tmpl_it[si] & class_it[ci]
-                if not viable.any():
-                    continue
-                zmask = zmask_c & tmpl_masks.mask[si, zone_kid, :Z]
-                ctmask = ctmask_c & tmpl_masks.mask[si, ct_kid, :CT]
-                off_ok = (
-                    off_avail & zmask[None, :, None] & ctmask[None, None, :]
-                ).any(axis=(1, 2))
-                head = it_alloc - tmpl_overhead[si][None, :]
-                r = class_requests[ci]
-                with np.errstate(divide="ignore", invalid="ignore"):
-                    per_dim = np.where(r[None, :] > 0, head / np.where(r > 0, r, 1.0), np.inf)
-                # same exact quantized arithmetic as the device kernel
-                k_it = np.floor(per_dim.min(axis=1))
-                k_it = np.where(viable & off_ok, k_it, -1)
-                if k_it.max() >= 1:
-                    new_template[ci] = si
-                    kstar[ci] = int(k_it.max())
-                    break
 
         # initial slot state with existing nodes seeded in rows [0, E)
         N = max_slots
@@ -934,6 +897,51 @@ class DeviceScheduler:
             z_domains=jnp.asarray(_pad(plan.z_domains, {0: Gzp, 1: Vp}, False)),
             z_rank=jnp.asarray(_pad(plan.z_rank, {0: Gzp, 1: Vp}, RANK_NONE)),
         )
+
+        # Fresh-node viability + kstar per class, ON DEVICE (ops/masks
+        # fresh_viability) over the statics' BUCKETED arrays, so drifting
+        # template/catalog/resource counts reuse the jit entry like every
+        # other kernel: the compat results never detour through the host,
+        # and the solve's only device sync is the post-scan output fetch
+        # (class_it rides along in it for the decode). Dead-on equal to the
+        # retired host loop: same quantized float32 floor arithmetic,
+        # first-template-wins (pad rows carry tmpl_ok False and can never
+        # be chosen).
+        if C and S and T:
+            class_it_b = jnp.pad(
+                class_it_dev,
+                ((0, 0), (0, Tp - class_it_dev.shape[1])),
+            ) if class_it_dev.shape[1] < Tp else class_it_dev
+            tmpl_ok_b = jnp.asarray(
+                _pad(taint_ok, {0: Cp, 1: Sp}, False)
+            ) & jnp.pad(
+                tmpl_compat_dev,
+                ((0, 0), (0, Sp - tmpl_compat_dev.shape[1])),
+            )
+            new_template, kstar = mops.fresh_viability(
+                class_it_b,
+                tmpl_ok_b,
+                statics.tmpl_it,
+                jnp.asarray(cpad(class_masks.mask[:, zone_kid, :Z], False)),
+                jnp.asarray(cpad(class_masks.mask[:, ct_kid, :CT], False)),
+                jnp.asarray(
+                    _pad(tmpl_masks.mask[:, zone_kid, :Z], {0: Sp}, False)
+                ),
+                jnp.asarray(
+                    _pad(tmpl_masks.mask[:, ct_kid, :CT], {0: Sp}, False)
+                ),
+                statics.off_avail,
+                statics.it_alloc,
+                statics.tmpl_overhead,
+                jnp.asarray(cpad(_pad(class_requests, {1: Rp}, 0.0), 0.0)),
+            )
+            class_it = class_it_b  # [Cp, Tp] device-resident
+            tmpl_ok = tmpl_ok_b  # [Cp, Sp] device-resident
+        else:
+            class_it = jnp.zeros((Cp, Tp), dtype=bool)
+            tmpl_ok = jnp.zeros((Cp, Sp), dtype=bool)
+            new_template = jnp.full((Cp,), -1, dtype=jnp.int32)
+            kstar = jnp.zeros((Cp,), dtype=jnp.int32)
         # slot valmask pads True everywhere: defined keys re-acquire False
         # pad columns on first intersection with a (False-padded) class mask;
         # EXISTING slots' defined keys must pad False now or anti-affinity
@@ -1051,6 +1059,25 @@ class DeviceScheduler:
         def stepvec(values, dtype, fill):
             return _pad(np.array(values, dtype=dtype), {0: Jp}, fill)
 
+        # device-resident per-class arrays (class_it/tmpl_ok/new_template/
+        # kstar live on device, see _prepare_with_vocab): gather by padded
+        # step index, pad the natural T/S axes up to the statics' bucketed
+        # shapes, and neutralize the pad rows so inert steps stay inert
+        ci_padded = np.zeros((Jp,), dtype=np.int32)
+        ci_padded[:J] = cis
+        ci_j = jnp.asarray(ci_padded)
+        valid_j = jnp.asarray(np.arange(Jp) < J)
+        class_it_g = prep.class_it[ci_j]
+        if class_it_g.shape[1] < Tp:
+            class_it_g = jnp.pad(
+                class_it_g, ((0, 0), (0, Tp - class_it_g.shape[1]))
+            )
+        tmpl_ok_g = prep.tmpl_ok[ci_j]
+        if tmpl_ok_g.shape[1] < Sp:
+            tmpl_ok_g = jnp.pad(
+                tmpl_ok_g, ((0, 0), (0, Sp - tmpl_ok_g.shape[1]))
+            )
+
         mask = _pad(cm.mask[cis], {0: Jp, 1: Kp, 2: Vp}, False)
         defines = _pad(cm.defines[cis], {0: Jp, 1: Kp}, False)
         mask = np.where(defines[:, :, None], mask, True)  # neutral pads
@@ -1066,13 +1093,13 @@ class DeviceScheduler:
             requests=jnp.asarray(
                 _pad(prep.class_requests[cis], {0: Jp, 1: Rp}, 0.0)
             ),
-            class_it=jnp.asarray(_pad(prep.class_it[cis], {0: Jp, 1: Tp}, False)),
-            tmpl_ok=jnp.asarray(_pad(prep.tmpl_ok[cis], {0: Jp, 1: Sp}, False)),
+            class_it=jnp.where(valid_j[:, None], class_it_g, False),
+            tmpl_ok=jnp.where(valid_j[:, None], tmpl_ok_g, False),
             exist_taint_ok=jnp.asarray(
                 _pad(prep.exist_taint_ok[cis], {0: Jp}, False)
             ),
-            new_template=jnp.asarray(_pad(prep.new_template[cis], {0: Jp}, -1)),
-            kstar=jnp.asarray(_pad(prep.kstar[cis], {0: Jp}, 0)),
+            new_template=jnp.where(valid_j, prep.new_template[ci_j], -1),
+            kstar=jnp.where(valid_j, prep.kstar[ci_j], 0),
             smask=jnp.asarray(smask),
             h_sel=jnp.asarray(_pad(plan.h_sel[cis], {0: Jp, 1: Ghp}, False)),
             h_owner=jnp.asarray(_pad(plan.h_owner[cis], {0: Jp, 1: Ghp}, False)),

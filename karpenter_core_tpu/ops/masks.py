@@ -123,3 +123,65 @@ def fits(requests, allocatable):
         requests[:, None, :] <= allocatable[None, :, :], axis=-1
     )
     return ok & jnp.all(allocatable >= 0, axis=-1)[None, :]
+
+
+@jax.jit
+def fresh_viability(
+    class_it,  # [C, T] bool — class x instance-type compat (intersects)
+    tmpl_ok,  # [C, S] bool — class x template compat AND taint tolerance
+    tmpl_it,  # [S, T] bool — template's prefiltered instance types
+    class_zmask,  # [C, Z] bool — class allowed zones
+    class_ctmask,  # [C, CT] bool
+    tmpl_zmask,  # [S, Z] bool
+    tmpl_ctmask,  # [S, CT] bool
+    off_avail,  # [T, Z, CT] bool — offering availability lattice
+    it_alloc,  # [T, R] float32 (quantized integer units)
+    tmpl_overhead,  # [S, R] float32 — daemon overhead per template
+    class_requests,  # [C, R] float32
+):
+    """Per-class fresh-node viability: the first workable template and the
+    max pods per fresh node on its best instance type — the device twin of
+    the scheduler's template walk (scheduler.go:288-314 new-claim path +
+    nodeclaimtemplate prefilter). Returns (new_template [C] int32, -1 when
+    no template works; kstar [C] int32). Runs fully on device so the solve
+    needs no host round-trip between the compat kernels and the FFD scan;
+    the floor arithmetic matches ops/ffd._k_max exactly (integer-quantized
+    float32, margin-free)."""
+    # Memory discipline: every intermediate stays O(C*S*T) — the offering
+    # lattice contracts through a flattened [T, Z*CT] axis and the resource
+    # minimum unrolls over the (small, static) R axis, so large class
+    # counts never materialize a [C,S,T,Z] or [C,S,T,R] tensor.
+    T = off_avail.shape[0]
+    viable = tmpl_it[None, :, :] & class_it[:, None, :]  # [C, S, T]
+    zjoin = class_zmask[:, None, :] & tmpl_zmask[None, :, :]  # [C, S, Z]
+    ctjoin = class_ctmask[:, None, :] & tmpl_ctmask[None, :, :]  # [C, S, CT]
+    joined = (
+        zjoin[:, :, :, None] & ctjoin[:, :, None, :]
+    ).astype(jnp.float32)  # [C, S, Z, CT] (Z/CT are tiny)
+    off_flat = off_avail.astype(jnp.float32).reshape(T, -1)  # [T, Z*CT]
+    off_ok = jnp.einsum(
+        "tm,csm->cst", off_flat, joined.reshape(*joined.shape[:2], -1)
+    ) > 0
+    head = it_alloc[None, :, :] - tmpl_overhead[:, None, :]  # [S, T, R]
+    r = class_requests  # [C, R]
+    safe_r = jnp.where(r > 0, r, 1.0)
+    k_min = jnp.full(
+        (r.shape[0],) + head.shape[:2], jnp.inf, dtype=jnp.float32
+    )  # [C, S, T]
+    for ri in range(r.shape[1]):  # static unroll, R is small
+        ratio_r = head[None, :, :, ri] / safe_r[:, None, None, ri]
+        ratio_r = jnp.where(r[:, None, None, ri] > 0, ratio_r, jnp.inf)
+        k_min = jnp.minimum(k_min, ratio_r)
+    k_it = jnp.floor(k_min)  # [C, S, T]
+    ok = viable & off_ok & tmpl_ok[:, :, None]
+    k_s = jnp.max(jnp.where(ok, k_it, -1.0), axis=-1)  # [C, S]
+    has = k_s >= 1.0
+    any_has = jnp.any(has, axis=1)
+    first_s = jnp.argmax(has, axis=1).astype(jnp.int32)
+    new_template = jnp.where(any_has, first_s, -1)
+    kstar = jnp.where(
+        any_has,
+        jnp.take_along_axis(k_s, first_s[:, None], axis=1)[:, 0],
+        0.0,
+    )
+    return new_template, jnp.clip(kstar, 0, 2**30).astype(jnp.int32)
