@@ -18,8 +18,10 @@ from karpenter_core_tpu.api.nodeclaim import (
 from karpenter_core_tpu.api.objects import Node
 from karpenter_core_tpu.cloudprovider.types import (
     CloudProviderError,
+    CreateError,
     InsufficientCapacityError,
     NodeClaimNotFoundError,
+    NodeClassNotReadyError,
 )
 from karpenter_core_tpu.scheduling import Requirements
 from karpenter_core_tpu.scheduling.taints import UNREGISTERED_NO_EXECUTE_TAINT
@@ -41,6 +43,14 @@ class NodeClaimLifecycle:
         if apilabels.TERMINATION_FINALIZER not in claim.metadata.finalizers:
             claim.metadata.finalizers.append(apilabels.TERMINATION_FINALIZER)
             self.kube.update(claim)
+        # liveness backstop (liveness.go:41): a claim not Registered within
+        # the TTL is reaped REGARDLESS of launch state — a permanently
+        # failing launch (CreateError each pass) must not retry forever
+        if not claim.is_registered() and self.clock.since(
+            claim.metadata.creation_timestamp
+        ) > REGISTRATION_TTL:
+            self.kube.delete(claim)
+            return
         if not claim.is_launched():
             self._launch(claim)
         if claim.is_launched() and not claim.is_registered():
@@ -54,10 +64,24 @@ class NodeClaimLifecycle:
         user_labels = dict(claim.metadata.labels)
         try:
             self.cloud_provider.create(claim)
-        except InsufficientCapacityError:
-            # terminal for this claim: delete so the provisioner retries
-            # with the offering marked unavailable (launch.go error path)
+        except (InsufficientCapacityError, NodeClassNotReadyError):
+            # terminal for this claim: delete so the provisioner retries —
+            # insufficient capacity with the offering marked unavailable,
+            # NodeClassNotReady against a (possibly fixed) class
+            # (launch.go terminal-error paths)
             self.kube.delete(claim)
+            return
+        except CreateError as e:
+            # non-terminal: surface the provider's typed condition so the
+            # failure is visible while retries continue (launch.go sets
+            # Launched=False from the CreateError's reason/message)
+            claim.conditions.set_false(
+                COND_LAUNCHED,
+                e.condition_reason or "LaunchFailed",
+                message=e.condition_message or str(e),
+                now=self.clock.now(),
+            )
+            self.kube.update(claim)
             return
         except CloudProviderError:
             return  # retried next reconcile
@@ -78,10 +102,7 @@ class NodeClaimLifecycle:
     def _register(self, claim: NodeClaim) -> None:
         node = self.kube.get_node_by_provider_id(claim.status.provider_id)
         if node is None:
-            # liveness: claims whose machine never joined are reaped
-            if self.clock.since(claim.metadata.creation_timestamp) > REGISTRATION_TTL:
-                self.kube.delete(claim)
-            return
+            return  # liveness reap lives in reconcile()'s TTL backstop
         node.taints = [
             t
             for t in node.taints
@@ -130,10 +151,16 @@ class NodeClaimLifecycle:
     def _finalize(self, claim: NodeClaim) -> None:
         if apilabels.TERMINATION_FINALIZER not in claim.metadata.finalizers:
             return
-        try:
-            self.cloud_provider.delete(claim)
-        except NodeClaimNotFoundError:
-            pass  # instance already gone
+        # no instance to delete when none was ever created — keyed on
+        # provider_id, NOT the Launched condition: a provider can create
+        # the instance and record its id, then fail before the condition
+        # lands (lifecycle/controller.go keys the skip on an empty
+        # ProviderID; gc.py's leak sweep uses the same signal)
+        if claim.status.provider_id:
+            try:
+                self.cloud_provider.delete(claim)
+            except NodeClaimNotFoundError:
+                pass  # instance already gone
         claim.conditions.set_true(COND_INSTANCE_TERMINATING, "Terminating", now=self.clock.now())
         claim.metadata.finalizers.remove(apilabels.TERMINATION_FINALIZER)
         self.kube.update(claim)

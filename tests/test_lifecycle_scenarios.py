@@ -1,0 +1,274 @@
+"""Ported NodeClaim lifecycle scenario blocks
+(reference: pkg/controllers/nodeclaim/lifecycle/{launch,registration,
+initialization,liveness,termination}_test.go families): launch error
+taxonomy, registration taint/label sync, initialization gating on
+readiness/startup taints/resources, the registration-liveness TTL, and
+finalizer semantics for unlaunched claims.
+"""
+import pytest
+
+from tests.helpers import make_nodepool, make_pod
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.api.nodeclaim import NodeClaim
+from karpenter_core_tpu.api.objects import Node, NodeStatus, ObjectMeta, Taint
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_core_tpu.cloudprovider.types import (
+    CreateError,
+    InsufficientCapacityError,
+    NodeClassNotReadyError,
+)
+from karpenter_core_tpu.controllers.nodeclaim.lifecycle import (
+    REGISTRATION_TTL,
+    NodeClaimLifecycle,
+)
+from karpenter_core_tpu.kube.store import KubeStore
+from karpenter_core_tpu.scheduling.taints import UNREGISTERED_NO_EXECUTE_TAINT
+from karpenter_core_tpu.state.cluster import Cluster
+from karpenter_core_tpu.utils.clock import FakeClock
+
+
+def harness():
+    clock = FakeClock()
+    kube = KubeStore(clock)
+    provider = FakeCloudProvider()
+    cluster = Cluster(kube, clock)
+    return NodeClaimLifecycle(kube, cluster, provider, clock), kube, provider, clock
+
+
+def make_claim(kube, name="c1", labels=None):
+    claim = NodeClaim(metadata=ObjectMeta(
+        name=name, labels={L.NODEPOOL_LABEL_KEY: "default",
+                           **(labels or {})},
+    ))
+    kube.create(claim)
+    return claim
+
+
+def join_node(kube, claim, ready=True, allocatable=None, taints=()):
+    """The machine comes online: a Node with the claim's provider id and
+    the unregistered taint (what kwok/a real bootstrap produces)."""
+    node = Node(
+        metadata=ObjectMeta(name=f"node-{claim.name}"),
+        provider_id=claim.status.provider_id,
+        taints=[UNREGISTERED_NO_EXECUTE_TAINT] + list(taints),
+        status=NodeStatus(
+            capacity={"cpu": 4.0},
+            allocatable=dict(
+                {"cpu": 3.5} if allocatable is None else allocatable
+            ),
+            conditions=[("Ready", "True" if ready else "False")],
+        ),
+    )
+    kube.create(node)
+    return node
+
+
+class TestLaunch:
+    def test_launched_condition_set_after_create(self):
+        lc, kube, provider, clock = harness()
+        claim = make_claim(kube)
+        lc.reconcile(claim)
+        assert claim.status.provider_id
+        assert provider.create_calls
+
+    def test_insufficient_capacity_deletes_claim(self):
+        lc, kube, provider, clock = harness()
+        claim = make_claim(kube)
+        provider.next_create_error = InsufficientCapacityError("no spot")
+        lc.reconcile(claim)  # terminal: delete (held by the finalizer)
+        lc.reconcile(claim)  # finalize pass releases it
+        assert kube.get(NodeClaim, claim.name) is None
+
+    def test_node_class_not_ready_deletes_claim(self):
+        lc, kube, provider, clock = harness()
+        claim = make_claim(kube)
+        provider.next_create_error = NodeClassNotReadyError("class pending")
+        lc.reconcile(claim)
+        lc.reconcile(claim)  # finalize pass
+        assert kube.get(NodeClaim, claim.name) is None
+
+    def test_create_error_sets_condition_and_retries(self):
+        lc, kube, provider, clock = harness()
+        claim = make_claim(kube)
+        provider.next_create_error = CreateError("quota exceeded")
+        lc.reconcile(claim)
+        held = kube.get(NodeClaim, claim.name)
+        assert held is not None  # not terminal
+        cond = held.conditions.get("Launched")
+        assert cond is not None and cond.status == "False"
+        assert "quota exceeded" in cond.message
+        lc.reconcile(held)  # provider recovered: launch proceeds
+        assert held.is_launched()
+
+    def test_finalizer_added_on_first_reconcile(self):
+        lc, kube, provider, clock = harness()
+        claim = make_claim(kube)
+        lc.reconcile(claim)
+        assert L.TERMINATION_FINALIZER in claim.metadata.finalizers
+
+
+class TestRegistration:
+    def test_unregistered_taint_removed_and_labels_synced(self):
+        lc, kube, provider, clock = harness()
+        claim = make_claim(kube, labels={"team": "infra"})
+        claim.spec.taints = [Taint(key="workload", value="gpu",
+                                   effect="NoSchedule")]
+        lc.reconcile(claim)
+        node = join_node(kube, claim)
+        lc.reconcile(claim)
+        assert claim.is_registered()
+        assert all(
+            t.key != UNREGISTERED_NO_EXECUTE_TAINT.key for t in node.taints
+        )
+        assert node.metadata.labels[L.NODE_REGISTERED_LABEL_KEY] == "true"
+        assert node.metadata.labels["team"] == "infra"
+        assert any(t.key == "workload" for t in node.taints)
+        assert L.TERMINATION_FINALIZER in node.metadata.finalizers
+
+    def test_startup_taints_synced_once(self):
+        lc, kube, provider, clock = harness()
+        claim = make_claim(kube)
+        claim.spec.startup_taints = [Taint(key="boot", value="",
+                                           effect="NoSchedule")]
+        lc.reconcile(claim)
+        node = join_node(kube, claim)
+        lc.reconcile(claim)
+        assert any(t.key == "boot" for t in node.taints)
+        # the kubelet clears the startup taint; registration must NOT
+        # re-add it (claim already registered)
+        node.taints = [t for t in node.taints if t.key != "boot"]
+        kube.update(node)
+        lc.reconcile(claim)
+        assert all(t.key != "boot" for t in node.taints)
+
+
+class TestInitialization:
+    def _registered(self, ready=True, allocatable=None, startup=()):
+        lc, kube, provider, clock = harness()
+        claim = make_claim(kube)
+        claim.spec.startup_taints = list(startup)
+        lc.reconcile(claim)
+        node = join_node(kube, claim, ready=ready, allocatable=allocatable)
+        lc.reconcile(claim)
+        assert claim.is_registered()
+        return lc, kube, claim, node
+
+    def test_not_initialized_while_not_ready(self):
+        lc, kube, claim, node = self._registered(ready=False)
+        lc.reconcile(claim)
+        assert not claim.is_initialized()
+
+    def test_not_initialized_without_registered_resources(self):
+        lc, kube, claim, node = self._registered(allocatable={})
+        lc.reconcile(claim)
+        assert not claim.is_initialized()
+
+    def test_not_initialized_until_startup_taints_clear(self):
+        startup = [Taint(key="boot", value="", effect="NoSchedule")]
+        lc, kube, claim, node = self._registered(startup=startup)
+        lc.reconcile(claim)
+        assert not claim.is_initialized()
+        node.taints = [t for t in node.taints if t.key != "boot"]
+        kube.update(node)
+        lc.reconcile(claim)
+        assert claim.is_initialized()
+        assert node.metadata.labels[L.NODE_INITIALIZED_LABEL_KEY] == "true"
+
+    def test_initializes_when_all_gates_pass(self):
+        lc, kube, claim, node = self._registered()
+        lc.reconcile(claim)
+        assert claim.is_initialized()
+
+
+class TestLiveness:
+    def test_unregistered_claim_reaped_after_ttl(self):
+        lc, kube, provider, clock = harness()
+        claim = make_claim(kube)
+        lc.reconcile(claim)  # launched, but no node ever joins
+        clock.step(REGISTRATION_TTL + 1.0)
+        lc.reconcile(claim)
+        lc.reconcile(claim)  # finalize pass
+        assert kube.get(NodeClaim, claim.name) is None
+
+    def test_registered_claim_survives_ttl(self):
+        lc, kube, provider, clock = harness()
+        claim = make_claim(kube)
+        lc.reconcile(claim)
+        join_node(kube, claim)
+        lc.reconcile(claim)
+        clock.step(REGISTRATION_TTL + 1.0)
+        lc.reconcile(claim)
+        assert kube.get(NodeClaim, claim.name) is not None
+
+    def test_claim_within_ttl_keeps_waiting(self):
+        lc, kube, provider, clock = harness()
+        claim = make_claim(kube)
+        lc.reconcile(claim)
+        clock.step(REGISTRATION_TTL / 2)
+        lc.reconcile(claim)
+        assert kube.get(NodeClaim, claim.name) is not None
+
+
+class TestFinalize:
+    def test_unlaunched_claim_skips_provider_delete(self):
+        lc, kube, provider, clock = harness()
+        claim = make_claim(kube)
+        claim.metadata.finalizers.append(L.TERMINATION_FINALIZER)
+        kube.update(claim)
+        kube.delete(claim)  # sets deletion timestamp (finalizer held)
+        lc.reconcile(claim)
+        assert provider.delete_calls == []
+        assert kube.get(NodeClaim, claim.name) is None
+
+    def test_launched_claim_deletes_instance(self):
+        lc, kube, provider, clock = harness()
+        claim = make_claim(kube)
+        lc.reconcile(claim)
+        kube.delete(claim)
+        lc.reconcile(claim)
+        assert len(provider.delete_calls) == 1
+        assert kube.get(NodeClaim, claim.name) is None
+
+
+class TestLivenessBackstop:
+    def test_perpetually_failing_launch_reaped_after_ttl(self):
+        """A launch that fails with CreateError on every pass must not
+        retry forever: the TTL backstop reaps the never-registered claim
+        (liveness.go:41 keys on Registered, not Launched)."""
+        lc, kube, provider, clock = harness()
+        claim = make_claim(kube)
+        for _ in range(3):
+            provider.next_create_error = CreateError("quota exceeded")
+            lc.reconcile(claim)
+        assert kube.get(NodeClaim, claim.name) is not None
+        clock.step(REGISTRATION_TTL + 1.0)
+        provider.next_create_error = CreateError("quota exceeded")
+        lc.reconcile(claim)
+        lc.reconcile(claim)  # finalize pass
+        assert kube.get(NodeClaim, claim.name) is None
+
+    def test_typed_create_error_condition_fields_used(self):
+        lc, kube, provider, clock = harness()
+        claim = make_claim(kube)
+        provider.next_create_error = CreateError(
+            "api timeout", condition_reason="ImageNotReady",
+            condition_message="AMI still pending",
+        )
+        lc.reconcile(claim)
+        cond = claim.conditions.get("Launched")
+        assert cond.reason == "ImageNotReady"
+        assert cond.message == "AMI still pending"
+
+    def test_instance_created_before_condition_is_still_deleted(self):
+        """Provider wrote provider_id but the Launched condition never
+        landed: finalize must still delete the instance (keyed on
+        provider_id, not the condition)."""
+        lc, kube, provider, clock = harness()
+        claim = make_claim(kube)
+        claim.metadata.finalizers.append(L.TERMINATION_FINALIZER)
+        claim.status.provider_id = "fake-instance-1"
+        kube.update(claim)
+        kube.delete(claim)
+        lc.reconcile(claim)
+        assert len(provider.delete_calls) == 1
