@@ -33,6 +33,9 @@ CAPACITY_TYPE_LABEL_KEY = f"{GROUP}/capacity-type"
 DO_NOT_DISRUPT_ANNOTATION_KEY = f"{GROUP}/do-not-disrupt"
 NODEPOOL_HASH_ANNOTATION_KEY = f"{GROUP}/nodepool-hash"
 NODEPOOL_HASH_VERSION_ANNOTATION_KEY = f"{GROUP}/nodepool-hash-version"
+# bumped whenever static_hash()'s algorithm/fields change; drift compares
+# hashes only when versions match (hash/controller.go migration)
+HASH_VERSION = "v3"
 NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY = (
     f"{GROUP}/nodeclaim-termination-timestamp"
 )

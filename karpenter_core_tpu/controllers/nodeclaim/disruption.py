@@ -67,11 +67,28 @@ class NodeClaimDisruption:
             claim.conditions.clear(COND_DRIFTED)
 
     def _drift_reason(self, pool: NodePool, claim: NodeClaim) -> Optional[str]:
-        # static hash drift (drift.go areStaticFieldsDrifted)
+        # static hash drift (drift.go areStaticFieldsDrifted): annotation vs
+        # annotation, gated — missing hash on either side or a hash-VERSION
+        # mismatch is NOT drift (the hash controller migrates versions by
+        # re-stamping claims, hash/controller.go:70-124)
+        pool_hash = pool.metadata.annotations.get(
+            apilabels.NODEPOOL_HASH_ANNOTATION_KEY
+        )
         claim_hash = claim.metadata.annotations.get(
             apilabels.NODEPOOL_HASH_ANNOTATION_KEY
         )
-        if claim_hash is not None and claim_hash != pool.static_hash():
+        pool_ver = pool.metadata.annotations.get(
+            apilabels.NODEPOOL_HASH_VERSION_ANNOTATION_KEY
+        )
+        claim_ver = claim.metadata.annotations.get(
+            apilabels.NODEPOOL_HASH_VERSION_ANNOTATION_KEY
+        )
+        if (
+            pool_hash is not None
+            and claim_hash is not None
+            and pool_ver == claim_ver
+            and claim_hash != pool_hash
+        ):
             return DRIFT_REASON_NODEPOOL_STATIC
         # requirements drift: the claim's committed labels must still satisfy
         # the pool's requirements (drift.go:144-154 uses Compatible, whose
@@ -83,13 +100,22 @@ class NodeClaimDisruption:
         claim_labels = Requirements.from_labels(claim.metadata.labels)
         if claim_labels.compatible(pool_reqs):
             return DRIFT_REASON_REQUIREMENTS
-        # instance type vanished from the provider catalog
+        # stale instance type: vanished from the catalog, or none of its
+        # remaining offerings is available+compatible with the claim's
+        # committed zone/capacity-type (drift.go instanceTypeNotFound family)
         it_name = claim.metadata.labels.get(apilabels.LABEL_INSTANCE_TYPE)
         if it_name is not None:
-            names = {
-                it.name for it in self.cloud_provider.get_instance_types(pool)
-            }
-            if it_name not in names:
+            it = next(
+                (
+                    i
+                    for i in self.cloud_provider.get_instance_types(pool)
+                    if i.name == it_name
+                ),
+                None,
+            )
+            if it is None:
+                return DRIFT_REASON_IT_GONE
+            if not it.offerings.available().has_compatible(claim_labels):
                 return DRIFT_REASON_IT_GONE
         return self.cloud_provider.is_drifted(claim) or None
 

@@ -5,6 +5,7 @@ controller.go).
 from __future__ import annotations
 
 from karpenter_core_tpu.api import labels as apilabels
+from karpenter_core_tpu.api.labels import HASH_VERSION
 from karpenter_core_tpu.api.nodepool import (
     COND_NODEPOOL_NODECLASS_READY,
     COND_NODEPOOL_VALIDATION_SUCCEEDED,
@@ -12,7 +13,6 @@ from karpenter_core_tpu.api.nodepool import (
 )
 from karpenter_core_tpu.utils import resources as resutil
 
-HASH_VERSION = "v3"
 
 
 class Counter:
@@ -50,8 +50,12 @@ class Hash:
             return
         if stale_version:
             # hash-version migration: re-stamp claims so a mechanical hash
-            # change isn't read as drift (hash/controller.go:70-124)
+            # change isn't read as drift — but NOT claims already marked
+            # Drifted, whose condition reflects a real config difference the
+            # re-stamp would erase (hash/controller.go:70-124 skips them)
             for claim in self.kube.list_nodeclaims():
+                if claim.conditions.is_true("Drifted"):
+                    continue
                 if claim.nodepool_name == pool.name:
                     claim.metadata.annotations[
                         apilabels.NODEPOOL_HASH_ANNOTATION_KEY

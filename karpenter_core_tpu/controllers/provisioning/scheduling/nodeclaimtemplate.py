@@ -47,6 +47,12 @@ class NodeClaimTemplate:
         labels[apilabels.NODEPOOL_LABEL_KEY] = nodepool.name
         annotations = dict(tmpl.annotations)
         annotations[apilabels.NODEPOOL_HASH_ANNOTATION_KEY] = nodepool.static_hash()
+        # version travels with the hash so drift's annotation-vs-annotation
+        # compare is gated on matching hash algorithms
+        # (nodeclaimtemplate.go stamps both; hash/controller.go migrates)
+        annotations[apilabels.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = (
+            apilabels.HASH_VERSION
+        )
         requirements = Requirements()
         requirements.add(
             *Requirements.from_node_selector_requirements_with_min_values(

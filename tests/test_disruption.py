@@ -227,6 +227,9 @@ class TestDrift:
         pool = op.kube.list_nodepools()[0]
         pool.spec.template.labels["fleet"] = "v2"
         op.kube.update(pool)
+        # drift reads the hash ANNOTATIONS (drift.go areStaticFieldsDrifted);
+        # the hash controller refreshes the pool's annotation first
+        op.nodepool_hash.reconcile(pool)
         (claim,) = op.kube.list_nodeclaims()
         op.nodeclaim_disruption.reconcile(claim)
         assert claim.conditions.is_true(COND_DRIFTED)
