@@ -451,3 +451,102 @@ class TestConsolidationEconomics:
         op.run_until_idle()
         # node is underutilized but NOT empty: WhenEmpty leaves it
         assert len(op.kube.list_nodes()) == nodes
+
+
+class TestEmptiness:
+    """Ported emptiness family (emptiness_test.go): what counts as empty,
+    the consolidatable gate, and the TTL wait."""
+
+    def _emptyable(self, op=None, consolidate_after=0.0):
+        from karpenter_core_tpu.api.duration import NillableDuration
+
+        op = op or new_operator()
+        pool = make_nodepool()
+        pool.spec.disruption.consolidate_after = NillableDuration(
+            consolidate_after
+        )
+        op.kube.create(pool)
+        p = replicated(make_pod(cpu=1.0, name="only"))
+        op.kube.create(p)
+        op.run_until_idle(disrupt=False)
+        assert len(op.kube.list_nodes()) == 1
+        fresh = op.kube.get(Pod, "only")
+        fresh.metadata.owner_references = []
+        op.kube.delete(fresh)
+        return op
+
+    def test_deletes_empty_node(self):
+        op = self._emptyable()
+        op.clock.step(40.0)
+        op.run_until_idle()
+        assert op.kube.list_nodes() == []
+        assert op.kube.list_nodeclaims() == []
+
+    def test_node_with_pods_is_not_empty(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=1.0, name="keeper")))
+        op.run_until_idle(disrupt=False)
+        op.clock.step(40.0)
+        op.run_until_idle()
+        # the keeper pod's node survives (single node: consolidation has
+        # nowhere cheaper either)
+        assert len(op.kube.list_nodes()) == 1
+
+    def test_daemonset_only_node_is_empty(self):
+        op = self._emptyable()
+        node = op.kube.list_nodes()[0]
+        ds = make_pod(cpu=0.1, name="ds0")
+        ds.is_daemonset = True
+        ds.node_name = node.name
+        ds.phase = "Running"
+        op.kube.create(ds)
+        op.clock.step(40.0)
+        op.run_until_idle()
+        assert op.kube.list_nodes() == []
+
+    def test_waits_for_consolidate_after_ttl(self):
+        op = self._emptyable(consolidate_after=600.0)
+        op.clock.step(40.0)
+        for _ in range(5):
+            op.reconcile_once()
+        assert len(op.kube.list_nodes()) == 1  # inside the window
+        op.clock.step(600.0)
+        op.run_until_idle()
+        assert op.kube.list_nodes() == []
+
+    def test_consolidate_after_never_blocks_emptiness(self):
+        from karpenter_core_tpu.api.duration import NillableDuration
+
+        op = new_operator()
+        pool = make_nodepool()
+        pool.spec.disruption.consolidate_after = NillableDuration(None)
+        op.kube.create(pool)
+        p = replicated(make_pod(cpu=1.0, name="only"))
+        op.kube.create(p)
+        op.run_until_idle(disrupt=False)
+        fresh = op.kube.get(Pod, "only")
+        fresh.metadata.owner_references = []
+        op.kube.delete(fresh)
+        op.clock.step(3600.0)
+        op.run_until_idle()
+        assert len(op.kube.list_nodes()) == 1  # Never: no consolidation
+
+    def test_do_not_disrupt_node_annotation_blocks_emptiness(self):
+        op = self._emptyable()
+        node = op.kube.list_nodes()[0]
+        node.metadata.annotations[L.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+        op.kube.update(node)
+        op.clock.step(40.0)
+        op.run_until_idle()
+        assert len(op.kube.list_nodes()) == 1
+
+    def test_pending_pods_reuse_empty_node_instead_of_new(self):
+        # "considers pending pods when consolidating": a pending pod that
+        # fits the empty node keeps it alive (nominated) rather than
+        # deleting + relaunching
+        op = self._emptyable()
+        op.kube.create(replicated(make_pod(cpu=1.0, name="reuser")))
+        op.run_until_idle()
+        assert len(op.kube.list_nodes()) == 1
+        assert op.kube.get(Pod, "reuser").node_name
