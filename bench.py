@@ -419,6 +419,76 @@ def _consolidation_bench(n_nodes=2000, n_candidates=100, repeats=3):
     }
 
 
+def _sidecar_bench(n_pods=5000, n_types=400, repeats=5):
+    """solverd RPC overhead: the same solve through the in-proc
+    DeviceScheduler and through a sidecar (in-thread server — the codec,
+    HTTP framing, and result rematerialization are the costs under test;
+    process hop adds scheduler noise, not work). Reported per phase from
+    the client's RPC histograms so encode/transit/kernel/decode drift is
+    visible across rounds."""
+    from karpenter_core_tpu.metrics import wiring as m
+    from karpenter_core_tpu.models.provisioner import DeviceScheduler
+    from karpenter_core_tpu.cloudprovider.kwok import bench_catalog
+    from karpenter_core_tpu.solver import remote, service
+
+    pods = _plain_pods(n_pods)
+    catalog = bench_catalog(n_types)
+    pools = [_pool()]
+    its = {"default": list(catalog)}
+
+    sched = DeviceScheduler(pools, dict(its), max_slots=1024)
+    inproc_times = []
+    sched.solve(pods)  # shared warm-up (jit cache is process-global)
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        res = sched.solve(pods)
+        inproc_times.append(time.perf_counter() - t0)
+    assert res.all_pods_scheduled()
+    inproc_nodes = res.node_count()
+
+    srv = service.serve(0)
+    try:
+        client = remote.SolverClient(
+            f"127.0.0.1:{srv.server_address[1]}", timeout=600
+        )
+        rs = remote.RemoteScheduler(
+            client, pools, dict(its),
+            device_scheduler_opts={"max_slots": 1024},
+        )
+        rpc_times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            res = rs.solve(pods)
+            rpc_times.append(time.perf_counter() - t0)
+        assert res.all_pods_scheduled()
+        # mode parity: the sidecar is the SAME solver behind a wire — any
+        # node-count delta vs in-proc means the codec/rebind leaked
+        assert res.node_count() == inproc_nodes, (
+            res.node_count(), inproc_nodes,
+        )
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+    p50_in = sorted(inproc_times)[len(inproc_times) // 2]
+    p50_rpc = sorted(rpc_times)[len(rpc_times) // 2]
+    phases = {}
+    h = m.SOLVER_RPC_PHASE_DURATION
+    for phase in ("encode", "transit", "kernel", "decode"):
+        k = (("phase", phase),)
+        total, n = h.sums.get(k, 0.0), h.totals.get(k, 0)
+        phases[f"mean_{phase}_s"] = round(total / n, 3) if n else None
+    return {
+        "pods": n_pods,
+        "p50_inproc_s": round(p50_in, 3),
+        "p50_sidecar_s": round(p50_rpc, 3),
+        "rpc_overhead_s": round(p50_rpc - p50_in, 3),
+        "nodes": inproc_nodes,
+        "mode_parity_nodes_delta": 0,  # asserted equal above
+        **phases,
+    }
+
+
 def _restart_probe() -> None:
     """Child mode: a FRESH process (persistent compile cache on disk warm
     from the parent's solves) boots a DeviceScheduler, pre-warms the shape
@@ -530,6 +600,7 @@ def main():
         )
         detail["shape_churn"] = _shape_churn_bench()
         detail["cfg4_consol"] = _consolidation_bench()
+        detail["cfg5_sidecar"] = _sidecar_bench()
         detail["restart"] = _run_restart_probe()
 
     pods_per_sec = primary["pods_per_sec"]

@@ -276,15 +276,35 @@ SINGLE_NODE_CONSOLIDATION_TIMEOUT = 3 * 60.0
 class SingleNodeConsolidation(_ConsolidationBase):
     """One candidate at a time, bounded per poll
     (singlenodeconsolidation.go:29-101): a 3-minute wall-clock budget stops
-    the sweep mid-list, and a persistent cursor rotates the starting
+    the sweep mid-list, and a persistent resume cursor rotates the starting
     candidate across polls so the tail of a large cluster is eventually
-    evaluated instead of being starved behind the same cheap prefix."""
+    evaluated instead of being starved behind the same cheap prefix.
+
+    The cursor is a STABLE KEY — (candidate name, disruption cost) of the
+    next candidate to evaluate — not an index: the candidate list is
+    re-collected and re-sorted every poll, so under churn an index silently
+    points at a different node and the tail can be starved forever. If the
+    named candidate is gone by the next poll, the sweep resumes at the
+    first candidate at or past the remembered cost (the list is
+    cost-sorted), preserving round-robin progress through the tail."""
 
     consolidation_type = "single"
 
     def __init__(self, ctx):
         super().__init__(ctx)
-        self._cursor = 0
+        self._resume_key: Optional[Tuple[str, float]] = None
+
+    def _resume_index(self, candidates: List[Candidate]) -> int:
+        if self._resume_key is None:
+            return 0
+        name, cost = self._resume_key
+        for i, c in enumerate(candidates):
+            if c.name == name:
+                return i
+        for i, c in enumerate(candidates):
+            if c.disruption_cost >= cost:
+                return i
+        return 0
 
     def compute_command(
         self, budgets: BudgetMapping, candidates: List[Candidate]
@@ -296,23 +316,28 @@ class SingleNodeConsolidation(_ConsolidationBase):
         )
         if not candidates:
             return Command()
-        start = self._cursor % len(candidates)
+        start = self._resume_index(candidates)
         rotated = candidates[start:] + candidates[:start]
         deadline = self.ctx.clock.now() + SINGLE_NODE_CONSOLIDATION_TIMEOUT
+
+        def remember(idx: int) -> None:
+            nxt = rotated[idx % len(rotated)]
+            self._resume_key = (nxt.name, nxt.disruption_cost)
+
         for i, c in enumerate(rotated):
             if self.ctx.clock.now() > deadline:
                 m.CONSOLIDATION_TIMEOUTS.inc(
                     {"consolidation_type": self.consolidation_type}
                 )
-                # resume AFTER the last candidate evaluated this poll
-                self._cursor = (start + i) % len(candidates)
+                # resume AT the first candidate NOT evaluated this poll
+                remember(i)
                 return Command()
             cmd, _ = self.compute_consolidation([c])
             if cmd.decision != "no-op":
                 budgets.consume(c.nodepool.name, self.reason)
-                self._cursor = (start + i + 1) % len(candidates)
+                remember(i + 1)
                 return cmd
-        self._cursor = 0  # full coverage this poll; restart at the cheapest
+        self._resume_key = None  # full coverage; restart at the cheapest
         return Command()
 
 

@@ -50,19 +50,22 @@ class Hash:
             return
         if stale_version:
             # hash-version migration: re-stamp claims so a mechanical hash
-            # change isn't read as drift — but NOT claims already marked
-            # Drifted, whose condition reflects a real config difference the
-            # re-stamp would erase (hash/controller.go:70-124 skips them)
+            # change isn't read as drift. Claims already marked Drifted
+            # keep their STALE HASH — the condition reflects a real config
+            # difference a re-stamp would erase — but still get the new
+            # hash VERSION, or the version gate would mask that real drift
+            # from then on (hash/controller.go:102-113 updates the version
+            # annotation on drifted claims and skips only the hash)
             for claim in self.kube.list_nodeclaims():
-                if claim.conditions.is_true("Drifted"):
+                if claim.nodepool_name != pool.name:
                     continue
-                if claim.nodepool_name == pool.name:
+                if not claim.conditions.is_true("Drifted"):
                     claim.metadata.annotations[
                         apilabels.NODEPOOL_HASH_ANNOTATION_KEY
                     ] = current
-                    claim.metadata.annotations[
-                        apilabels.NODEPOOL_HASH_VERSION_ANNOTATION_KEY
-                    ] = HASH_VERSION
+                claim.metadata.annotations[
+                    apilabels.NODEPOOL_HASH_VERSION_ANNOTATION_KEY
+                ] = HASH_VERSION
         ann[apilabels.NODEPOOL_HASH_ANNOTATION_KEY] = current
         ann[apilabels.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = HASH_VERSION
         self.kube.update(pool)

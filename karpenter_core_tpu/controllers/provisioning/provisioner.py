@@ -37,6 +37,7 @@ class Provisioner:
         solver: str = "greedy",
         device_scheduler_opts: Optional[dict] = None,
         recorder=None,
+        solver_client=None,
     ):
         self.kube = kube
         self.cluster = cluster
@@ -45,6 +46,10 @@ class Provisioner:
         self.solver = solver
         self.device_scheduler_opts = device_scheduler_opts or {}
         self.recorder = recorder
+        # non-None routes tpu solves (and the consolidation sweep) through
+        # the solverd sidecar via solver/remote.py; the client owns the
+        # circuit breaker, so it outlives individual schedulers
+        self.solver_client = solver_client
         # host+device profiling hook (reference pprof, operator.go:159-175):
         # set by the operator from --profile-solves / --profile-dir
         self.profile_solves = 0
@@ -159,6 +164,15 @@ class Provisioner:
             daemonset_pods=self.daemonset_pods(),
         )
         if self.solver == "tpu":
+            if self.solver_client is not None:
+                from karpenter_core_tpu.solver.remote import RemoteScheduler
+
+                return RemoteScheduler(
+                    self.solver_client,
+                    topology=topology,
+                    device_scheduler_opts=self.device_scheduler_opts,
+                    **common,
+                )
             from karpenter_core_tpu.models.provisioner import DeviceScheduler
 
             return DeviceScheduler(

@@ -40,6 +40,31 @@ _LIST_KINDS = {
     "poddisruptionbudgets": "list_pdbs",
 }
 
+# -- shared handler plumbing (also used by solver/service.py, the solverd
+# sidecar — one definition of "send a body with correct framing") ----------
+
+
+def send_body(
+    handler: BaseHTTPRequestHandler,
+    code: int,
+    body: bytes,
+    ctype: str = "application/json",
+    headers: dict = None,
+) -> None:
+    handler.send_response(code)
+    handler.send_header("Content-Type", ctype)
+    handler.send_header("Content-Length", str(len(body)))
+    for k, v in (headers or {}).items():
+        handler.send_header(k, v)
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def read_body(handler: BaseHTTPRequestHandler) -> bytes:
+    n = int(handler.headers.get("Content-Length", "0"))
+    return handler.rfile.read(n) if n else b""
+
+
 # kinds the GET-by-name path serves (plural -> API class)
 _GET_KINDS = {}
 
@@ -96,16 +121,11 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _send(self, code: int, payload) -> None:
-        body = json.dumps(payload).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        send_body(self, code, json.dumps(payload).encode())
 
     def _body(self):
-        n = int(self.headers.get("Content-Length", "0"))
-        return json.loads(self.rfile.read(n)) if n else None
+        raw = read_body(self)
+        return json.loads(raw) if raw else None
 
     # -- verbs -------------------------------------------------------------
 

@@ -63,7 +63,14 @@ def encode(value: Any) -> Any:
         return {k: encode(v) for k, v in value.items()}
     if isinstance(value, tuple):
         return {_TYPE_KEY: "!tuple", "items": [encode(v) for v in value]}
-    if isinstance(value, (set, frozenset)):
+    if isinstance(value, frozenset):
+        # tagged separately from set: frozen dataclass fields must decode
+        # back hashable (a plain set would TypeError on first hash)
+        return {
+            _TYPE_KEY: "!frozenset",
+            "items": sorted(encode(v) for v in value),
+        }
+    if isinstance(value, set):
         return {_TYPE_KEY: "!set", "items": sorted(encode(v) for v in value)}
     if isinstance(value, list):
         return [encode(v) for v in value]
@@ -85,6 +92,8 @@ def decode(value: Any) -> Any:
         return tuple(decode(v) for v in value["items"])
     if tag == "!set":
         return set(decode(v) for v in value["items"])
+    if tag == "!frozenset":
+        return frozenset(decode(v) for v in value["items"])
     if tag == "ConditionSet":
         cs = ConditionSet(*value.get("types", []))
         for c in decode(value.get("conditions", [])):

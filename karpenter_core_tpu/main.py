@@ -2,12 +2,14 @@
 
 Builds the full controller stack over the in-memory store + kwok provider
 and runs the reconcile loop. Flags/env parse through Options.parse
-(--solver greedy|tpu, --batch-max-duration, --batch-idle-duration,
+(--solver greedy|tpu, --solver-mode inproc|sidecar, --solver-addr,
+--solver-timeout, --batch-max-duration, --batch-idle-duration,
 --log-level, --feature-gates Name=true,...), plus loop controls:
 --poll-interval seconds between passes, --max-iters to bound the run
 (0 = run until interrupted).
 
     python -m karpenter_core_tpu.main --solver tpu --log-level debug
+    python -m karpenter_core_tpu.main --solver tpu --solver-mode sidecar
 """
 from __future__ import annotations
 
@@ -31,13 +33,19 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         port = 0 if options.health_port < 0 else options.health_port
         health = start_health_server(op, port)
+        # log the ACTUAL listen address — the server binds 0.0.0.0 by
+        # default, not loopback
         logger.info(
-            "health/metrics on 127.0.0.1:%d (/healthz /readyz /metrics)",
+            "health/metrics on %s:%d (/healthz /readyz /metrics)",
+            health.server_address[0],
             health.server_address[1],
         )
+    if op.solver_client is not None:
+        logger.info("solver sidecar at %s", op.solver_client.addr)
     logger.info(
-        "operator starting: solver=%s batch=%ss/%ss gates=%s",
+        "operator starting: solver=%s mode=%s batch=%ss/%ss gates=%s",
         options.solver,
+        options.solver_mode,
         options.batch_max_duration,
         options.batch_idle_duration,
         options.feature_gates,
@@ -53,6 +61,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     except KeyboardInterrupt:
         logger.info("operator interrupted after %d passes", n)
     finally:
+        op.shutdown()
         if health is not None:
             health.shutdown()
             health.server_close()

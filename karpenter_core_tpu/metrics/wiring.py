@@ -125,3 +125,34 @@ SOLVER_RELAX_ROUNDS = REGISTRY.counter(
     "solver_relaxation_rounds_total",
     "Preference-relaxation re-solves",
 )
+
+# -- solverd sidecar RPC (solver/{service,remote,supervisor}.py) -----------
+
+SOLVER_RPC_PHASE_DURATION = REGISTRY.histogram(
+    "solver_rpc_phase_duration_seconds",
+    "One sidecar RPC split by phase (encode|transit|kernel|decode): encode/"
+    "decode are the client codec, kernel is the sidecar's reported solve "
+    "time, transit is wire+HTTP overhead (total - kernel)",
+)
+SOLVER_RPC_FAILURES = REGISTRY.counter(
+    "solver_rpc_failures_total",
+    "Sidecar RPCs abandoned after retries, by cause "
+    "(timeout|error|circuit_open|injected|decode)",
+)
+SOLVER_RPC_RETRIES = REGISTRY.counter(
+    "solver_rpc_retries_total",
+    "Individual sidecar RPC attempts that failed and were retried",
+)
+SOLVER_RPC_FALLBACKS = REGISTRY.counter(
+    "solver_rpc_fallbacks_total",
+    "Solves degraded to the host-greedy path because the sidecar was "
+    "unavailable, by endpoint (solve|consolidate)",
+)
+SOLVER_CIRCUIT_STATE = REGISTRY.gauge(
+    "solver_circuit_breaker_state",
+    "Sidecar circuit breaker: 0 closed, 1 half-open, 2 open",
+)
+SOLVER_SIDECAR_RESTARTS = REGISTRY.counter(
+    "solver_sidecar_restarts_total",
+    "Sidecar processes respawned by the supervisor",
+)

@@ -138,16 +138,62 @@ def schedulability_frontier(
         np_.name: provisioner.cloud_provider.get_instance_types(np_)
         for np_ in nodepools
     }
+    candidate_pods = [c.reschedulable_pods for c in candidates]
+    daemonset_pods = provisioner.daemonset_pods()
+
+    # sidecar mode: the sweep crosses the same RPC seam as the solve; a
+    # dead/slow sidecar degrades to the host binary search (None), exactly
+    # like an unrepresentable problem
+    client = getattr(provisioner, "solver_client", None)
+    if client is not None:
+        from karpenter_core_tpu.solver.remote import remote_frontier
+
+        return remote_frontier(
+            client,
+            nodepools,
+            instance_types,
+            cand_nodes,
+            keep_nodes,
+            daemonset_pods,
+            base_pods,
+            candidate_pods,
+            max_slots=max_slots,
+        )
+    return frontier_core(
+        nodepools,
+        instance_types,
+        cand_nodes,
+        keep_nodes,
+        daemonset_pods,
+        base_pods,
+        candidate_pods,
+        max_slots=max_slots,
+    )
+
+
+def frontier_core(
+    nodepools,
+    instance_types,
+    cand_nodes,
+    keep_nodes,
+    daemonset_pods,
+    base_pods: List,
+    candidate_pods: List[List],
+    max_slots: int = 1024,
+) -> Optional[List[Tuple[bool, int, float]]]:
+    """The device sweep proper, over already-gathered inputs — runnable
+    in-process or behind the solverd sidecar (solver/service.py decodes a
+    frontier request straight into this signature)."""
     all_pods = list(base_pods)
-    for c in candidates:
-        all_pods.extend(c.reschedulable_pods)
+    for pods in candidate_pods:
+        all_pods.extend(pods)
 
     # candidate slots first so prefix p masks slots [0, p)
     sched = DeviceScheduler(
         nodepools,
         instance_types,
         existing_nodes=cand_nodes + keep_nodes,
-        daemonset_pods=provisioner.daemonset_pods(),
+        daemonset_pods=daemonset_pods,
         max_slots=max_slots,
     )
     # DeviceScheduler sorts existing nodes; force candidate-first order back
@@ -157,11 +203,9 @@ def schedulability_frontier(
     except _SlotOverflow:
         return None  # cluster wider than the slot array: binary search
 
-    P = len(candidates)
+    P = len(candidate_pods)
     E = len(sched.existing_nodes)
-    kind_batch, count_batch = prefix_batches(
-        prep, base_pods, [c.reschedulable_pods for c in candidates]
-    )
+    kind_batch, count_batch = prefix_batches(prep, base_pods, candidate_pods)
 
     classes = sched._class_steps(prep)
     Jp = int(classes.count.shape[0])

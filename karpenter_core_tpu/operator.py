@@ -60,6 +60,13 @@ class Options:
     feature gates parse from the comma-separated "Name=bool" string."""
 
     solver: str = "greedy"  # greedy | tpu
+    # where the tpu solver runs: in this process, or behind the solverd
+    # sidecar (solver/service.py) with RPC fault tolerance + greedy
+    # degradation (solver/remote.py). solver_addr="" spawns a supervised
+    # local sidecar (solver/supervisor.py); set it to reach an external one.
+    solver_mode: str = "inproc"  # inproc | sidecar
+    solver_addr: str = ""
+    solver_timeout: float = 30.0  # per-RPC deadline, seconds
     batch_max_duration: float = 10.0
     batch_idle_duration: float = 1.0
     log_level: str = "info"
@@ -79,6 +86,11 @@ class Options:
     _FLAGS = {
         "health_port": ("--health-port", "KARPENTER_HEALTH_PORT", int),
         "solver": ("--solver", "KARPENTER_SOLVER", str),
+        "solver_mode": ("--solver-mode", "KARPENTER_SOLVER_MODE", str),
+        "solver_addr": ("--solver-addr", "KARPENTER_SOLVER_ADDR", str),
+        "solver_timeout": (
+            "--solver-timeout", "KARPENTER_SOLVER_TIMEOUT", float,
+        ),
         "batch_max_duration": (
             "--batch-max-duration", "KARPENTER_BATCH_MAX_DURATION", float,
         ),
@@ -132,6 +144,15 @@ class Options:
             opts.feature_gates[name] = value.lower() in ("true", "1", "yes")
         if opts.solver not in ("greedy", "tpu"):
             raise ValueError(f"unknown solver {opts.solver!r}")
+        if opts.solver_mode not in ("inproc", "sidecar"):
+            raise ValueError(f"unknown solver mode {opts.solver_mode!r}")
+        if opts.solver_mode == "sidecar" and opts.solver != "tpu":
+            # the sidecar hosts the DEVICE solver; accepting this combo
+            # would silently run greedy in-proc while logging sidecar mode
+            raise ValueError(
+                "--solver-mode=sidecar requires --solver=tpu "
+                f"(got solver={opts.solver!r})"
+            )
         return opts
 
 
@@ -160,6 +181,29 @@ class Operator:
         )
         self.cluster = Cluster(self.kube, self.clock)
         self.recorder = Recorder(self.clock)
+        # solverd sidecar wiring (solver_mode=sidecar): a supervised child
+        # process (unless an external --solver-addr is given) plus the
+        # fault-tolerant RPC client the provisioner routes solves through
+        self.solver_supervisor = None
+        self.solver_client = None
+        if self.options.solver == "tpu" and self.options.solver_mode == "sidecar":
+            from karpenter_core_tpu.solver.remote import SolverClient
+
+            addr = self.options.solver_addr
+            if not addr:
+                from karpenter_core_tpu.solver.supervisor import (
+                    SolverSupervisor,
+                )
+
+                self.solver_supervisor = SolverSupervisor(
+                    on_event=self._publish_sidecar_event
+                )
+                addr = self.solver_supervisor.start()
+            self.solver_client = SolverClient(
+                addr,
+                timeout=self.options.solver_timeout,
+                on_state_change=self._publish_circuit_event,
+            )
         self.provisioner = Provisioner(
             self.kube,
             self.cluster,
@@ -168,6 +212,7 @@ class Operator:
             solver=self.options.solver,
             device_scheduler_opts=self.options.device_scheduler_opts,
             recorder=self.recorder,
+            solver_client=self.solver_client,
         )
         self.provisioner.profile_solves = self.options.profile_solves
         self.provisioner.profile_dir = self.options.profile_dir
@@ -233,6 +278,44 @@ class Operator:
         if podutil.is_provisionable(obj):
             self.batcher.trigger()
 
+    # -- solverd sidecar surface -------------------------------------------
+
+    def _publish_sidecar_event(self, reason: str, message: str) -> None:
+        """Supervisor lifecycle -> the event stream, the way the reference
+        surfaces controller conditions (SidecarUnavailable is the 'sidecar
+        unavailable' condition the ops surface watches)."""
+        from karpenter_core_tpu.events import Event
+
+        self.recorder.publish(Event(
+            involved_object="Solverd/sidecar",
+            type="Warning" if "Unavailable" in reason or "Failed" in reason
+            else "Normal",
+            reason=reason,
+            message=message,
+        ))
+
+    def _publish_circuit_event(self, state: str) -> None:
+        from karpenter_core_tpu.events import Event
+
+        self.recorder.publish(Event(
+            involved_object="Solverd/sidecar",
+            type="Warning" if state == "open" else "Normal",
+            reason="SolverCircuitOpen" if state == "open"
+            else "SolverCircuitClosed" if state == "closed"
+            else "SolverCircuitHalfOpen",
+            message=f"solver circuit breaker is {state}; "
+            + (
+                "solves degrade to the host greedy path"
+                if state == "open"
+                else "device solves resume"
+            ),
+        ))
+
+    def shutdown(self) -> None:
+        """Stop owned background resources (the supervised sidecar)."""
+        if self.solver_supervisor is not None:
+            self.solver_supervisor.stop()
+
     # -- health surface (operator.go:181-198 healthz/readyz) ---------------
 
     def healthz(self) -> bool:
@@ -247,6 +330,11 @@ class Operator:
     # -- one pass ----------------------------------------------------------
 
     def reconcile_once(self, disrupt: bool = True) -> None:
+        if self.solver_supervisor is not None:
+            # supervise the sidecar every pass; after a respawn the client
+            # follows the (possibly fresh) address — no operator restart
+            if self.solver_supervisor.poll() and self.solver_client is not None:
+                self.solver_client.set_addr(self.solver_supervisor.addr)
         for pool in list(self.kube.list_nodepools()):
             self.nodepool_hash.reconcile(pool)
             self.nodepool_validation.reconcile(pool)

@@ -1,18 +1,21 @@
 """Snapshot wire codec: the solver's process boundary.
 
 SURVEY §7 and BASELINE frame the solver as a service a control plane talks
-to over gRPC/DCN; this codec is that boundary's payload format. A solve
-request (the ``Snapshot`` from solver/snapshot.py — pure numpy + interned
-vocab) and a solve response (per-class slot assignments) round-trip
-through bytes with no Python-specific pickling: arrays ride npz, the
-vocab/metadata ride JSON. A Go (or any) client can produce the same
-layout; the in-process path simply skips the codec.
+to over gRPC/DCN; this codec is that boundary's payload format, and the
+solverd sidecar (solver/service.py, driven by solver/remote.py) actually
+serves it. A solve request (the ``Snapshot`` from solver/snapshot.py —
+pure numpy + interned vocab) and a solve response (per-class slot
+assignments) round-trip through bytes with no Python-specific pickling:
+arrays ride npz, the vocab/metadata ride JSON. A Go (or any) client can
+produce the same layout; the in-process path simply skips the codec.
+The solverd section below extends the same container to the FULL
+scheduler input/output (solve problems, results, consolidation sweeps).
 """
 from __future__ import annotations
 
 import io
 import json
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -113,3 +116,403 @@ def encode_response(
 def decode_response(data: bytes) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     z = np.load(io.BytesIO(data))
     return z["takes"], z["unplaced"], z["slot_template"]
+
+
+# ---------------------------------------------------------------------------
+# solverd wire format: the full solve problem and its results.
+#
+# The snapshot codec above carries one pre-tensorized subproblem; the solverd
+# sidecar (solver/service.py) instead receives the whole scheduler input —
+# nodepools, per-pool instance types, existing SimNodes, daemonset pods,
+# pending pods, topology context — runs DeviceScheduler server-side, and
+# returns placements keyed by pod uid / node name / instance-type name so the
+# client (solver/remote.py) re-binds them to its own live objects. Same
+# container as above (npz; object payloads ride the JSON header), no
+# pickling: API objects go through kube/serial's closed-world registry and
+# the solver-side types (Requirement, InstanceType, SimNode) get explicit
+# field codecs below.
+# ---------------------------------------------------------------------------
+
+SOLVE_WIRE_VERSION = 1
+
+
+def _json_payload(header: dict) -> bytes:
+    arrays = {
+        _HEADER_KEY: np.frombuffer(json.dumps(header).encode(), dtype=np.uint8)
+    }
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    return buf.getvalue()
+
+
+def _json_header(data: bytes) -> dict:
+    z = np.load(io.BytesIO(data))
+    return json.loads(bytes(z[_HEADER_KEY]).decode())
+
+
+def _encode_req(r) -> dict:
+    return {
+        "key": r.key,
+        "complement": r.complement,
+        "values": sorted(r.values),
+        "gt": r.greater_than,
+        "lt": r.less_than,
+        "min_values": r.min_values,
+    }
+
+
+def _decode_req(d: dict):
+    from karpenter_core_tpu.scheduling.requirement import Requirement
+
+    return Requirement(
+        d["key"],
+        complement=d["complement"],
+        values=d["values"],
+        greater_than=d["gt"],
+        less_than=d["lt"],
+        min_values=d["min_values"],
+    )
+
+
+def _encode_reqs(reqs) -> List[dict]:
+    return [_encode_req(r) for r in reqs.values()]
+
+
+def _decode_reqs(items: List[dict]):
+    from karpenter_core_tpu.scheduling import Requirements
+
+    out = Requirements()
+    # bypass add()'s intersection: the wire carries final requirement sets
+    for d in items:
+        r = _decode_req(d)
+        out[r.key] = r
+    return out
+
+
+def _encode_instance_type(it) -> dict:
+    return {
+        "name": it.name,
+        "requirements": _encode_reqs(it.requirements),
+        "offerings": [
+            {
+                "requirements": _encode_reqs(o.requirements),
+                "price": o.price,
+                "available": o.available,
+            }
+            for o in it.offerings
+        ],
+        "capacity": dict(it.capacity),
+        "overhead": dict(it.overhead),
+    }
+
+
+def _decode_instance_type(d: dict):
+    from karpenter_core_tpu.cloudprovider.types import (
+        InstanceType,
+        Offering,
+        Offerings,
+    )
+
+    return InstanceType(
+        name=d["name"],
+        requirements=_decode_reqs(d["requirements"]),
+        offerings=Offerings(
+            Offering(
+                requirements=_decode_reqs(o["requirements"]),
+                price=o["price"],
+                available=o["available"],
+            )
+            for o in d["offerings"]
+        ),
+        capacity=dict(d["capacity"]),
+        overhead=dict(d["overhead"]),
+    )
+
+
+def _encode_it_table(instance_types: Dict[str, list]) -> Tuple[list, dict]:
+    """(table, per-pool index lists). Instance-type OBJECT IDENTITY is part
+    of the solve input (catalog union dedupes by id), so objects shared
+    across pools encode once and decode back to one shared object."""
+    table: List[dict] = []
+    index: Dict[int, int] = {}
+    pools: Dict[str, List[int]] = {}
+    for pool, its in instance_types.items():
+        rows = []
+        for it in its:
+            ti = index.get(id(it))
+            if ti is None:
+                ti = index[id(it)] = len(table)
+                table.append(_encode_instance_type(it))
+            rows.append(ti)
+        pools[pool] = rows
+    return table, pools
+
+
+def _decode_it_table(table: list, pools: dict) -> Dict[str, list]:
+    objs = [_decode_instance_type(d) for d in table]
+    return {pool: [objs[i] for i in rows] for pool, rows in pools.items()}
+
+
+def _encode_volume_usage(vu) -> Optional[dict]:
+    if vu is None:
+        return None
+    return {
+        "limits": dict(vu.limits),
+        "volumes": {k: sorted(v) for k, v in vu.volumes.items()},
+    }
+
+
+def _decode_volume_usage(d: Optional[dict]):
+    if d is None:
+        return None
+    from karpenter_core_tpu.scheduling.volumeusage import VolumeUsage
+
+    vu = VolumeUsage()
+    vu.limits = dict(d["limits"])
+    vu.volumes = {k: set(v) for k, v in d["volumes"].items()}
+    return vu
+
+
+def _encode_sim_node(n) -> dict:
+    from karpenter_core_tpu.kube import serial
+
+    return {
+        "name": n.name,
+        "labels": dict(n.labels),
+        "taints": [serial.encode(t) for t in n.taints],
+        "available": dict(n.available),
+        "capacity": dict(n.capacity),
+        "daemon_requests": dict(n.daemon_requests),
+        "initialized": n.initialized,
+        "nodeclaim_name": n.nodeclaim_name,
+        "nodepool_name": n.nodepool_name,
+        "volume_usage": _encode_volume_usage(n.volume_usage),
+    }
+
+
+def _decode_sim_node(d: dict):
+    from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import (
+        SimNode,
+    )
+    from karpenter_core_tpu.kube import serial
+
+    return SimNode(
+        name=d["name"],
+        labels=dict(d["labels"]),
+        taints=[serial.decode(t) for t in d["taints"]],
+        available=dict(d["available"]),
+        capacity=dict(d["capacity"]),
+        daemon_requests=dict(d["daemon_requests"]),
+        initialized=d["initialized"],
+        nodeclaim_name=d["nodeclaim_name"],
+        nodepool_name=d["nodepool_name"],
+        volume_usage=_decode_volume_usage(d["volume_usage"]),
+    )
+
+
+def _encode_topology(topo) -> Optional[dict]:
+    from karpenter_core_tpu.kube import serial
+
+    if topo is None:
+        return None
+    return {
+        "domains": {k: sorted(v) for k, v in topo.domains.items()},
+        "existing_pods": [
+            [serial.encode(p), dict(labels), name]
+            for p, labels, name in topo.existing_pods
+        ],
+        "excluded": sorted(topo.excluded_pods),
+    }
+
+
+def _decode_topology(d: Optional[dict]):
+    if d is None:
+        return None
+    from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+        Topology,
+    )
+    from karpenter_core_tpu.kube import serial
+
+    return Topology(
+        domains={k: set(v) for k, v in d["domains"].items()},
+        existing_pods=[
+            (serial.decode(p), dict(labels), name)
+            for p, labels, name in d["existing_pods"]
+        ],
+        excluded_pod_uids=d["excluded"],
+    )
+
+
+def encode_solve_request(
+    nodepools,
+    instance_types: Dict[str, list],
+    existing_nodes,
+    daemonset_pods,
+    pods,
+    topology=None,
+    max_slots: int = 256,
+) -> bytes:
+    """Serialize a full scheduler input for the solverd sidecar."""
+    from karpenter_core_tpu.kube import serial
+
+    table, pools = _encode_it_table(instance_types)
+    header = {
+        "version": SOLVE_WIRE_VERSION,
+        "nodepools": [serial.encode(np_) for np_ in nodepools],
+        "it_table": table,
+        "it_pools": pools,
+        "existing_nodes": [_encode_sim_node(n) for n in existing_nodes],
+        "daemonset_pods": [serial.encode(p) for p in daemonset_pods],
+        "pods": [serial.encode(p) for p in pods],
+        "topology": _encode_topology(topology),
+        "max_slots": max_slots,
+    }
+    return _json_payload(header)
+
+
+def decode_solve_request(data: bytes) -> dict:
+    """Inverse of encode_solve_request; returns a kwargs-style dict."""
+    from karpenter_core_tpu.kube import serial
+
+    h = _json_header(data)
+    if h["version"] != SOLVE_WIRE_VERSION:
+        raise ValueError(f"unsupported solve wire version {h['version']}")
+    return {
+        "nodepools": [serial.decode(d) for d in h["nodepools"]],
+        "instance_types": _decode_it_table(h["it_table"], h["it_pools"]),
+        "existing_nodes": [_decode_sim_node(d) for d in h["existing_nodes"]],
+        "daemonset_pods": [serial.decode(d) for d in h["daemonset_pods"]],
+        "pods": [serial.decode(d) for d in h["pods"]],
+        "topology": _decode_topology(h["topology"]),
+        "max_slots": h["max_slots"],
+    }
+
+
+def encode_solve_results(results, solve_seconds: float) -> bytes:
+    """Serialize a Results: placements by pod uid, instance types by name,
+    nodepool by name — the client re-binds them to its live objects."""
+    header = {
+        "version": SOLVE_WIRE_VERSION,
+        "claims": [
+            {
+                "nodepool": c.template.nodepool_name,
+                "instance_types": [it.name for it in c.instance_type_options],
+                "requirements": _encode_reqs(c.requirements),
+                "requests": dict(c.requests),
+                "pod_uids": [p.uid for p in c.pods],
+            }
+            for c in results.new_node_claims
+        ],
+        "existing": [
+            {"node": sim.name, "pod_uids": [p.uid for p in sim.pods]}
+            for sim in results.existing_nodes
+        ],
+        "errors": dict(results.pod_errors),
+        "solve_seconds": solve_seconds,
+    }
+    return _json_payload(header)
+
+
+def decode_solve_results(data: bytes) -> dict:
+    """Plain-data view of a solve response; solver/remote.py materializes
+    Results from it against the caller's local objects (requirements decode
+    here — they carry no identity)."""
+    h = _json_header(data)
+    if h.get("version") != SOLVE_WIRE_VERSION:
+        # same explicit skew error as the request decoders — an external
+        # sidecar on a different code version must not surface as a
+        # mysterious per-solve fallback
+        raise ValueError(
+            f"unsupported solve wire version {h.get('version')}"
+        )
+    for claim in h["claims"]:
+        claim["requirements"] = _decode_reqs(claim["requirements"])
+    return h
+
+
+def encode_frontier_request(
+    nodepools,
+    instance_types: Dict[str, list],
+    cand_nodes,
+    keep_nodes,
+    daemonset_pods,
+    base_pods,
+    candidate_pods,
+    max_slots: int = 1024,
+) -> bytes:
+    """Serialize a consolidation-frontier sweep (models/consolidation.py)
+    for the sidecar: candidate nodes FIRST (prefix p masks slots [0, p))."""
+    from karpenter_core_tpu.kube import serial
+
+    table, pools = _encode_it_table(instance_types)
+    header = {
+        "version": SOLVE_WIRE_VERSION,
+        "nodepools": [serial.encode(np_) for np_ in nodepools],
+        "it_table": table,
+        "it_pools": pools,
+        "cand_nodes": [_encode_sim_node(n) for n in cand_nodes],
+        "keep_nodes": [_encode_sim_node(n) for n in keep_nodes],
+        "daemonset_pods": [serial.encode(p) for p in daemonset_pods],
+        "base_pods": [serial.encode(p) for p in base_pods],
+        "candidate_pods": [
+            [serial.encode(p) for p in pods] for pods in candidate_pods
+        ],
+        "max_slots": max_slots,
+    }
+    return _json_payload(header)
+
+
+def decode_frontier_request(data: bytes) -> dict:
+    from karpenter_core_tpu.kube import serial
+
+    h = _json_header(data)
+    if h["version"] != SOLVE_WIRE_VERSION:
+        raise ValueError(f"unsupported solve wire version {h['version']}")
+    return {
+        "nodepools": [serial.decode(d) for d in h["nodepools"]],
+        "instance_types": _decode_it_table(h["it_table"], h["it_pools"]),
+        "cand_nodes": [_decode_sim_node(d) for d in h["cand_nodes"]],
+        "keep_nodes": [_decode_sim_node(d) for d in h["keep_nodes"]],
+        "daemonset_pods": [serial.decode(d) for d in h["daemonset_pods"]],
+        "base_pods": [serial.decode(d) for d in h["base_pods"]],
+        "candidate_pods": [
+            [serial.decode(d) for d in pods] for pods in h["candidate_pods"]
+        ],
+        "max_slots": h["max_slots"],
+    }
+
+
+def encode_frontier_response(frontier) -> bytes:
+    """frontier: list of (schedulable, new_nodes, price_lb) or None (the
+    sweep could not represent the problem — caller binary-searches)."""
+    if frontier is None:
+        return _json_payload({"version": SOLVE_WIRE_VERSION, "available": False})
+    arrays = {
+        _HEADER_KEY: np.frombuffer(
+            json.dumps(
+                {"version": SOLVE_WIRE_VERSION, "available": True}
+            ).encode(),
+            dtype=np.uint8,
+        ),
+        "ok": np.array([ok for ok, _, _ in frontier], dtype=bool),
+        "n_new": np.array([n for _, n, _ in frontier], dtype=np.int64),
+        "price_lb": np.array([p for _, _, p in frontier], dtype=np.float64),
+    }
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    return buf.getvalue()
+
+
+def decode_frontier_response(data: bytes):
+    z = np.load(io.BytesIO(data))
+    header = json.loads(bytes(z[_HEADER_KEY]).decode())
+    if header.get("version") != SOLVE_WIRE_VERSION:
+        raise ValueError(
+            f"unsupported solve wire version {header.get('version')}"
+        )
+    if not header["available"]:
+        return None
+    return [
+        (bool(ok), int(n), float(p))
+        for ok, n, p in zip(z["ok"], z["n_new"], z["price_lb"])
+    ]

@@ -1,0 +1,438 @@
+"""RemoteSolver: the control-plane client of the solverd sidecar.
+
+``RemoteScheduler`` presents the exact surface the provisioner consumes
+(``solve(pods) -> Results``, the Scheduler/DeviceScheduler contract) while
+the device work happens in another process (solver/service.py). Fault
+tolerance is the point of the seam:
+
+* per-request deadline (the HTTP timeout covers connect AND read, so a
+  hung sidecar surfaces as ``socket.timeout`` within the budget);
+* bounded retry with exponential backoff;
+* a circuit breaker that trips after consecutive failures and half-opens
+  after a cooldown, so a dead sidecar costs one fast-failed call per solve
+  instead of retries×timeout;
+* graceful degradation — any RPC failure falls back to the host greedy
+  Scheduler over the SAME inputs, so the cluster degrades to greedy parity
+  instead of stalling provisioning (the in-solver twin of the device
+  solver's own ``_host_fallback_add`` repair path).
+
+``FaultInjector`` scripts deterministic timeout/error/slow schedules into
+the client (the cloudprovider/fake.py error-injection pattern) so every
+degradation path is testable without real process failures.
+"""
+from __future__ import annotations
+
+import http.client
+import socket
+import time
+from typing import Dict, List, Optional
+
+from karpenter_core_tpu.solver import codec
+
+STATE_CLOSED = 0
+STATE_HALF_OPEN = 1
+STATE_OPEN = 2
+
+_STATE_NAMES = {0: "closed", 1: "half-open", 2: "open"}
+
+
+class RemoteSolverError(Exception):
+    """An RPC abandoned after retries (or short-circuited)."""
+
+    def __init__(self, cause: str, message: str = ""):
+        super().__init__(message or cause)
+        self.cause = cause  # timeout | error | circuit_open | injected
+
+
+class FaultInjector:
+    """Scripted per-call faults, consumed in order; exhausted -> healthy.
+
+    Entries: ``"ok"``, ``"error"`` (injected exception before transport),
+    ``"timeout"`` (simulated deadline miss), ``"hang"`` (sleeps the client's
+    full timeout, then times out — the slow-sidecar shape), ``"slow:<s>"``
+    (adds latency, call still succeeds)."""
+
+    def __init__(self, schedule: Optional[List[str]] = None):
+        self.schedule = list(schedule or [])
+        self.calls = 0
+
+    def next_fault(self) -> str:
+        self.calls += 1
+        if self.schedule:
+            return self.schedule.pop(0)
+        return "ok"
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with half-open probing."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        cooldown: float = 15.0,
+        time_fn=time.monotonic,
+        on_state_change=None,
+    ):
+        self.failure_threshold = failure_threshold
+        self.cooldown = cooldown
+        self.time_fn = time_fn
+        self.on_state_change = on_state_change
+        self.state = STATE_CLOSED
+        self.failures = 0
+        self.opened_at = 0.0
+        self._export()
+
+    def _export(self) -> None:
+        from karpenter_core_tpu.metrics import wiring as m
+
+        m.SOLVER_CIRCUIT_STATE.set(float(self.state))
+
+    def _transition(self, state: int) -> None:
+        if state == self.state:
+            return
+        self.state = state
+        self._export()
+        if self.on_state_change is not None:
+            self.on_state_change(_STATE_NAMES[state])
+
+    def allow(self) -> bool:
+        """May a call proceed right now? Open trips to half-open (one probe
+        allowed) once the cooldown has elapsed."""
+        if self.state == STATE_OPEN:
+            if self.time_fn() - self.opened_at >= self.cooldown:
+                self._transition(STATE_HALF_OPEN)
+                return True
+            return False
+        return True
+
+    def record_success(self) -> None:
+        self.failures = 0
+        self._transition(STATE_CLOSED)
+
+    def record_failure(self) -> None:
+        self.failures += 1
+        if (
+            self.state == STATE_HALF_OPEN
+            or self.failures >= self.failure_threshold
+        ):
+            self.opened_at = self.time_fn()
+            self._transition(STATE_OPEN)
+
+
+class SolverClient:
+    """Shared transport + fault-tolerance state for one sidecar address.
+
+    One instance lives on the provisioner for the operator's lifetime (the
+    breaker must remember failures ACROSS solves); RemoteScheduler instances
+    are per-solve and borrow it."""
+
+    def __init__(
+        self,
+        addr: str,
+        timeout: float = 30.0,
+        max_retries: int = 2,
+        backoff: float = 0.1,
+        breaker: Optional[CircuitBreaker] = None,
+        fault_injector: Optional[FaultInjector] = None,
+        sleep=time.sleep,
+        on_state_change=None,
+    ):
+        host, _, port = addr.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self.timeout = timeout
+        self.max_retries = max_retries
+        self.backoff = backoff
+        self.breaker = breaker or CircuitBreaker(
+            on_state_change=on_state_change
+        )
+        if on_state_change is not None and breaker is not None:
+            breaker.on_state_change = on_state_change
+        self.fault_injector = fault_injector
+        self.sleep = sleep
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def set_addr(self, addr: str) -> None:
+        """Follow a respawned sidecar to its new port (supervisor restarts
+        with port 0 pick a fresh one)."""
+        host, _, port = addr.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+
+    # -- transport ---------------------------------------------------------
+
+    def _apply_fault(self) -> None:
+        if self.fault_injector is None:
+            return
+        fault = self.fault_injector.next_fault()
+        if fault == "ok":
+            return
+        if fault == "error":
+            raise RemoteSolverError("injected", "injected error")
+        if fault == "timeout":
+            raise socket.timeout("injected timeout")
+        if fault == "hang":
+            # a hung sidecar holds the socket until the client deadline
+            self.sleep(self.timeout)
+            raise socket.timeout("injected hang past deadline")
+        if fault.startswith("slow:"):
+            self.sleep(float(fault.split(":", 1)[1]))
+            return
+        raise ValueError(f"unknown fault {fault!r}")
+
+    def _once(self, path: str, body: bytes):
+        self._apply_fault()
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout
+        )
+        try:
+            conn.request(
+                "POST", path, body,
+                headers={"Content-Type": "application/octet-stream"},
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise RemoteSolverError(
+                    "error",
+                    f"sidecar {path} -> {resp.status}: {data[:200]!r}",
+                )
+            kernel = float(resp.getheader("X-Solver-Seconds", "0") or 0.0)
+            return data, kernel
+        finally:
+            conn.close()
+
+    def call(self, path: str, body: bytes):
+        """(response bytes, sidecar-reported kernel seconds), or raises
+        RemoteSolverError after the retry budget / on an open circuit."""
+        from karpenter_core_tpu.metrics import wiring as m
+
+        if not self.breaker.allow():
+            m.SOLVER_RPC_FAILURES.inc({"cause": "circuit_open"})
+            raise RemoteSolverError("circuit_open", "circuit breaker open")
+        cause, detail = "error", ""
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                m.SOLVER_RPC_RETRIES.inc()
+                self.sleep(self.backoff * (2 ** (attempt - 1)))
+            try:
+                data, kernel = self._once(path, body)
+            except RemoteSolverError as e:
+                cause, detail = e.cause, str(e)
+                if self.breaker.state == STATE_HALF_OPEN:
+                    break  # one probe only — don't burn retries while open
+                continue
+            except socket.timeout as e:
+                cause, detail = "timeout", str(e)
+                if self.breaker.state == STATE_HALF_OPEN:
+                    break
+                continue
+            except OSError as e:
+                cause, detail = "error", str(e)
+                if self.breaker.state == STATE_HALF_OPEN:
+                    break
+                continue
+            self.breaker.record_success()
+            return data, kernel
+        self.breaker.record_failure()
+        m.SOLVER_RPC_FAILURES.inc({"cause": cause})
+        raise RemoteSolverError(cause, detail)
+
+
+class RemoteScheduler:
+    """Per-solve scheduler facade over a SolverClient.
+
+    Holds the same constructor inputs as Scheduler/DeviceScheduler so the
+    greedy fallback is built from the identical world the sidecar saw."""
+
+    def __init__(
+        self,
+        client: SolverClient,
+        nodepools,
+        instance_types: Dict[str, list],
+        existing_nodes=None,
+        daemonset_pods=None,
+        topology=None,
+        device_scheduler_opts: Optional[dict] = None,
+    ):
+        self.client = client
+        self.nodepools = list(nodepools)
+        self.instance_types = instance_types
+        self.existing_nodes = list(existing_nodes or [])
+        self.daemonset_pods = list(daemonset_pods or [])
+        self.topology = topology
+        self.max_slots = (device_scheduler_opts or {}).get("max_slots", 256)
+
+    # -- the solve ---------------------------------------------------------
+
+    def solve(self, pods: List):
+        from karpenter_core_tpu.metrics import wiring as m
+
+        try:
+            with m.SOLVER_RPC_PHASE_DURATION.time({"phase": "encode"}):
+                body = codec.encode_solve_request(
+                    self.nodepools,
+                    self.instance_types,
+                    self.existing_nodes,
+                    self.daemonset_pods,
+                    pods,
+                    topology=self.topology,
+                    max_slots=self.max_slots,
+                )
+            t0 = time.perf_counter()
+            data, kernel = self.client.call("/solve", body)
+            total = time.perf_counter() - t0
+            m.SOLVER_RPC_PHASE_DURATION.observe(kernel, {"phase": "kernel"})
+            m.SOLVER_RPC_PHASE_DURATION.observe(
+                max(total - kernel, 0.0), {"phase": "transit"}
+            )
+            with m.SOLVER_RPC_PHASE_DURATION.time({"phase": "decode"}):
+                wire = codec.decode_solve_results(data)
+                return self._materialize(wire, pods)
+        except RemoteSolverError:
+            m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "solve"})
+            return self._fallback_solve(pods)
+        except (ValueError, KeyError):
+            # malformed response (wire-version skew, truncated body):
+            # degrade like an unreachable sidecar, but count the cause so
+            # persistent skew is distinguishable from a dead process
+            m.SOLVER_RPC_FAILURES.inc({"cause": "decode"})
+            m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "solve"})
+            return self._fallback_solve(pods)
+
+    def _fallback_solve(self, pods: List):
+        """Greedy degradation: the host Scheduler over the same inputs —
+        the cluster keeps provisioning at greedy parity."""
+        from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+            Scheduler,
+        )
+
+        return Scheduler(
+            self.nodepools,
+            self.instance_types,
+            existing_nodes=self.existing_nodes,
+            daemonset_pods=self.daemonset_pods,
+            topology=self.topology,
+        ).solve(pods)
+
+    # -- response materialization -----------------------------------------
+
+    def _materialize(self, wire: dict, pods: List):
+        """Re-bind a wire response to the caller's live objects: pods by
+        uid, instance types by name, nodepools by name. The rebuilt
+        InFlightNodeClaims are indistinguishable from locally-solved ones
+        (provision() and the disruption price filters mutate them)."""
+        from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import (
+            ExistingNodeSim,
+            InFlightNodeClaim,
+        )
+        from karpenter_core_tpu.controllers.provisioning.scheduling.nodeclaimtemplate import (
+            NodeClaimTemplate,
+        )
+        from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+            Results,
+            _daemon_compatible,
+        )
+        from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+            Topology,
+        )
+        from karpenter_core_tpu.utils import resources as resutil
+
+        pods_by_uid = {p.uid: p for p in pods}
+        it_by_name: Dict[str, object] = {}
+        for its in self.instance_types.values():
+            for it in its:
+                it_by_name.setdefault(it.name, it)
+        templates: Dict[str, NodeClaimTemplate] = {}
+        overhead: Dict[str, dict] = {}
+        for np_ in self.nodepools:
+            nct = NodeClaimTemplate.from_nodepool(np_)
+            templates[np_.name] = nct
+            overhead[np_.name] = resutil.requests_for_pods(
+                *[p for p in self.daemonset_pods if _daemon_compatible(nct, p)]
+            )
+
+        errors = dict(wire["errors"])
+        claims = []
+        for c in wire["claims"]:
+            template = templates.get(c["nodepool"])
+            if template is None:  # pool vanished between encode and decode
+                for uid in c["pod_uids"]:
+                    errors[uid] = f"nodepool {c['nodepool']!r} no longer exists"
+                continue
+            options = [
+                it_by_name[n] for n in c["instance_types"] if n in it_by_name
+            ]
+            claim = InFlightNodeClaim(
+                template, Topology(), overhead[c["nodepool"]], options
+            )
+            claim.requirements = c["requirements"]
+            claim.requests = dict(c["requests"])
+            claim.pods = [
+                pods_by_uid[u] for u in c["pod_uids"] if u in pods_by_uid
+            ]
+            claims.append(claim)
+
+        node_by_name = {n.name: n for n in self.existing_nodes}
+        sims = []
+        for e in wire["existing"]:
+            node = node_by_name.get(e["node"])
+            if node is None:
+                continue
+            sim = ExistingNodeSim(node, Topology(), {})
+            sim.pods = [
+                pods_by_uid[u] for u in e["pod_uids"] if u in pods_by_uid
+            ]
+            sims.append(sim)
+        return Results(
+            new_node_claims=claims, existing_nodes=sims, pod_errors=errors
+        )
+
+
+def remote_frontier(
+    client: SolverClient,
+    nodepools,
+    instance_types,
+    cand_nodes,
+    keep_nodes,
+    daemonset_pods,
+    base_pods,
+    candidate_pods,
+    max_slots: int = 1024,
+):
+    """Consolidation prefix sweep over the sidecar seam. Any RPC failure
+    returns None — the caller's host binary search, i.e. greedy-parity
+    degradation for disruption too."""
+    from karpenter_core_tpu.metrics import wiring as m
+
+    try:
+        with m.SOLVER_RPC_PHASE_DURATION.time({"phase": "encode"}):
+            body = codec.encode_frontier_request(
+                nodepools,
+                instance_types,
+                cand_nodes,
+                keep_nodes,
+                daemonset_pods,
+                base_pods,
+                candidate_pods,
+                max_slots=max_slots,
+            )
+        t0 = time.perf_counter()
+        data, kernel = client.call("/consolidate", body)
+        total = time.perf_counter() - t0
+        m.SOLVER_RPC_PHASE_DURATION.observe(kernel, {"phase": "kernel"})
+        m.SOLVER_RPC_PHASE_DURATION.observe(
+            max(total - kernel, 0.0), {"phase": "transit"}
+        )
+        with m.SOLVER_RPC_PHASE_DURATION.time({"phase": "decode"}):
+            return codec.decode_frontier_response(data)
+    except RemoteSolverError:
+        m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "consolidate"})
+        return None
+    except (ValueError, KeyError):
+        m.SOLVER_RPC_FAILURES.inc({"cause": "decode"})
+        m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "consolidate"})
+        return None

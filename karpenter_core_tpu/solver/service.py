@@ -1,0 +1,206 @@
+"""solverd: the TPU solver as a supervised sidecar process.
+
+SURVEY §7 / BASELINE frame the paper's architecture as Go reconcilers
+feeding pod×InstanceType tensor problems to a TPU solver across a process
+boundary; this server IS that boundary's solver side, promoted from the
+codec-only seam (solver/codec.py called itself "the solver's process
+boundary" while nothing served it). It speaks HTTP+npz instead of
+gRPC+proto — same split, stdlib transport (the kube/httpserver.py pattern):
+
+* ``POST /solve``        — full scheduler input -> DeviceScheduler.solve
+* ``POST /consolidate``  — consolidation prefix sweep (frontier_core)
+* ``GET  /healthz``      — liveness + readiness (warm-up finished)
+* ``GET  /metrics``      — the sidecar's own registry, exposition format
+
+Responses carry ``X-Solver-Seconds`` (device solve wall time) so the client
+can split its RPC histogram into transit vs kernel. Boot enables the
+persistent XLA compile cache and optionally pre-warms the common class-count
+shape buckets (the bench restart-probe path), turning the first-batch
+compile cliff into a cache load.
+
+Run: ``python -m karpenter_core_tpu.solver.service --port 0``
+"""
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from karpenter_core_tpu.kube.httpserver import read_body, send_body
+from karpenter_core_tpu.solver import codec
+
+_OCTET = "application/octet-stream"
+
+
+class SolverDaemon:
+    """Request execution, transport-free (tests drive it directly)."""
+
+    def __init__(self):
+        self.ready = False
+        self.solves = 0
+
+    # -- endpoints ---------------------------------------------------------
+
+    def solve(self, body: bytes):
+        """bytes -> (response bytes, solve seconds)."""
+        from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+        problem = codec.decode_solve_request(body)
+        scheduler = DeviceScheduler(
+            problem["nodepools"],
+            problem["instance_types"],
+            existing_nodes=problem["existing_nodes"],
+            daemonset_pods=problem["daemonset_pods"],
+            max_slots=problem["max_slots"],
+            topology=problem["topology"],
+        )
+        t0 = time.perf_counter()
+        results = scheduler.solve(problem["pods"])
+        dt = time.perf_counter() - t0
+        self.solves += 1
+        return codec.encode_solve_results(results, dt), dt
+
+    def consolidate(self, body: bytes):
+        from karpenter_core_tpu.models.consolidation import frontier_core
+
+        req = codec.decode_frontier_request(body)
+        t0 = time.perf_counter()
+        frontier = frontier_core(
+            req["nodepools"],
+            req["instance_types"],
+            req["cand_nodes"],
+            req["keep_nodes"],
+            req["daemonset_pods"],
+            req["base_pods"],
+            req["candidate_pods"],
+            max_slots=req["max_slots"],
+        )
+        dt = time.perf_counter() - t0
+        return codec.encode_frontier_response(frontier), dt
+
+    # -- boot warm-up ------------------------------------------------------
+
+    def warm_up(self, prewarm: bool = False) -> None:
+        """Compile-cache bootstrap: always point XLA's persistent cache at
+        the repo-local directory; with ``prewarm`` also run the synthetic
+        shape-bucket solves so a restarted sidecar serves its first real
+        batch from the jit cache instead of a compile cliff."""
+        from karpenter_core_tpu.utils.jaxenv import (
+            enable_persistent_compile_cache,
+        )
+
+        enable_persistent_compile_cache()
+        if prewarm:
+            from karpenter_core_tpu.api.nodepool import NodePool, NodePoolSpec
+            from karpenter_core_tpu.api.objects import ObjectMeta
+            from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+            from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+            pool = NodePool(metadata=ObjectMeta(name="prewarm"))
+            pool.spec = NodePoolSpec()
+            catalog = build_catalog(cpu_grid=[1, 2, 4, 8], mem_factors=[2, 4])
+            DeviceScheduler(
+                [pool], {"prewarm": catalog}, max_slots=256
+            ).prewarm()
+        self.ready = True
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "karpenter-solverd/1"
+    daemon: SolverDaemon
+
+    def log_message(self, *args) -> None:  # quiet
+        pass
+
+    def do_GET(self) -> None:
+        path = self.path.split("?")[0]
+        if path == "/healthz":
+            ok = self.daemon.ready
+            send_body(
+                self,
+                200 if ok else 503,
+                (b'{"ok": true}' if ok else b'{"ok": false}'),
+            )
+        elif path == "/metrics":
+            from karpenter_core_tpu.metrics.registry import REGISTRY
+
+            send_body(
+                self, 200, REGISTRY.render().encode(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+        else:
+            send_body(self, 404, b'{"error": "not found"}')
+
+    def do_POST(self) -> None:
+        path = self.path.split("?")[0]
+        body = read_body(self)
+        try:
+            if path == "/solve":
+                out, dt = self.daemon.solve(body)
+            elif path == "/consolidate":
+                out, dt = self.daemon.consolidate(body)
+            else:
+                return send_body(self, 404, b'{"error": "not found"}')
+        except Exception as e:
+            return send_body(
+                self, 500, repr(e).encode(), ctype="text/plain"
+            )
+        send_body(
+            self, 200, out, _OCTET, headers={"X-Solver-Seconds": f"{dt:.6f}"}
+        )
+
+
+def serve(
+    port: int,
+    host: str = "127.0.0.1",
+    daemon: SolverDaemon = None,
+    ready: bool = True,
+) -> ThreadingHTTPServer:
+    """Serve solverd on host:port in a daemon thread; returns the server
+    (port 0 picks a free one — server_address[1]). ``ready=True`` marks the
+    daemon ready immediately (in-thread test servers skip warm-up)."""
+    d = daemon or SolverDaemon()
+    if ready:
+        d.ready = True
+    handler = type("BoundSolverd", (_Handler,), {"daemon": d})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd.daemon_ = d
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="karpenter TPU solver sidecar")
+    ap.add_argument("--port", type=int, default=8181)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument(
+        "--prewarm", action="store_true",
+        help="compile the common shape buckets before serving traffic",
+    )
+    args = ap.parse_args()
+
+    daemon = SolverDaemon()
+    httpd = serve(args.port, host=args.host, daemon=daemon, ready=False)
+    # the supervisor (solver/supervisor.py) reads this line to learn the
+    # bound address — same handshake as kube/httpserver.py
+    print(
+        f"listening on {httpd.server_address[0]}:{httpd.server_address[1]}",
+        flush=True,
+    )
+    daemon.warm_up(prewarm=args.prewarm)
+    print("ready", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

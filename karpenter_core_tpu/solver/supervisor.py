@@ -1,0 +1,178 @@
+"""Sidecar lifecycle supervision: spawn, monitor, restart with backoff.
+
+The operator owns one SolverSupervisor when ``--solver-mode=sidecar`` runs
+without an external ``--solver-addr``: it spawns
+``python -m karpenter_core_tpu.solver.service`` as a child process, learns
+the bound address from the child's ``listening on host:port`` handshake
+line (the kube/httpserver.py pattern), and on every reconcile pass checks
+the child is alive — a dead child respawns under exponential backoff so a
+crash-looping solver cannot busy-spin the operator, and every respawn is
+surfaced through the ``on_event`` hook (the operator wires it to the event
+recorder as a "sidecar unavailable"/"restarted" condition) plus the
+``solver_sidecar_restarts_total`` counter.
+
+The command is injectable so tests supervise a stub child; the default
+spawns the real solverd module.
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, List, Optional
+
+
+def default_command(port: int, prewarm: bool = False) -> List[str]:
+    cmd = [
+        sys.executable,
+        "-m",
+        "karpenter_core_tpu.solver.service",
+        "--port",
+        str(port),
+    ]
+    if prewarm:
+        cmd.append("--prewarm")
+    return cmd
+
+
+class SolverSupervisor:
+    def __init__(
+        self,
+        command: Optional[List[str]] = None,
+        port: int = 0,
+        prewarm: bool = False,
+        backoff_initial: float = 1.0,
+        backoff_max: float = 30.0,
+        stable_window: float = 60.0,
+        spawn_timeout: float = 60.0,
+        time_fn=time.monotonic,
+        on_event: Optional[Callable[[str, str], None]] = None,
+    ):
+        self.command = command or default_command(port, prewarm)
+        self.backoff_initial = backoff_initial
+        self.backoff_max = backoff_max
+        # deadline on the handshake line: a child that wedges before
+        # printing it must not hang the operator's reconcile loop
+        self.spawn_timeout = spawn_timeout
+        # a child must stay up this long before the backoff resets — a
+        # crash-looping sidecar (spawns fine, dies seconds later) must not
+        # re-earn an immediate respawn on every death
+        self.stable_window = stable_window
+        self.time_fn = time_fn
+        self.on_event = on_event
+        self.proc: Optional[subprocess.Popen] = None
+        self.addr: str = ""
+        self.restarts = 0
+        # delay before the NEXT respawn attempt: 0 after a stable run (the
+        # first restart is immediate), then backoff_initial doubling per
+        # attempt while the child keeps dying, capped at backoff_max
+        self._delay = 0.0
+        self._next_spawn_at = 0.0
+        self._down_since: Optional[float] = None
+        self._last_spawn_at = 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _emit(self, reason: str, message: str) -> None:
+        if self.on_event is not None:
+            self.on_event(reason, message)
+
+    def _spawn(self) -> str:
+        self._last_spawn_at = self.time_fn()
+        self.proc = subprocess.Popen(
+            self.command,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        # handshake: the child prints "listening on host:port" once bound
+        # (before any heavy warm-up, so this resolves in import time, not
+        # compile time). The read runs under a deadline — a child that
+        # wedges pre-handshake (stuck import, held compile-cache lock)
+        # raises here instead of hanging reconcile; poll() turns that into
+        # backoff + an event, and provisioning keeps degrading to greedy.
+        got: List[str] = []
+        reader = threading.Thread(
+            target=lambda: got.append(self.proc.stdout.readline()),
+            daemon=True,
+        )
+        reader.start()
+        reader.join(self.spawn_timeout)
+        line = got[0] if got else ""
+        if "listening on" not in line:
+            self.proc.kill()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+            raise RuntimeError(
+                "sidecar failed to start ("
+                + (f"got {line!r}" if got else
+                   f"no handshake within {self.spawn_timeout}s")
+                + f" from {self.command!r})"
+            )
+        self.addr = line.strip().rsplit(" ", 1)[-1]
+        return self.addr
+
+    def start(self) -> str:
+        """Spawn the sidecar; returns its host:port address."""
+        return self._spawn()
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def poll(self) -> bool:
+        """One supervision pass: respawn a dead child once its backoff
+        window has elapsed. Returns True when a restart happened (the
+        caller re-points its SolverClient at the possibly-new address)."""
+        if self.proc is None:
+            return False
+        now = self.time_fn()
+        if self.alive():
+            if self._delay and now - self._last_spawn_at >= self.stable_window:
+                self._delay = 0.0
+            return False
+        if self._down_since is None:
+            self._down_since = now
+            # the accumulated delay survives a "successful" spawn that dies
+            # again seconds later — only stability resets it
+            self._next_spawn_at = now + self._delay
+            self._emit(
+                "SidecarUnavailable",
+                f"solver sidecar exited with code {self.proc.returncode}",
+            )
+        if now < self._next_spawn_at:
+            return False
+        self._delay = min(
+            max(self._delay * 2, self.backoff_initial), self.backoff_max
+        )
+        try:
+            self._spawn()
+        except (OSError, RuntimeError) as e:
+            self._next_spawn_at = now + self._delay
+            self._emit("SidecarRestartFailed", str(e))
+            return False
+        from karpenter_core_tpu.metrics import wiring as m
+
+        m.SOLVER_SIDECAR_RESTARTS.inc()
+        self.restarts += 1
+        self._down_since = None
+        self._emit(
+            "SidecarRestarted", f"solver sidecar respawned on {self.addr}"
+        )
+        return True
+
+    def stop(self) -> None:
+        if self.proc is None:
+            return
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+        if self.proc.stdout is not None:
+            self.proc.stdout.close()
+        self.proc = None

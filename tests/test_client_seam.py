@@ -50,6 +50,35 @@ class TestKubeClientProtocol:
         assert all(p.node_name for p in op.kube.list_pods())
 
 
+class TestSerialContainers:
+    def test_frozenset_roundtrips_hashable(self):
+        """serial.py's docstring promise: frozen dataclass fields stay
+        hashable through the wire — frozenset must NOT decode to set."""
+        from karpenter_core_tpu.kube import serial
+
+        value = frozenset({"a", "b"})
+        decoded = serial.decode(serial.encode(value))
+        assert decoded == value
+        assert isinstance(decoded, frozenset)
+        hash(decoded)  # the actual contract: usable as a dict key
+        # plain sets keep their own tag (mutable on arrival)
+        plain = serial.decode(serial.encode({"x", "y"}))
+        assert plain == {"x", "y"}
+        assert isinstance(plain, set) and not isinstance(plain, frozenset)
+
+    def test_frozen_dataclass_field_roundtrip(self):
+        # NodeSelectorRequirement is the frozen in-tree carrier: its values
+        # ride as a tuple; frozensets inside registered objects must come
+        # back frozen too
+        from karpenter_core_tpu.api.objects import NodeSelectorRequirement
+        from karpenter_core_tpu.kube import serial
+
+        req = NodeSelectorRequirement("zone", "In", ("a", "b"))
+        back = serial.decode(serial.encode(req))
+        assert back == req
+        hash(back)
+
+
 class TestSnapshotCodec:
     def test_request_roundtrip(self):
         from karpenter_core_tpu.cloudprovider.kwok import build_catalog

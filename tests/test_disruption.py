@@ -489,4 +489,39 @@ class TestSingleNodeBounding:
         method.compute_consolidation = cheap
         method.compute_command(BudgetMapping({}), self._candidates(4))
         assert evaluated == ["n0", "n1", "n2", "n3"]
-        assert method._cursor == 0
+        assert method._resume_key is None
+
+    def test_cursor_survives_candidate_churn(self):
+        """The resume cursor anchors to a stable key (candidate name /
+        cost), not an index into the re-sorted list: churn ahead of the
+        cursor must not restart the sweep at the cheap prefix and starve
+        the tail."""
+        from karpenter_core_tpu.controllers.disruption.helpers import (
+            BudgetMapping,
+        )
+
+        clock = FakeClock()
+        method, evaluated = self._method(clock)
+        cands = self._candidates(10)
+        # poll 1: evaluates n0, n1; resume key -> n2
+        method.compute_command(BudgetMapping({}), cands)
+        assert evaluated == ["n0", "n1"]
+        assert method._resume_key == ("n2", 2.0)
+        # churn: n0 was consolidated away and two NEW cheap candidates
+        # appear ahead of the cursor — an index-based cursor (2) would now
+        # point at n1 and re-evaluate the head
+        survivors = [c for c in cands if c.state_node.name != "n0"]
+        fresh = self._candidates(2)
+        for i, c in enumerate(fresh):
+            c.state_node.name = f"fresh{i}"
+            c.disruption_cost = 0.25 * (i + 1)
+        churned = fresh + survivors
+        method.compute_command(BudgetMapping({}), churned)
+        # poll 2 resumes AT n2 — the remembered name — then walks the tail
+        assert evaluated == ["n0", "n1", "n2", "n3"]
+        # churn away the remembered candidate itself: resume falls back to
+        # the first candidate at/after its remembered cost (n4 at 4.0)
+        assert method._resume_key == ("n4", 4.0)
+        survivors = [c for c in churned if c.state_node.name != "n4"]
+        method.compute_command(BudgetMapping({}), survivors)
+        assert evaluated == ["n0", "n1", "n2", "n3", "n5", "n6"]

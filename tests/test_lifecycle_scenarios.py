@@ -347,6 +347,39 @@ class TestDriftScenarios:
         op.nodeclaim_disruption.reconcile(claim)
         assert not claim.conditions.is_true("Drifted")
 
+    def test_hash_version_migration_keeps_drifted_claims_drifted(self):
+        """hash/controller.go:102-113: a claim already marked Drifted keeps
+        its STALE HASH through the version migration (re-stamping would
+        erase the real config difference) but still gets the new hash
+        VERSION — otherwise the version gate would un-drift it forever."""
+        op, pool, claim = self._op()
+        self._mutate_pool(op, pool)
+        op.nodeclaim_disruption.reconcile(claim)
+        assert claim.conditions.is_true("Drifted")
+        stale_hash = claim.metadata.annotations[L.NODEPOOL_HASH_ANNOTATION_KEY]
+        # a hash-version rollout lands while the claim is Drifted
+        claim.metadata.annotations[
+            L.NODEPOOL_HASH_VERSION_ANNOTATION_KEY
+        ] = "v1-legacy"
+        pool.metadata.annotations[
+            L.NODEPOOL_HASH_VERSION_ANNOTATION_KEY
+        ] = "v1-legacy"
+        op.nodepool_hash.reconcile(pool)
+        # hash NOT re-stamped (the drift evidence survives) ...
+        assert (
+            claim.metadata.annotations[L.NODEPOOL_HASH_ANNOTATION_KEY]
+            == stale_hash
+        )
+        # ... but the VERSION is migrated, so the drift check still fires
+        from karpenter_core_tpu.api.labels import HASH_VERSION
+
+        assert (
+            claim.metadata.annotations[L.NODEPOOL_HASH_VERSION_ANNOTATION_KEY]
+            == HASH_VERSION
+        )
+        op.nodeclaim_disruption.reconcile(claim)
+        assert claim.conditions.is_true("Drifted")
+
     def test_drift_clears_when_pool_reverts(self):
         op, pool, claim = self._op()
         self._mutate_pool(op, pool)

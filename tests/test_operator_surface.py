@@ -44,6 +44,29 @@ class TestOptionsParse:
         with pytest.raises(ValueError):
             Options.parse(["--solver", "quantum"], env={})
 
+    def test_solver_mode_flags(self):
+        o = Options.parse([], env={})
+        assert o.solver_mode == "inproc"
+        assert o.solver_addr == "" and o.solver_timeout == 30.0
+        o = Options.parse(
+            ["--solver", "tpu", "--solver-mode", "sidecar",
+             "--solver-addr=127.0.0.1:8181", "--solver-timeout", "5"],
+            env={},
+        )
+        assert o.solver_mode == "sidecar"
+        assert o.solver_addr == "127.0.0.1:8181"
+        assert o.solver_timeout == 5.0
+        assert Options.parse(
+            [],
+            env={"KARPENTER_SOLVER": "tpu",
+                 "KARPENTER_SOLVER_MODE": "sidecar"},
+        ).solver_mode == "sidecar"
+        with pytest.raises(ValueError):
+            Options.parse(["--solver-mode", "carrier-pigeon"], env={})
+        # sidecar without the tpu solver would silently run greedy in-proc
+        with pytest.raises(ValueError):
+            Options.parse(["--solver-mode", "sidecar"], env={})
+
     def test_unknown_flag_rejected(self):
         # a typo'd flag must error, not silently swallow the next flag
         with pytest.raises(ValueError):

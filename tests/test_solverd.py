@@ -1,0 +1,570 @@
+"""solverd: the out-of-process TPU solver sidecar.
+
+Three layers of proof:
+
+* the wire codec round-trips the FULL scheduler input (object identity,
+  volume state, topology context) and its results;
+* conformance — one shared solve battery produces identical outcomes with
+  ``--solver-mode=inproc`` and ``--solver-mode=sidecar``, including a
+  test_e2e-style operator run and the consolidation sweep over the same
+  seam;
+* degradation — a killed and (separately) hung sidecar falls back to the
+  host greedy path within the deadline with the fallback/circuit metrics
+  incrementing, and a supervisor respawn resumes the device path without
+  an operator restart.
+"""
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tests.helpers import make_nodepool, make_pod
+
+from karpenter_core_tpu.api.objects import OwnerReference, Pod
+from karpenter_core_tpu.cloudprovider.fake import fake_instance_types
+from karpenter_core_tpu.cloudprovider.kwok import KwokCloudProvider, build_catalog
+from karpenter_core_tpu.kube.store import KubeStore
+from karpenter_core_tpu.metrics import wiring as m
+from karpenter_core_tpu.operator import Operator, Options
+from karpenter_core_tpu.solver import codec, remote, service
+from karpenter_core_tpu.solver.remote import (
+    CircuitBreaker,
+    FaultInjector,
+    RemoteScheduler,
+    RemoteSolverError,
+    SolverClient,
+    STATE_CLOSED,
+    STATE_OPEN,
+)
+from karpenter_core_tpu.utils.clock import FakeClock
+
+CATALOG = build_catalog(cpu_grid=[1, 2, 4, 8], mem_factors=[2, 4])
+
+
+@pytest.fixture(scope="module")
+def sidecar():
+    """One in-thread solverd for the module (the jit cache is process-global
+    anyway; per-test servers only add socket churn)."""
+    srv = service.serve(0)
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def sidecar_addr(srv) -> str:
+    return f"127.0.0.1:{srv.server_address[1]}"
+
+
+def replicated(pod: Pod) -> Pod:
+    pod.metadata.owner_references.append(
+        OwnerReference(kind="ReplicaSet", name="rs", uid="rs-uid")
+    )
+    return pod
+
+
+def new_operator(mode: str, addr: str = "", **opt_kwargs) -> Operator:
+    clock = FakeClock()
+    kube = KubeStore(clock)
+    return Operator(
+        kube=kube,
+        cloud_provider=KwokCloudProvider(kube, CATALOG),
+        clock=clock,
+        options=Options(
+            solver="tpu", solver_mode=mode, solver_addr=addr, **opt_kwargs
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+
+class TestWireCodec:
+    def _problem(self):
+        from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import (
+            SimNode,
+        )
+        from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+            Topology,
+        )
+        from karpenter_core_tpu.scheduling.volumeusage import VolumeUsage
+
+        pools = [make_nodepool(), make_nodepool(name="batch", weight=10)]
+        catalog = fake_instance_types(4)
+        # the same IT objects serve both pools: identity must survive
+        instance_types = {"default": catalog, "batch": catalog[:2]}
+        vu = VolumeUsage()
+        vu.add_limit("ebs.csi", 4)
+        vu.add({"ebs.csi": {"default/pvc-a"}})
+        nodes = [
+            SimNode(
+                name="existing-0",
+                labels={"karpenter.sh/nodepool": "default"},
+                taints=[],
+                available={"cpu": 3.0, "memory": 8.0 * 2**30},
+                capacity={"cpu": 4.0, "memory": 16.0 * 2**30},
+                daemon_requests={"cpu": 0.1},
+                initialized=True,
+                nodeclaim_name="claim-0",
+                nodepool_name="default",
+                volume_usage=vu,
+            )
+        ]
+        bound = make_pod(cpu=0.5, name="bound-0")
+        topo = Topology(
+            domains={"topology.kubernetes.io/zone": {"z1", "z2"}},
+            existing_pods=[(bound, {"kubernetes.io/hostname": "existing-0"},
+                            "existing-0")],
+            excluded_pod_uids={"uid-x"},
+        )
+        pods = [make_pod(cpu=1.0, name=f"p{i}") for i in range(3)]
+        return pools, instance_types, nodes, pods, topo
+
+    def test_solve_request_roundtrip(self):
+        pools, instance_types, nodes, pods, topo = self._problem()
+        data = codec.encode_solve_request(
+            pools, instance_types, nodes, [], pods,
+            topology=topo, max_slots=512,
+        )
+        back = codec.decode_solve_request(data)
+        assert [p.name for p in back["nodepools"]] == ["default", "batch"]
+        assert back["nodepools"][1].spec.weight == 10
+        assert back["max_slots"] == 512
+        # instance-type identity: shared objects decode to ONE object
+        its = back["instance_types"]
+        assert [it.name for it in its["default"]][:2] == [
+            it.name for it in its["batch"]
+        ]
+        assert its["default"][0] is its["batch"][0]
+        assert its["default"][0].offerings[0].zone == "test-zone-1"
+        # SimNode + volume state
+        (node,) = back["existing_nodes"]
+        assert node.name == "existing-0"
+        assert node.volume_usage.limits == {"ebs.csi": 4}
+        assert node.volume_usage.volumes == {"ebs.csi": {"default/pvc-a"}}
+        # topology context
+        t = back["topology"]
+        assert t.domains["topology.kubernetes.io/zone"] == {"z1", "z2"}
+        assert t.excluded_pods == {"uid-x"}
+        [(pod, labels, name)] = t.existing_pods
+        assert (pod.metadata.name, name) == ("bound-0", "existing-0")
+        assert [p.uid for p in back["pods"]] == [p.uid for p in pods]
+
+    def test_requirements_decode_preserves_semantics(self):
+        from karpenter_core_tpu.scheduling import Requirement, Requirements
+
+        reqs = Requirements([
+            Requirement.new("zone", "In", ["a", "b"]),
+            Requirement.new("tier", "NotIn", ["gpu"]),
+            Requirement.new("gen", "Gt", ["3"]),
+        ])
+        back = codec._decode_reqs(codec._encode_reqs(reqs))
+        for key in reqs:
+            assert back[key].complement == reqs[key].complement
+            assert back[key].values == reqs[key].values
+            assert back[key].greater_than == reqs[key].greater_than
+
+    def test_frontier_response_roundtrip(self):
+        frontier = [(True, 0, 0.0), (False, 2, 1.5), (True, 1, 0.25)]
+        assert codec.decode_frontier_response(
+            codec.encode_frontier_response(frontier)
+        ) == frontier
+        assert codec.decode_frontier_response(
+            codec.encode_frontier_response(None)
+        ) is None
+
+
+# ---------------------------------------------------------------------------
+# conformance: one battery, both modes
+# ---------------------------------------------------------------------------
+
+
+def _run_battery(op: Operator) -> dict:
+    """The shared solve battery: plain pods, selector-pinned pods, then a
+    second wave that must reuse the existing capacity."""
+    op.kube.create(make_nodepool())
+    for i in range(6):
+        op.kube.create(replicated(make_pod(cpu=1.5, name=f"plain{i}")))
+    for i in range(2):
+        op.kube.create(replicated(make_pod(
+            cpu=0.5, name=f"zonal{i}", zone_in=["zone-b"],
+        )))
+    op.run_until_idle(disrupt=False)
+    first_nodes = len(op.kube.list_nodes())
+    # second wave: small pods that fit into the launched capacity
+    for i in range(2):
+        op.kube.create(replicated(make_pod(cpu=0.25, name=f"late{i}")))
+    op.run_until_idle(disrupt=False)
+    pods = op.kube.list_pods()
+    nodes = op.kube.list_nodes()
+    return {
+        "bound": sorted(p.metadata.name for p in pods if p.node_name),
+        "unbound": sorted(p.metadata.name for p in pods if not p.node_name),
+        "first_nodes": first_nodes,
+        "nodes": len(nodes),
+        "zonal_zone": sorted({
+            n.metadata.labels.get("topology.kubernetes.io/zone")
+            for n in nodes
+            for p in pods
+            if p.node_name == n.name and p.metadata.name.startswith("zonal")
+        }),
+    }
+
+
+class TestConformance:
+    def test_battery_identical_inproc_vs_sidecar(self, sidecar):
+        inproc = _run_battery(new_operator("inproc"))
+        solves_before = sidecar.daemon_.solves
+        fallbacks_before = m.SOLVER_RPC_FALLBACKS.value({"endpoint": "solve"})
+        remote_ = _run_battery(
+            new_operator("sidecar", addr=sidecar_addr(sidecar))
+        )
+        assert remote_ == inproc
+        assert inproc["unbound"] == []
+        assert inproc["zonal_zone"] == ["zone-b"]
+        # the sidecar actually served every solve (no silent fallback)
+        assert sidecar.daemon_.solves > solves_before
+        assert m.SOLVER_RPC_FALLBACKS.value(
+            {"endpoint": "solve"}
+        ) == fallbacks_before
+
+    def test_direct_results_parity(self, sidecar):
+        """RemoteScheduler's materialized Results match DeviceScheduler's
+        structurally: same pod->group assignment, instance options, errors."""
+        from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+        pools = [make_nodepool()]
+        catalog = fake_instance_types(5)
+        pods = [make_pod(cpu=1.0, name=f"p{i}") for i in range(10)]
+        pods += [make_pod(cpu=64.0, name="whale")]  # unschedulable
+        local = DeviceScheduler(pools, {"default": catalog}).solve(pods)
+        client = SolverClient(sidecar_addr(sidecar), timeout=120)
+        rs = RemoteScheduler(client, pools, {"default": catalog})
+        over_wire = rs.solve(pods)
+
+        def shape(results):
+            return {
+                "groups": sorted(
+                    tuple(sorted(p.metadata.name for p in c.pods))
+                    for c in results.new_node_claims
+                ),
+                "options": sorted(
+                    tuple(sorted(it.name for it in c.instance_type_options))
+                    for c in results.new_node_claims
+                ),
+                "errors": set(results.pod_errors),
+            }
+
+        assert shape(over_wire) == shape(local)
+        # materialized claims are bound to the CALLER's objects
+        claim = over_wire.new_node_claims[0]
+        assert all(it in catalog for it in claim.instance_type_options)
+        assert all(p in pods for p in claim.pods)
+
+    def test_consolidation_sweep_over_sidecar(self, sidecar):
+        """Multi-node consolidation's device frontier crosses the RPC seam
+        in sidecar mode and reaches the same decision as inproc."""
+
+        def run(mode, addr=""):
+            op = new_operator(mode, addr=addr)
+            op.kube.create(make_nodepool())
+            for i in range(4):
+                op.kube.create(replicated(make_pod(cpu=1.2, name=f"c{i}")))
+            op.run_until_idle(disrupt=False)
+            # shrink the workload so the nodes consolidate
+            for i in range(2):
+                pod = op.kube.get(Pod, f"c{i}")
+                pod.metadata.owner_references = []
+                op.kube.delete(pod)
+            op.clock.step(1.0)
+            op.run_until_idle()
+            return {
+                "nodes": len(op.kube.list_nodes()),
+                "bound": all(p.node_name for p in op.kube.list_pods()),
+            }
+
+        inproc = run("inproc")
+        remote_ = run("sidecar", addr=sidecar_addr(sidecar))
+        assert remote_ == inproc
+
+    def test_e2e_operator_over_spawned_sidecar(self):
+        """test_e2e-style run with the REAL subprocess sidecar under the
+        supervisor (solver_addr empty -> the operator spawns and owns it)."""
+        op = new_operator("sidecar")
+        try:
+            assert op.solver_supervisor is not None
+            assert op.solver_supervisor.alive()
+            op.kube.create(make_nodepool())
+            for i in range(3):
+                op.kube.create(replicated(make_pod(cpu=2.0, name=f"e{i}")))
+            op.run_until_idle(disrupt=False)
+            assert all(p.node_name for p in op.kube.list_pods())
+            assert op.kube.list_nodes()
+        finally:
+            op.shutdown()
+        assert not op.solver_supervisor.alive()
+
+
+# ---------------------------------------------------------------------------
+# degradation: kill, hang, breaker, supervised restart
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _HangingHandler(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def do_POST(self):
+        time.sleep(3.0)  # far past any client deadline used below
+
+
+class TestDegradation:
+    def test_dead_sidecar_degrades_to_greedy(self):
+        """Kill shape: connection refused -> greedy fallback within the
+        deadline; fallback + failure counters increment."""
+        port = _free_port()  # nothing listens here
+        client = SolverClient(
+            f"127.0.0.1:{port}", timeout=0.5, max_retries=1, sleep=lambda s: None
+        )
+        pools = [make_nodepool()]
+        pods = [make_pod(cpu=1.0, name=f"p{i}") for i in range(4)]
+        rs = RemoteScheduler(client, pools, {"default": fake_instance_types(3)})
+        fallbacks = m.SOLVER_RPC_FALLBACKS.value({"endpoint": "solve"})
+        failures = m.SOLVER_RPC_FAILURES.value({"cause": "error"})
+        t0 = time.perf_counter()
+        results = rs.solve(pods)
+        elapsed = time.perf_counter() - t0
+        assert results.all_pods_scheduled()
+        assert results.new_node_claims  # greedy placed them
+        assert elapsed < 5.0
+        assert m.SOLVER_RPC_FALLBACKS.value({"endpoint": "solve"}) == fallbacks + 1
+        assert m.SOLVER_RPC_FAILURES.value({"cause": "error"}) == failures + 1
+
+    def test_hung_sidecar_times_out_to_greedy(self):
+        """Hang shape: the server accepts and never answers — the read
+        deadline fires and the solve degrades within the budget."""
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), _HangingHandler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            client = SolverClient(
+                f"127.0.0.1:{httpd.server_address[1]}",
+                timeout=0.3, max_retries=0,
+            )
+            rs = RemoteScheduler(
+                client, [make_nodepool()], {"default": fake_instance_types(3)}
+            )
+            timeouts = m.SOLVER_RPC_FAILURES.value({"cause": "timeout"})
+            t0 = time.perf_counter()
+            results = rs.solve([make_pod(cpu=1.0, name="h0")])
+            elapsed = time.perf_counter() - t0
+            assert results.all_pods_scheduled()
+            assert elapsed < 2.0  # deadline + fallback, not the 3s hang
+            assert m.SOLVER_RPC_FAILURES.value(
+                {"cause": "timeout"}
+            ) == timeouts + 1
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_injected_faults_then_recovery(self, sidecar):
+        """Scripted faults (the fake.py pattern): two consecutive failed
+        solves trip the breaker; while open, solves short-circuit to greedy
+        without touching the wire; after the cooldown the half-open probe
+        heals it and the device path resumes."""
+        now = [0.0]
+        breaker = CircuitBreaker(
+            failure_threshold=2, cooldown=10.0, time_fn=lambda: now[0]
+        )
+        injector = FaultInjector(["error", "error", "error", "error"])
+        client = SolverClient(
+            sidecar_addr(sidecar), timeout=60, max_retries=1,
+            breaker=breaker, fault_injector=injector, sleep=lambda s: None,
+        )
+        pools = [make_nodepool()]
+        rs = RemoteScheduler(client, pools, {"default": fake_instance_types(3)})
+        pods = [make_pod(cpu=1.0, name=f"f{i}") for i in range(2)]
+
+        # solves 1+2: every attempt injected-fails; both degrade to greedy,
+        # and the second consecutive call failure opens the breaker
+        assert rs.solve(pods).all_pods_scheduled()
+        assert breaker.state == STATE_CLOSED and breaker.failures == 1
+        assert rs.solve(pods).all_pods_scheduled()
+        assert breaker.state == STATE_OPEN
+        assert m.SOLVER_CIRCUIT_STATE.value() == float(STATE_OPEN)
+
+        # solve 3: circuit open -> fast-fail, no transport, injector unused
+        calls_before = injector.calls
+        open_failures = m.SOLVER_RPC_FAILURES.value({"cause": "circuit_open"})
+        assert rs.solve(pods).all_pods_scheduled()
+        assert injector.calls == calls_before
+        assert m.SOLVER_RPC_FAILURES.value(
+            {"cause": "circuit_open"}
+        ) == open_failures + 1
+
+        # cooldown elapses; the schedule is exhausted (healthy transport):
+        # the half-open probe succeeds and closes the circuit
+        now[0] = 11.0
+        injector.schedule.clear()
+        solves_before = sidecar.daemon_.solves
+        results = rs.solve(pods)
+        assert results.all_pods_scheduled()
+        assert breaker.state == STATE_CLOSED
+        assert sidecar.daemon_.solves == solves_before + 1  # device path
+
+    def test_half_open_probe_failure_reopens(self):
+        now = [0.0]
+        breaker = CircuitBreaker(
+            failure_threshold=1, cooldown=5.0, time_fn=lambda: now[0]
+        )
+        port = _free_port()
+        client = SolverClient(
+            f"127.0.0.1:{port}", timeout=0.2, max_retries=3,
+            breaker=breaker, sleep=lambda s: None,
+        )
+        with pytest.raises(RemoteSolverError):
+            client.call("/solve", b"x")
+        assert breaker.state == STATE_OPEN
+        now[0] = 6.0
+        with pytest.raises(RemoteSolverError):
+            client.call("/solve", b"x")  # half-open probe: ONE attempt only
+        assert breaker.state == STATE_OPEN
+
+    def test_kill_fallback_then_supervised_restart_resumes_device(self):
+        """The acceptance shape end-to-end: the sidecar dies mid-stream ->
+        provisioning completes via greedy fallback within the deadline; the
+        supervisor respawns it and the device path resumes through the NEW
+        process, no operator restart."""
+        op = new_operator("sidecar", batch_idle_duration=0.0)
+        try:
+            sup = op.solver_supervisor
+            assert sup is not None
+            # cheap failures for the test
+            op.solver_client.timeout = 1.0
+            op.solver_client.max_retries = 0
+            op.solver_client.sleep = lambda s: None
+            op.kube.create(make_nodepool())
+            op.kube.create(replicated(make_pod(cpu=1.0, name="w0")))
+            op.run_until_idle(disrupt=False)
+            assert all(p.node_name for p in op.kube.list_pods())
+
+            # kill the sidecar; hold the supervisor's backoff window open so
+            # the next solve genuinely runs against a dead process
+            sup._delay = 9999.0
+            sup.proc.kill()
+            sup.proc.wait(timeout=10)
+            fallback_before = m.SOLVER_RPC_FALLBACKS.value(
+                {"endpoint": "solve"}
+            )
+            op.kube.create(replicated(make_pod(cpu=1.0, name="w1")))
+            t0 = time.perf_counter()
+            op.run_until_idle(disrupt=False)
+            elapsed = time.perf_counter() - t0
+            # provisioning completed via greedy degradation, within deadline
+            assert all(p.node_name for p in op.kube.list_pods())
+            assert elapsed < 30.0
+            assert m.SOLVER_RPC_FALLBACKS.value(
+                {"endpoint": "solve"}
+            ) > fallback_before
+            assert not sup.alive()
+            assert op.recorder.with_reason("SidecarUnavailable")
+
+            # open the restart window: the supervisor respawns on the next
+            # reconcile and the client follows the fresh address. Restore a
+            # real deadline first — the fresh process pays jax import on its
+            # first solve, which the 1s kill-phase timeout would misread as
+            # a hang
+            op.solver_client.timeout = 120.0
+            restarts_before = sup.restarts
+            sup._delay = 0.0
+            sup._next_spawn_at = 0.0
+            op.kube.create(replicated(make_pod(cpu=1.0, name="w2")))
+            op.run_until_idle(disrupt=False)
+            assert sup.restarts == restarts_before + 1
+            assert sup.alive()
+            assert op.solver_client.addr == sup.addr
+            assert op.recorder.with_reason("SidecarRestarted")
+            assert m.SOLVER_SIDECAR_RESTARTS.value() >= 1
+            assert all(p.node_name for p in op.kube.list_pods())
+            # device path resumed: later solves record no new fallbacks
+            fallback_after = m.SOLVER_RPC_FALLBACKS.value(
+                {"endpoint": "solve"}
+            )
+            op.kube.create(replicated(make_pod(cpu=1.0, name="w3")))
+            op.run_until_idle(disrupt=False)
+            assert all(p.node_name for p in op.kube.list_pods())
+            assert m.SOLVER_RPC_FALLBACKS.value(
+                {"endpoint": "solve"}
+            ) == fallback_after
+        finally:
+            op.shutdown()
+
+
+class TestSupervisor:
+    STUB = (
+        "import sys, time; print('listening on 127.0.0.1:1', flush=True); "
+        "time.sleep(3600)"
+    )
+    CRASHER = "print('listening on 127.0.0.1:1', flush=True)"
+
+    def _sup(self, code, **kwargs):
+        import sys
+
+        from karpenter_core_tpu.solver.supervisor import SolverSupervisor
+
+        return SolverSupervisor(
+            command=[sys.executable, "-u", "-c", code], **kwargs
+        )
+
+    def test_restart_with_backoff_on_crash_loop(self):
+        now = [0.0]
+        events = []
+        sup = self._sup(
+            self.CRASHER,
+            backoff_initial=2.0,
+            time_fn=lambda: now[0],
+            on_event=lambda r, msg: events.append(r),
+        )
+        sup.start()
+        sup.proc.wait(timeout=10)  # the crasher exits immediately
+        assert sup.poll()  # first respawn is immediate
+        assert "SidecarUnavailable" in events and "SidecarRestarted" in events
+        sup.proc.wait(timeout=10)
+        # second respawn must wait out the grown 2s backoff window
+        assert not sup.poll()
+        now[0] += 1.9
+        assert not sup.poll()
+        now[0] += 0.2
+        assert sup.poll()
+        assert sup.restarts == 2
+        sup.stop()
+
+    def test_stable_child_resets_backoff(self):
+        now = [0.0]
+        sup = self._sup(
+            self.STUB,
+            backoff_initial=1.0,
+            stable_window=5.0,
+            time_fn=lambda: now[0],
+        )
+        sup.start()
+        sup._delay = 8.0  # pretend it crash-looped earlier
+        now[0] = 6.0
+        assert not sup.poll()  # alive; stability window elapsed
+        assert sup._delay == 0.0
+        sup.stop()
+
+    def test_handshake_failure_raises(self):
+        sup = self._sup("print('nope', flush=True)")
+        with pytest.raises(RuntimeError):
+            sup.start()
