@@ -292,6 +292,51 @@ def _solve_bench(pods, nodepools, catalog, max_slots=1024, repeats=5,
     return out
 
 
+def _ice_storm_bench(n_pods=5000, n_types=400, fractions=(0.0, 0.25, 0.5),
+                     repeats=3):
+    """Solve latency under an ICE storm: a growing fraction of the
+    catalog's offerings — CHEAPEST first, exactly the rows the packer
+    wants — marked unavailable through the same snapshot the provisioner
+    passes (the UnavailableOfferings cache populated by lifecycle on
+    InsufficientCapacityError). Measures the stockout-masking overhead
+    (apply_unavailable catalog projection + the off_avail tensor mask) and
+    the repack cost of routing around dead capacity."""
+    from karpenter_core_tpu.cloudprovider.kwok import bench_catalog
+    from karpenter_core_tpu.cloudprovider.types import OfferingKey
+    from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+    catalog = bench_catalog(n_types)
+    pools = [_pool()]
+    by_price = sorted(
+        (off.price, OfferingKey(it.name, off.zone, off.capacity_type))
+        for it in catalog
+        for off in it.offerings
+    )
+    out = {}
+    for frac in fractions:
+        k = int(len(by_price) * frac)
+        unavail = frozenset(key for _, key in by_price[:k])
+        sched = DeviceScheduler(
+            pools,
+            {p.name: list(catalog) for p in pools},
+            max_slots=1024,
+            unavailable_offerings=unavail,
+        )
+        pods = _plain_pods(n_pods)
+        sched.solve(pods)  # warm the jit cache at this masking shape
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            res = sched.solve(pods)
+            times.append(time.perf_counter() - t0)
+        entry = _spread(times)
+        entry["unavailable_offerings"] = k
+        entry["nodes"] = res.node_count()
+        entry["all_scheduled"] = res.all_pods_scheduled()
+        out[f"storm_{int(frac * 100)}pct"] = entry
+    return out
+
+
 def _shape_churn_bench(n=20000, types=800, rounds=6):
     """Every solve mutates the pod mix — different pod counts AND a
     different shape grid, so class counts drift round to round. Bucketed
@@ -601,6 +646,7 @@ def main():
         detail["shape_churn"] = _shape_churn_bench()
         detail["cfg4_consol"] = _consolidation_bench()
         detail["cfg5_sidecar"] = _sidecar_bench()
+        detail["cfg6_ice_storm"] = _ice_storm_bench()
         detail["restart"] = _run_restart_probe()
 
     pods_per_sec = primary["pods_per_sec"]

@@ -1,0 +1,221 @@
+"""Seeded chaos harness: deterministic fault injection at the two seams the
+control plane talks through — the ``KubeClient`` (apiserver) and the
+``CloudProvider``.
+
+The style is solver/remote.py's ``FaultInjector`` (scripted, consumed in
+order, exhausted -> healthy) generalized to many fault sites and backed by
+a seeded PRNG for rate-driven storms, so a chaos soak is REPRODUCIBLE:
+identical seeds draw identical fault sequences, and with a fake clock the
+whole run — including the operator's isolation backoffs and the ICE cache's
+TTLs — replays event-for-event.
+
+Faults injected BEFORE delegating model "the request never reached the
+server" (create/delete/bind/evict): the store is untouched and the caller
+retries from clean state. ``update`` faults inject AFTER delegating —
+"applied, response lost" — because controllers mutate the store's own
+object in place before calling update; raising before the write would
+leave a phantom half-state (mutated object, no version bump, no watch
+event) that neither a real apiserver nor a real network can produce.
+
+Capacity stockouts are STATE, not a per-call coin flip: an ``IceStorm``
+window writes the provider's ``stockouts`` set (the kwok/fake ground
+truth), the provider's create raises typed ICE against it, lifecycle marks
+the UnavailableOfferings cache, and the re-solve routes around the storm —
+the whole availability loop under test.
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+from karpenter_core_tpu.cloudprovider.types import (
+    CloudProviderError,
+    CreateError,
+    InsufficientCapacityError,
+    NodeClaimNotFoundError,
+    OfferingKey,
+)
+from karpenter_core_tpu.kube.store import ConflictError, TooManyRequestsError
+
+
+class ChaosSchedule:
+    """Deterministic fault source shared by both injectors.
+
+    ``script`` maps a seam name to a fault list consumed call-by-call
+    (``"ok"`` entries pass through); once a seam's script is exhausted,
+    ``rates`` take over: ``{"<seam>.<fault>": probability}`` drawn from the
+    seeded PRNG in a fixed order, so the same seed replays the same
+    faults."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        rates: Optional[Dict[str, float]] = None,
+        script: Optional[Dict[str, List[str]]] = None,
+    ):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.rates = dict(rates or {})
+        self.script = {k: list(v) for k, v in (script or {}).items()}
+        self.draws = 0
+
+    def next_fault(self, seam: str, faults: Sequence[str]) -> str:
+        self.draws += 1
+        queued = self.script.get(seam)
+        if queued:
+            return queued.pop(0)
+        for fault in faults:
+            rate = self.rates.get(f"{seam}.{fault}", 0.0)
+            if rate and self.rng.random() < rate:
+                return fault
+        return "ok"
+
+
+class ChaosKubeClient:
+    """KubeClient wrapper injecting apiserver-shaped faults on writes:
+    ConflictError (optimistic-lock race), TooManyRequestsError (apiserver
+    overload), and latency (a slow round-trip, stepped on a fake clock).
+    Reads delegate untouched — the seam under test is write contention."""
+
+    WRITE_FAULTS = ("conflict", "too_many_requests", "latency")
+
+    def __init__(self, inner, schedule: ChaosSchedule, latency: float = 0.25):
+        self._inner = inner
+        self.schedule = schedule
+        self.latency = latency
+        self.injected: Dict[str, int] = {}
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+    def _fault(self, seam: str, verb: str, detail: str) -> None:
+        fault = self.schedule.next_fault(seam, self.WRITE_FAULTS)
+        if fault == "ok":
+            return
+        self.injected[fault] = self.injected.get(fault, 0) + 1
+        if fault == "latency":
+            clock = getattr(self._inner, "clock", None)
+            if clock is not None and hasattr(clock, "step"):
+                clock.step(self.latency)
+            else:
+                time.sleep(min(self.latency, 0.01))
+            return
+        if fault == "conflict":
+            raise ConflictError(f"chaos: injected conflict on {verb} {detail}")
+        if fault == "too_many_requests":
+            raise TooManyRequestsError(
+                f"chaos: injected 429 on {verb} {detail}"
+            )
+        raise ValueError(f"unknown chaos fault {fault!r}")
+
+    @staticmethod
+    def _detail(obj) -> str:
+        return f"{type(obj).__name__}/{obj.metadata.name}"
+
+    # request-lost faults (store untouched, caller retries clean)
+
+    def create(self, obj):
+        self._fault("kube.create", "create", self._detail(obj))
+        return self._inner.create(obj)
+
+    def delete(self, obj) -> None:
+        self._fault("kube.delete", "delete", self._detail(obj))
+        self._inner.delete(obj)
+
+    def bind(self, pod, node_name: str) -> None:
+        self._fault("kube.bind", "bind", self._detail(pod))
+        self._inner.bind(pod, node_name)
+
+    def evict(self, pod) -> None:
+        self._fault("kube.evict", "evict", self._detail(pod))
+        self._inner.evict(pod)
+
+    # response-lost fault (applied first — see module docstring)
+
+    def update(self, obj):
+        out = self._inner.update(obj)
+        self._fault("kube.update", "update", self._detail(obj))
+        return out
+
+
+class IceStorm(NamedTuple):
+    """A capacity stockout window: ``offerings`` are unfillable during
+    [start, start+duration) of the provider's clock."""
+
+    start: float
+    duration: float
+    offerings: "tuple[OfferingKey, ...]"
+
+
+class ChaosCloudProvider:
+    """CloudProvider wrapper: per-call create/delete/get faults plus
+    time-windowed ICE storms written into the inner provider's ground-truth
+    ``stockouts`` set (kwok/fake both expose it)."""
+
+    CREATE_FAULTS = ("create_error", "insufficient_capacity")
+
+    def __init__(
+        self,
+        inner,
+        schedule: ChaosSchedule,
+        storms: Sequence[IceStorm] = (),
+        clock=None,
+    ):
+        from karpenter_core_tpu.utils.clock import Clock
+
+        self._inner = inner
+        self.schedule = schedule
+        self.storms = list(storms)
+        self.clock = clock or getattr(inner, "clock", None) or Clock()
+        self._base_stockouts = set(getattr(inner, "stockouts", set()))
+        self.injected: Dict[str, int] = {}
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+    def _count(self, fault: str) -> None:
+        self.injected[fault] = self.injected.get(fault, 0) + 1
+
+    def _apply_storms(self) -> None:
+        if not self.storms:
+            return
+        now = self.clock.now()
+        active: set = set()
+        for storm in self.storms:
+            if storm.start <= now < storm.start + storm.duration:
+                active.update(OfferingKey(*k) for k in storm.offerings)
+        self._inner.stockouts = self._base_stockouts | active
+
+    def create(self, node_claim):
+        self._apply_storms()
+        fault = self.schedule.next_fault("cloud.create", self.CREATE_FAULTS)
+        if fault == "create_error":
+            self._count(fault)
+            raise CreateError(
+                "chaos: injected launch failure",
+                condition_reason="ChaosInjected",
+            )
+        if fault == "insufficient_capacity":
+            # context-free ICE (an aggregate stockout the provider could not
+            # attribute): lifecycle deletes the claim and the re-solve
+            # retries the same offering — the pre-cache degradation path
+            self._count(fault)
+            raise InsufficientCapacityError(
+                "chaos: injected capacity stockout"
+            )
+        return self._inner.create(node_claim)
+
+    def delete(self, node_claim) -> None:
+        if self.schedule.next_fault("cloud.delete", ("delete_error",)) != "ok":
+            self._count("delete_error")
+            raise CloudProviderError("chaos: injected delete failure")
+        self._inner.delete(node_claim)
+
+    def get(self, provider_id: str):
+        if self.schedule.next_fault("cloud.get", ("not_found",)) != "ok":
+            self._count("not_found")
+            raise NodeClaimNotFoundError(
+                f"chaos: injected not-found for {provider_id}"
+            )
+        return self._inner.get(provider_id)

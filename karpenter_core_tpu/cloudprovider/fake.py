@@ -16,6 +16,7 @@ from karpenter_core_tpu.api.objects import (
 from karpenter_core_tpu.cloudprovider.types import (
     CloudProvider,
     InstanceType,
+    InsufficientCapacityError,
     NodeClaimNotFoundError,
     Offering,
     Offerings,
@@ -82,7 +83,16 @@ def fake_instance_types(n: int, zones: Optional[List[str]] = None) -> List[Insta
 
 
 class FakeCloudProvider(CloudProvider):
-    def __init__(self, instance_types: Optional[List[InstanceType]] = None):
+    def __init__(
+        self,
+        instance_types: Optional[List[InstanceType]] = None,
+        unavailable_offerings=None,
+        clock=None,
+    ):
+        from karpenter_core_tpu.cloudprovider.unavailableofferings import (
+            UnavailableOfferings,
+        )
+
         self.instance_types = instance_types or fake_instance_types(5)
         self.instance_types_for_nodepool: dict = {}
         self.create_calls: list = []
@@ -92,6 +102,16 @@ class FakeCloudProvider(CloudProvider):
         self.drifted: str = ""
         self._created: dict = {}
         self._counter = itertools.count(1)
+        # same stockout/ICE-cache seam as the kwok provider (see kwok.py);
+        # `is None` because an empty shared cache is falsy but still shared.
+        # Pass the test's fake clock (this provider has no kube to derive
+        # one from) or ICE TTLs expire on WALL time under a stepped clock.
+        self.stockouts: set = set()
+        self.unavailable_offerings = (
+            unavailable_offerings
+            if unavailable_offerings is not None
+            else UnavailableOfferings(clock)
+        )
 
     def get_instance_types(self, nodepool) -> List[InstanceType]:
         name = getattr(nodepool, "name", nodepool)
@@ -120,7 +140,28 @@ class FakeCloudProvider(CloudProvider):
         )
         if it is None:
             raise RuntimeError("no compatible instance type")
-        offering = it.offerings.available().compatible(reqs).cheapest()
+        candidates = it.offerings.available().compatible(reqs)
+        offering = min(
+            (
+                o
+                for o in candidates
+                if not self.unavailable_offerings.is_unavailable(o.key(it.name))
+            ),
+            key=lambda o: o.price,
+            default=None,
+        )
+        if offering is None and candidates:
+            # every compatible offering is ICE-cached: the launch must fail
+            # like kwok's (no context — they are already cached), not
+            # silently succeed with empty zone/capacity-type labels
+            raise InsufficientCapacityError(
+                f"no available offering for {it.name}"
+            )
+        if offering is not None and offering.key(it.name) in self.stockouts:
+            raise InsufficientCapacityError(
+                f"insufficient capacity for {it.name}",
+                offerings=[offering.key(it.name)],
+            )
         node_claim.status.provider_id = f"fake://{next(self._counter)}"
         node_claim.status.capacity = dict(it.capacity)
         node_claim.status.allocatable = dict(it.allocatable())

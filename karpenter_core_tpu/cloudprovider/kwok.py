@@ -143,7 +143,15 @@ def bench_catalog(n_target: int = 800) -> List[InstanceType]:
 class KwokCloudProvider(CloudProvider):
     """Fake provider backed by the in-memory kube store."""
 
-    def __init__(self, kube, instance_types: Optional[List[InstanceType]] = None):
+    def __init__(
+        self,
+        kube,
+        instance_types: Optional[List[InstanceType]] = None,
+        unavailable_offerings=None,
+    ):
+        from karpenter_core_tpu.cloudprovider.unavailableofferings import (
+            UnavailableOfferings,
+        )
         from karpenter_core_tpu.utils.clock import Clock
 
         self.kube = kube
@@ -154,6 +162,23 @@ class KwokCloudProvider(CloudProvider):
         self._by_name = {it.name: it for it in self.instance_types}
         self._counter = itertools.count(1)
         self.allow_insufficient_capacity = False
+        # ground-truth capacity stockouts: OfferingKeys create cannot fill.
+        # Tests / the chaos harness's ICE storms write this set; create
+        # raises a typed ICE (with the offering context) when its pick is in
+        # it — the seam the UnavailableOfferings cache learns from.
+        self.stockouts: set = set()
+        # shared ICE cache (the AWS provider consults the same cache in its
+        # own CreateFleet path): create skips offerings already known
+        # unavailable so a claim whose requirements still admit them cannot
+        # livelock through the identical stockout inside the TTL.
+        # `is None`, not truthiness — an EMPTY cache passed by the operator
+        # is falsy (len 0) but must be adopted, or lifecycle marks a
+        # different cache than this create path consults
+        self.unavailable_offerings = (
+            unavailable_offerings
+            if unavailable_offerings is not None
+            else UnavailableOfferings(self.clock)
+        )
 
     def get_instance_types(self, nodepool) -> List[InstanceType]:
         return list(self.instance_types)
@@ -163,21 +188,35 @@ class KwokCloudProvider(CloudProvider):
             node_claim.spec.requirements
         )
         # pick cheapest compatible instance type + offering
-        # (kwok cloudprovider.go:143-191)
+        # (kwok cloudprovider.go:143-191), skipping offerings the shared ICE
+        # cache already knows are stocked out — the fleet-request analogue of
+        # the AWS provider excluding cached-unavailable pools
         best = None
         for it in self.instance_types:
             if reqs.intersects(it.requirements):
                 continue
-            offering = it.offerings.available().compatible(reqs).cheapest()
-            if offering is None:
-                continue
-            if best is None or offering.price < best[1].price:
-                best = (it, offering)
+            for offering in it.offerings.available().compatible(reqs):
+                if self.unavailable_offerings.is_unavailable(
+                    offering.key(it.name)
+                ):
+                    continue
+                if best is None or offering.price < best[1].price:
+                    best = (it, offering)
         if best is None:
             raise InsufficientCapacityError(
                 f"no compatible instance type for {node_claim.name}"
             )
         it, offering = best
+        key = offering.key(it.name)
+        if key in self.stockouts:
+            # actual capacity is out: fail the launch NAMING the offering,
+            # so lifecycle can mark it unavailable and the re-solve lands on
+            # the next-cheapest available one instead of repeating this pick
+            raise InsufficientCapacityError(
+                f"insufficient capacity for {key.instance_type} in "
+                f"{key.zone} ({key.capacity_type})",
+                offerings=[key],
+            )
         seq = next(self._counter)
         provider_id = f"kwok://{node_claim.name}-{seq}"
         node_claim.status.provider_id = provider_id

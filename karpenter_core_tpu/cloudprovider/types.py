@@ -8,13 +8,23 @@ from __future__ import annotations
 
 import abc
 from dataclasses import dataclass, field
-from typing import Iterable, List, Optional
+from typing import Dict, Iterable, List, NamedTuple, Optional
 
 from karpenter_core_tpu.api import labels as apilabels
 from karpenter_core_tpu.api.nodeclaim import NodeClaim
 from karpenter_core_tpu.api.objects import ResourceList
 from karpenter_core_tpu.scheduling import Requirements
 from karpenter_core_tpu.utils import resources as resutil
+
+
+class OfferingKey(NamedTuple):
+    """The identity of one purchase option: the instance-type × zone ×
+    capacity-type triple a capacity stockout names. A plain tuple subclass,
+    so wire-decoded ``(it, zone, ct)`` tuples compare equal."""
+
+    instance_type: str
+    zone: str
+    capacity_type: str
 
 
 @dataclass
@@ -24,6 +34,9 @@ class Offering:
     requirements: Requirements
     price: float
     available: bool = True
+
+    def key(self, instance_type: str) -> OfferingKey:
+        return OfferingKey(instance_type, self.zone, self.capacity_type)
 
     @property
     def zone(self) -> str:
@@ -136,6 +149,50 @@ def truncate_instance_types(
     return truncated, None
 
 
+def apply_unavailable(
+    instance_types: Dict[str, List[InstanceType]],
+    unavailable: "frozenset[OfferingKey] | set",
+) -> Dict[str, List[InstanceType]]:
+    """Project an unavailable-offerings set onto per-pool catalogs: instance
+    types with a hit get a shallow copy whose stocked-out offerings are
+    marked ``available=False``; untouched types keep their identity, and
+    objects shared across pools stay shared (the catalog-union dedupe and
+    the wire codec's identity table both key on ``id``)."""
+    if not unavailable:
+        return instance_types
+    memo: Dict[int, InstanceType] = {}
+
+    def one(it: InstanceType) -> InstanceType:
+        got = memo.get(id(it))
+        if got is None:
+            hit = any(
+                o.available and o.key(it.name) in unavailable
+                for o in it.offerings
+            )
+            if hit:
+                got = InstanceType(
+                    name=it.name,
+                    requirements=it.requirements,
+                    offerings=Offerings(
+                        Offering(
+                            requirements=o.requirements,
+                            price=o.price,
+                            available=o.available
+                            and o.key(it.name) not in unavailable,
+                        )
+                        for o in it.offerings
+                    ),
+                    capacity=it.capacity,
+                    overhead=it.overhead,
+                )
+            else:
+                got = it
+            memo[id(it)] = got
+        return got
+
+    return {pool: [one(it) for it in its] for pool, its in instance_types.items()}
+
+
 # -- typed errors (types.go:312-399) ----------------------------------------
 
 class CloudProviderError(Exception):
@@ -147,7 +204,16 @@ class NodeClaimNotFoundError(CloudProviderError):
 
 
 class InsufficientCapacityError(CloudProviderError):
-    pass
+    """A launch failed because capacity was stocked out. ``offerings``
+    carries the OfferingKeys the provider observed unavailable so the
+    control plane can mark them in its UnavailableOfferings cache (the
+    reference's AWS provider attaches the same context to its ICE cache,
+    pkg/cache/unavailableofferings.go) instead of re-solving onto the
+    identical stocked-out offering and livelocking."""
+
+    def __init__(self, message: str, offerings: Iterable[OfferingKey] = ()):
+        super().__init__(message)
+        self.offerings = tuple(offerings)
 
 
 class NodeClassNotReadyError(CloudProviderError):

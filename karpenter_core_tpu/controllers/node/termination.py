@@ -7,7 +7,11 @@ from __future__ import annotations
 from karpenter_core_tpu.api import labels as apilabels
 from karpenter_core_tpu.api.objects import Node
 from karpenter_core_tpu.cloudprovider.types import NodeClaimNotFoundError
-from karpenter_core_tpu.kube.store import NotFoundError, TooManyRequestsError
+from karpenter_core_tpu.kube.store import (
+    ConflictError,
+    NotFoundError,
+    TooManyRequestsError,
+)
 from karpenter_core_tpu.scheduling.taints import DISRUPTED_NO_SCHEDULE_TAINT
 from karpenter_core_tpu.utils import pod as podutil
 
@@ -44,6 +48,18 @@ class NodeTermination:
         return min(waits) if waits else 0.0
 
     def reconcile(self, node: Node) -> None:
+        # a stale-resource_version conflict on any of the node/claim writes
+        # below is an expected optimistic-lock race (another controller got
+        # there first), not a crash: drop this pass and retry against the
+        # fresh object next reconcile — the controller-runtime conflict
+        # requeue, consistent with the operator's isolation wrapper (which
+        # would otherwise count it as a reconcile error and back off)
+        try:
+            self._reconcile(node)
+        except ConflictError:
+            return
+
+    def _reconcile(self, node: Node) -> None:
         if node.metadata.deletion_timestamp is None:
             return
         if apilabels.TERMINATION_FINALIZER not in node.metadata.finalizers:

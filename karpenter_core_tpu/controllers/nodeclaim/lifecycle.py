@@ -30,11 +30,24 @@ REGISTRATION_TTL = 15 * 60.0  # liveness.go:41
 
 
 class NodeClaimLifecycle:
-    def __init__(self, kube, cluster, cloud_provider, clock):
+    def __init__(
+        self,
+        kube,
+        cluster,
+        cloud_provider,
+        clock,
+        unavailable_offerings=None,
+        recorder=None,
+    ):
         self.kube = kube
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock
+        # ICE cache the launch path populates from typed error context; the
+        # provisioner's solve paths consume it (cloudprovider/
+        # unavailableofferings.py) — None keeps the pre-cache behavior
+        self.unavailable_offerings = unavailable_offerings
+        self.recorder = recorder
 
     def reconcile(self, claim: NodeClaim) -> None:
         if claim.metadata.deletion_timestamp is not None:
@@ -64,11 +77,17 @@ class NodeClaimLifecycle:
         user_labels = dict(claim.metadata.labels)
         try:
             self.cloud_provider.create(claim)
-        except (InsufficientCapacityError, NodeClassNotReadyError):
-            # terminal for this claim: delete so the provisioner retries —
-            # insufficient capacity with the offering marked unavailable,
-            # NodeClassNotReady against a (possibly fixed) class
-            # (launch.go terminal-error paths)
+        except InsufficientCapacityError as e:
+            # terminal for this claim: mark the stocked-out offerings in the
+            # ICE cache so the re-solve excludes them (both solve paths AND
+            # the provider's own pick consume the cache), then delete so the
+            # provisioner retries onto the next-cheapest AVAILABLE offering
+            # (launch.go terminal-error path + the AWS ICE cache)
+            self._record_insufficient_capacity(claim, e)
+            self.kube.delete(claim)
+            return
+        except NodeClassNotReadyError:
+            # terminal against a (possibly fixed) class; retried via re-solve
             self.kube.delete(claim)
             return
         except CreateError as e:
@@ -96,6 +115,34 @@ class NodeClaimLifecycle:
             **user_labels,
         }
         self.kube.update(claim)
+
+    def _record_insufficient_capacity(
+        self, claim: NodeClaim, err: InsufficientCapacityError
+    ) -> None:
+        from karpenter_core_tpu.metrics import wiring as m
+
+        keys = getattr(err, "offerings", ()) or ()
+        if self.unavailable_offerings is not None:
+            for key in keys:
+                self.unavailable_offerings.mark(key)
+        if keys:
+            for key in keys:
+                m.INSUFFICIENT_CAPACITY_ERRORS.inc({
+                    "capacity_type": key.capacity_type, "zone": key.zone,
+                })
+        else:
+            m.INSUFFICIENT_CAPACITY_ERRORS.inc(
+                {"capacity_type": "", "zone": ""}
+            )
+        if self.recorder is not None:
+            from karpenter_core_tpu.events import Event
+
+            self.recorder.publish(Event(
+                involved_object=f"NodeClaim/{claim.name}",
+                type="Warning",
+                reason="InsufficientCapacity",
+                message=str(err),
+            ))
 
     # -- registration (registration.go:43) --------------------------------
 

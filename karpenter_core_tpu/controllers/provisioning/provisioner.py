@@ -38,6 +38,7 @@ class Provisioner:
         device_scheduler_opts: Optional[dict] = None,
         recorder=None,
         solver_client=None,
+        unavailable_offerings=None,
     ):
         self.kube = kube
         self.cluster = cluster
@@ -46,6 +47,11 @@ class Provisioner:
         self.solver = solver
         self.device_scheduler_opts = device_scheduler_opts or {}
         self.recorder = recorder
+        # ICE cache (cloudprovider/unavailableofferings.py) shared with the
+        # lifecycle controller: every scheduler this provisioner builds —
+        # greedy, device, remote, and the disruption simulations routed
+        # through new_scheduler — excludes the cached offerings
+        self.unavailable_offerings = unavailable_offerings
         # non-None routes tpu solves (and the consolidation sweep) through
         # the solverd sidecar via solver/remote.py; the client owns the
         # circuit breaker, so it outlives individual schedulers
@@ -157,11 +163,17 @@ class Provisioner:
             ],
             excluded_pod_uids={p.uid for p in pods},
         )
+        unavail = (
+            self.unavailable_offerings.snapshot()
+            if self.unavailable_offerings is not None
+            else frozenset()
+        )
         common = dict(
             nodepools=nodepools,
             instance_types=instance_types,
             existing_nodes=sim_nodes,
             daemonset_pods=self.daemonset_pods(),
+            unavailable_offerings=unavail,
         )
         if self.solver == "tpu":
             if self.solver_client is not None:

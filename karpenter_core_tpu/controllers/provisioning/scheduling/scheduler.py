@@ -79,7 +79,17 @@ class Scheduler:
         existing_nodes: Optional[List[SimNode]] = None,
         daemonset_pods: Optional[List[Pod]] = None,
         topology: Optional[Topology] = None,
+        unavailable_offerings: "frozenset | set" = frozenset(),
     ):
+        # ICE'd offerings (the UnavailableOfferings snapshot) project onto
+        # the catalog before anything consults availability: the per-
+        # template prefilter, in-flight offering narrowing, and price
+        # ordering all see the stockout and pack onto the next-cheapest
+        # AVAILABLE offering (cloudprovider/types.py apply_unavailable)
+        from karpenter_core_tpu.cloudprovider.types import apply_unavailable
+
+        instance_types = apply_unavailable(instance_types, unavailable_offerings)
+        self.unavailable_offerings = frozenset(unavailable_offerings)
         # default topology over the discoverable domain universe
         # (provisioner.go:251-283); the provisioning controller passes a
         # Topology seeded with live cluster pods instead

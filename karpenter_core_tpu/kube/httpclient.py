@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import time
 from typing import Callable, List, Optional
 
 from karpenter_core_tpu.kube import serial
@@ -52,18 +53,37 @@ def _ns(kind: str, obj) -> str:
     return obj.metadata.namespace if kind in _NAMESPACED else "default"
 
 
+# transient statuses a GET/LIST may retry through: apiserver overload (429)
+# and gateway/server-side blips (5xx). Writes are NOT retried — a timed-out
+# create/update may have landed, and replaying it is not idempotent.
+_RETRYABLE_STATUSES = (429, 500, 502, 503, 504)
+GET_RETRIES = 3
+GET_RETRY_BACKOFF = 0.05
+
+
 class HttpKubeClient:
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 30.0,
+        get_retries: int = GET_RETRIES,
+        retry_backoff: float = GET_RETRY_BACKOFF,
+    ):
         self.host = host
         self.port = port
         self.timeout = timeout
+        self.get_retries = get_retries
+        self.retry_backoff = retry_backoff
+        self._sleep = time.sleep  # injectable for tests
         self._watchers: List[Callable[[str, str, object], None]] = []
         self._cursor = 0
         self.mutations = 0  # event count; run_until_idle's idle signal
 
     # -- transport ---------------------------------------------------------
 
-    def _request(self, method: str, path: str, payload=None):
+    def _do_request(self, method: str, path: str, payload=None):
+        """One wire round-trip: (status, decoded body)."""
         conn = http.client.HTTPConnection(
             self.host, self.port, timeout=self.timeout
         )
@@ -77,14 +97,30 @@ class HttpKubeClient:
             data = json.loads(resp.read() or b"null")
         finally:
             conn.close()
-        if resp.status == 404:
+        return resp.status, data
+
+    def _request(self, method: str, path: str, payload=None):
+        # bounded retry with exponential backoff for idempotent reads on
+        # transient 429/5xx (client-go's rest client retries the same set);
+        # everything else surfaces on the first response
+        attempts = self.get_retries + 1 if method == "GET" else 1
+        for attempt in range(attempts):
+            status, data = self._do_request(method, path, payload)
+            if (
+                status in _RETRYABLE_STATUSES
+                and attempt < attempts - 1
+            ):
+                self._sleep(self.retry_backoff * (2 ** attempt))
+                continue
+            break
+        if status == 404:
             raise NotFoundError(str((data or {}).get("error", path)))
-        if resp.status == 409:
+        if status == 409:
             raise ConflictError(str((data or {}).get("error", path)))
-        if resp.status == 429:
+        if status == 429:
             raise TooManyRequestsError(str((data or {}).get("error", path)))
-        if resp.status >= 400:
-            raise RuntimeError(f"{method} {path}: {resp.status} {data}")
+        if status >= 400:
+            raise RuntimeError(f"{method} {path}: {status} {data}")
         return data
 
     # -- watch -------------------------------------------------------------

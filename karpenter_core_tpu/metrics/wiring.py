@@ -93,6 +93,34 @@ NODEPOOL_LIMIT = REGISTRY.gauge(
     "nodepool_limit", "Configured limit per nodepool and resource"
 )
 
+# -- reconcile fault isolation (controller-runtime's controller_runtime_
+# reconcile_errors_total + the health probe's crash-loop gate) -------------
+
+RECONCILE_ERRORS = REGISTRY.counter(
+    "controller_reconcile_errors_total",
+    "Reconciler invocations that raised, by controller and error type; the"
+    " pass survives (the exception is isolated to the controller's backoff)",
+)
+CONTROLLER_CRASHLOOPING = REGISTRY.gauge(
+    "controller_crashlooping",
+    "Controllers at/past the consecutive-error-pass threshold that flips"
+    " readyz",
+)
+
+# -- ICE / unavailable offerings (AWS provider's ICE cache, surfaced core) --
+
+UNAVAILABLE_OFFERINGS_COUNT = REGISTRY.gauge(
+    "cloudprovider_unavailable_offerings",
+    "Offerings currently marked unavailable (instance-type×zone×capacity-"
+    "type) in the TTL'd ICE cache both solve paths consume",
+)
+INSUFFICIENT_CAPACITY_ERRORS = REGISTRY.counter(
+    "nodeclaims_insufficient_capacity_total",
+    "NodeClaim launches abandoned on InsufficientCapacityError, by"
+    " capacity_type/zone of the stocked-out offering ('' when the provider"
+    " attached no offering context)",
+)
+
 # -- TPU solver (no reference counterpart; Weak #6 of VERDICT r3) ----------
 
 SOLVER_SOLVE_DURATION = REGISTRY.histogram(

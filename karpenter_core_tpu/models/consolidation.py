@@ -138,6 +138,14 @@ def schedulability_frontier(
         np_.name: provisioner.cloud_provider.get_instance_types(np_)
         for np_ in nodepools
     }
+    # the sweep's price bound and repack viability must see the same ICE'd
+    # offerings the solve does, or consolidation plans a replacement onto a
+    # stocked-out offering that the launch then fails
+    cache = getattr(provisioner, "unavailable_offerings", None)
+    if cache is not None:
+        from karpenter_core_tpu.cloudprovider.types import apply_unavailable
+
+        instance_types = apply_unavailable(instance_types, cache.snapshot())
     candidate_pods = [c.reschedulable_pods for c in candidates]
     daemonset_pods = provisioner.daemonset_pods()
 

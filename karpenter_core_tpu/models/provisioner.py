@@ -206,7 +206,18 @@ class DeviceScheduler:
         daemonset_pods: Optional[List[Pod]] = None,
         max_slots: int = 256,
         topology: Optional[Topology] = None,
+        unavailable_offerings: "frozenset | set" = frozenset(),
     ):
+        # ICE'd offerings project onto the catalog exactly like the greedy
+        # path (apply_unavailable), so the host-side machinery — template
+        # prefilter, decode refit, host fallback, price ordering — all see
+        # the stockout; the device side additionally masks the offerings
+        # tensor (off_avail in _prepare_with_vocab) so in-kernel zone/ct
+        # viability excludes the stocked-out rows
+        from karpenter_core_tpu.cloudprovider.types import apply_unavailable
+
+        instance_types = apply_unavailable(instance_types, unavailable_offerings)
+        self.unavailable_offerings = frozenset(unavailable_offerings)
         # a supplied Topology carries cluster context (existing pods,
         # exclusions); its groups are rebuilt fresh each solve round, so only
         # the constructor inputs are kept
@@ -745,6 +756,12 @@ class DeviceScheduler:
         for ti, it in enumerate(catalog):
             for off in it.offerings:
                 if not off.available:
+                    continue
+                # the unavailable-offerings tensor mask: ICE'd rows never
+                # enter fresh-node viability (apply_unavailable already
+                # flipped copies' available flags; this guards catalogs
+                # handed in pre-built, e.g. over the sidecar wire)
+                if off.key(it.name) in self.unavailable_offerings:
                     continue
                 z = frozen.values[zone_kid].get(off.zone)
                 c_ = frozen.values[ct_kid].get(off.capacity_type)

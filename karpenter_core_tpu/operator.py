@@ -51,6 +51,17 @@ from karpenter_core_tpu.state.cluster import Cluster
 from karpenter_core_tpu.utils import pod as podutil
 from karpenter_core_tpu.utils.clock import Clock
 
+# -- reconcile fault isolation -----------------------------------------------
+# One controller's exception must not kill the pass (the reference runs ~28
+# independent controllers on a manager; an error there requeues ONE object
+# with rate limiting, controller-runtime's DefaultTypedControllerRateLimiter).
+# A guarded invocation that raises puts its controller on exponential requeue
+# backoff; repeated consecutive errors mark it crash-looping and readyz()
+# reports the control plane degraded.
+RECONCILE_BACKOFF_BASE = 1.0
+RECONCILE_BACKOFF_CAP = 60.0
+CRASHLOOP_THRESHOLD = 3
+
 
 @dataclass
 class Options:
@@ -142,6 +153,16 @@ class Options:
         for part in filter(None, (p.strip() for p in gates.split(","))):
             name, _, value = part.partition("=")
             opts.feature_gates[name] = value.lower() in ("true", "1", "yes")
+        # non-positive durations silently wedge the loop (a zero RPC
+        # deadline fails every solve; a zero poll interval busy-spins) —
+        # reject them at the flag surface, not deep in a controller
+        for attr in ("solver_timeout", "batch_max_duration", "poll_interval"):
+            value = getattr(opts, attr)
+            if value <= 0:
+                flag = cls._FLAGS[attr][0]
+                raise ValueError(
+                    f"{flag} must be positive, got {value}"
+                )
         if opts.solver not in ("greedy", "tpu"):
             raise ValueError(f"unknown solver {opts.solver!r}")
         if opts.solver_mode not in ("inproc", "sidecar"):
@@ -174,11 +195,33 @@ class Operator:
         self.kube = kube or KubeStore(self.clock)
         self.options = options or Options()
         from karpenter_core_tpu.cloudprovider.metrics import MetricsDecorator
-
-        self.cloud_provider = MetricsDecorator(
-            cloud_provider
-            or KwokCloudProvider(self.kube, instance_types)
+        from karpenter_core_tpu.cloudprovider.unavailableofferings import (
+            UnavailableOfferings,
         )
+
+        # the ICE cache is shared three ways: lifecycle marks offerings from
+        # typed InsufficientCapacityError context, the provisioner's solve
+        # paths exclude them, and a provider that exposes its own cache (the
+        # kwok/fake create paths skip cached offerings when picking) keeps
+        # using the SAME instance so all views agree
+        if cloud_provider is None:
+            self.unavailable_offerings = UnavailableOfferings(self.clock)
+            cloud_provider = KwokCloudProvider(
+                self.kube,
+                instance_types,
+                unavailable_offerings=self.unavailable_offerings,
+            )
+        else:
+            # `is None`, not truthiness: an EMPTY provider cache is falsy
+            # (len 0) but must still be adopted, or lifecycle would mark a
+            # different cache than the provider's create path consults
+            adopted = getattr(cloud_provider, "unavailable_offerings", None)
+            self.unavailable_offerings = (
+                adopted
+                if adopted is not None
+                else UnavailableOfferings(self.clock)
+            )
+        self.cloud_provider = MetricsDecorator(cloud_provider)
         self.cluster = Cluster(self.kube, self.clock)
         self.recorder = Recorder(self.clock)
         # solverd sidecar wiring (solver_mode=sidecar): a supervised child
@@ -213,11 +256,14 @@ class Operator:
             device_scheduler_opts=self.options.device_scheduler_opts,
             recorder=self.recorder,
             solver_client=self.solver_client,
+            unavailable_offerings=self.unavailable_offerings,
         )
         self.provisioner.profile_solves = self.options.profile_solves
         self.provisioner.profile_dir = self.options.profile_dir
         self.lifecycle = NodeClaimLifecycle(
-            self.kube, self.cluster, self.cloud_provider, self.clock
+            self.kube, self.cluster, self.cloud_provider, self.clock,
+            unavailable_offerings=self.unavailable_offerings,
+            recorder=self.recorder,
         )
         self.termination = NodeTermination(
             self.kube, self.cluster, self.cloud_provider, self.clock,
@@ -271,6 +317,17 @@ class Operator:
         self.kube.watch(self._trigger_on_pod)
         # claim/node name -> pod keys awaiting bind
         self.nominations: Dict[str, List[str]] = {}
+        # controller name -> (not_before, delay, consecutive_errors,
+        # pass_id_recorded): the per-controller requeue backoff state
+        # (_guarded); pass_id scopes the skip-gate so a fault armed DURING
+        # a pass never skips that same pass's remaining objects
+        self._controller_faults: Dict[str, tuple] = {}
+        self._pass_id = 0
+        # controllers _guarded saw this pass (invoked OR backoff-skipped):
+        # a faulted controller that no longer appears at all — its failing
+        # object was deleted and no workload remains — must drop its fault,
+        # or readyz would report a crash-loop forever with nothing failing
+        self._pass_seen: set = set()
 
     def _trigger_on_pod(self, event: str, kind: str, obj) -> None:
         if kind != "Pod" or event == "DELETED":
@@ -324,33 +381,139 @@ class Operator:
 
     def readyz(self) -> bool:
         """Readiness: cluster state has caught up with the store — the
-        Synced gate every solve already requires (state/cluster.go:96-150)."""
+        Synced gate every solve already requires (state/cluster.go:96-150) —
+        AND no controller is crash-looping (a controller past the
+        consecutive-error threshold means the control plane is degraded;
+        the probe surface must say so)."""
+        if any(
+            fault[2] >= CRASHLOOP_THRESHOLD
+            for fault in self._controller_faults.values()
+        ):
+            return False
         return self.cluster.synced()
+
+    # -- fault isolation (see module constants above) ----------------------
+
+    def _guarded(self, controller: str, fn, *args) -> None:
+        """Run one reconciler invocation inside the controller's failure
+        domain: an exception increments reconcile_errors, publishes a
+        Warning event, and escalates the controller's requeue backoff —
+        the pass continues. The backoff gate only honors faults recorded
+        in EARLIER passes, so the remaining objects of a pass still
+        reconcile after a sibling's error, and a mixed controller (one
+        broken object among healthy ones) clears its fault state on the
+        next success instead of starving siblings or flipping readyz —
+        crash-loop detection targets whole-controller failure."""
+        self._pass_seen.add(controller)
+        fault = self._controller_faults.get(controller)
+        now = self.clock.now()
+        if (
+            fault is not None
+            and now < fault[0]
+            and fault[3] != self._pass_id
+        ):
+            return  # still on requeue backoff from a prior pass
+        try:
+            fn(*args)
+        except Exception as e:  # noqa: BLE001 — isolation is the point
+            self._record_reconcile_error(controller, e)
+        else:
+            if self._controller_faults.pop(controller, None) is not None:
+                self._export_crashloop()
+
+    def _record_reconcile_error(self, controller: str, e: Exception) -> None:
+        from karpenter_core_tpu.events import Event
+        from karpenter_core_tpu.metrics import wiring as m
+
+        m.RECONCILE_ERRORS.inc(
+            {"controller": controller, "error": type(e).__name__}
+        )
+        self.recorder.publish(Event(
+            involved_object=f"Controller/{controller}",
+            type="Warning",
+            reason="ReconcileError",
+            message=f"{type(e).__name__}: {e}",
+        ))
+        fault = self._controller_faults.get(controller)
+        if fault is not None and fault[3] == self._pass_id:
+            return  # already escalated this pass; don't compound the delay
+        delay = (
+            RECONCILE_BACKOFF_BASE
+            if fault is None
+            else min(fault[1] * 2.0, RECONCILE_BACKOFF_CAP)
+        )
+        # an optimistic-lock race is an expected requeue in EVERY
+        # controller, not evidence of a crash-loop: it backs off like any
+        # error (the controller-runtime rate limiter) but never advances
+        # the consecutive count that degrades readyz
+        from karpenter_core_tpu.kube.store import ConflictError
+
+        if isinstance(e, ConflictError):
+            consecutive = 0 if fault is None else fault[2]
+        else:
+            consecutive = 1 if fault is None else fault[2] + 1
+        self._controller_faults[controller] = (
+            self.clock.now() + delay, delay, consecutive, self._pass_id,
+        )
+        self._export_crashloop()
+
+    def _export_crashloop(self) -> None:
+        from karpenter_core_tpu.metrics import wiring as m
+
+        m.CONTROLLER_CRASHLOOPING.set(float(sum(
+            1
+            for fault in self._controller_faults.values()
+            if fault[2] >= CRASHLOOP_THRESHOLD
+        )))
+
+    def reconcile_backoff_wait_remaining(self) -> float:
+        """Seconds until the nearest controller requeue backoff unblocks
+        (0 when none) — lets a fake-clock driver elapse the backoff."""
+        now = self.clock.now()
+        waits = [
+            fault[0] - now for fault in self._controller_faults.values()
+            if fault[0] > now
+        ]
+        return min(waits) if waits else 0.0
 
     # -- one pass ----------------------------------------------------------
 
     def reconcile_once(self, disrupt: bool = True) -> None:
+        self._pass_id += 1
+        self._pass_seen = set()
         if self.solver_supervisor is not None:
             # supervise the sidecar every pass; after a respawn the client
             # follows the (possibly fresh) address — no operator restart
             if self.solver_supervisor.poll() and self.solver_client is not None:
                 self.solver_client.set_addr(self.solver_supervisor.addr)
         for pool in list(self.kube.list_nodepools()):
-            self.nodepool_hash.reconcile(pool)
-            self.nodepool_validation.reconcile(pool)
-            self.nodepool_readiness.reconcile(pool)
-            self.nodepool_counter.reconcile(pool)
+            self._guarded("nodepool.hash", self.nodepool_hash.reconcile, pool)
+            self._guarded(
+                "nodepool.validation", self.nodepool_validation.reconcile, pool
+            )
+            self._guarded(
+                "nodepool.readiness", self.nodepool_readiness.reconcile, pool
+            )
+            self._guarded(
+                "nodepool.counter", self.nodepool_counter.reconcile, pool
+            )
         for claim in list(self.kube.list_nodeclaims()):
-            self.lifecycle.reconcile(claim)
-            self.hydration.reconcile(claim)
-            self.nodeclaim_disruption.reconcile(claim)
-            self.expiration.reconcile(claim)
-            self.consistency.reconcile(claim)
-        self.garbage_collection.reconcile()
+            self._guarded("nodeclaim.lifecycle", self.lifecycle.reconcile, claim)
+            self._guarded("nodeclaim.hydration", self.hydration.reconcile, claim)
+            self._guarded(
+                "nodeclaim.disruption",
+                self.nodeclaim_disruption.reconcile,
+                claim,
+            )
+            self._guarded("nodeclaim.expiration", self.expiration.reconcile, claim)
+            self._guarded(
+                "nodeclaim.consistency", self.consistency.reconcile, claim
+            )
+        self._guarded("nodeclaim.gc", self.garbage_collection.reconcile)
         for node in list(self.kube.list_nodes()):
-            self.termination.reconcile(node)
-            self.node_health.reconcile(node)
-        self._bind_nominated()
+            self._guarded("node.termination", self.termination.reconcile, node)
+            self._guarded("node.health", self.node_health.reconcile, node)
+        self._guarded("binder", self._bind_nominated)
         provisionable = any(
             podutil.is_provisionable(p) for p in self.kube.list_pods()
         )
@@ -365,11 +528,21 @@ class Operator:
             # window and split it into per-pod solves
             self.batcher.reset()
             if provisionable:
-                self._provision()
+                self._guarded("provisioning", self._provision)
         if disrupt:
-            self.disruption.reconcile()
-        self.status.reconcile()
-        self._export_metrics()
+            self._guarded("disruption", self.disruption.reconcile)
+        self._guarded("status", self.status.reconcile)
+        self._guarded("metrics", self._export_metrics)
+        # drop faults of controllers with no remaining workload (their
+        # failing object vanished — nothing is failing anymore)
+        stale = [
+            name for name in self._controller_faults
+            if name not in self._pass_seen
+        ]
+        if stale:
+            for name in stale:
+                del self._controller_faults[name]
+            self._export_crashloop()
 
     def _export_metrics(self) -> None:
         """State gauges + pod/node/nodepool exporters (state/metrics.go:36-67,
@@ -429,6 +602,7 @@ class Operator:
             if self.kube.mutations == before and not self.disruption.in_flight:
                 waits = [self.batcher.wait_remaining()]
                 waits.append(self.termination.backoff_wait_remaining())
+                waits.append(self.reconcile_backoff_wait_remaining())
                 if disrupt:
                     waits.append(self.disruption.validation_wait_remaining())
                 waits = [w for w in waits if w > 0]

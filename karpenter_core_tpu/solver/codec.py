@@ -133,7 +133,13 @@ def decode_response(data: bytes) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
 # field codecs below.
 # ---------------------------------------------------------------------------
 
-SOLVE_WIRE_VERSION = 1
+# v2: solve requests carry unavailable_offerings (the ICE-cache snapshot).
+# The field is load-bearing — an old sidecar that silently dropped it would
+# pack onto stocked-out offerings and re-open the create→ICE→delete
+# livelock — so the version bumps and a mixed deployment fails EXPLICITLY
+# (version-skew error → greedy degradation with the decode-failure metric)
+# instead of silently losing the mask.
+SOLVE_WIRE_VERSION = 2
 
 
 def _json_payload(header: dict) -> bytes:
@@ -351,8 +357,12 @@ def encode_solve_request(
     pods,
     topology=None,
     max_slots: int = 256,
+    unavailable_offerings=(),
 ) -> bytes:
-    """Serialize a full scheduler input for the solverd sidecar."""
+    """Serialize a full scheduler input for the solverd sidecar.
+    ``unavailable_offerings`` is the control plane's ICE-cache snapshot
+    (instance-type×zone×capacity-type triples); it rides the wire so the
+    sidecar's DeviceScheduler masks the same offerings the client would."""
     from karpenter_core_tpu.kube import serial
 
     table, pools = _encode_it_table(instance_types)
@@ -366,6 +376,9 @@ def encode_solve_request(
         "pods": [serial.encode(p) for p in pods],
         "topology": _encode_topology(topology),
         "max_slots": max_slots,
+        "unavailable_offerings": sorted(
+            list(k) for k in unavailable_offerings
+        ),
     }
     return _json_payload(header)
 
@@ -377,6 +390,8 @@ def decode_solve_request(data: bytes) -> dict:
     h = _json_header(data)
     if h["version"] != SOLVE_WIRE_VERSION:
         raise ValueError(f"unsupported solve wire version {h['version']}")
+    from karpenter_core_tpu.cloudprovider.types import OfferingKey
+
     return {
         "nodepools": [serial.decode(d) for d in h["nodepools"]],
         "instance_types": _decode_it_table(h["it_table"], h["it_pools"]),
@@ -385,6 +400,10 @@ def decode_solve_request(data: bytes) -> dict:
         "pods": [serial.decode(d) for d in h["pods"]],
         "topology": _decode_topology(h["topology"]),
         "max_slots": h["max_slots"],
+        # absent from pre-ICE-cache encoders -> empty set, same semantics
+        "unavailable_offerings": frozenset(
+            OfferingKey(*k) for k in h.get("unavailable_offerings", [])
+        ),
     }
 
 

@@ -257,6 +257,7 @@ class RemoteScheduler:
         daemonset_pods=None,
         topology=None,
         device_scheduler_opts: Optional[dict] = None,
+        unavailable_offerings: "frozenset | set" = frozenset(),
     ):
         self.client = client
         self.nodepools = list(nodepools)
@@ -265,6 +266,9 @@ class RemoteScheduler:
         self.daemonset_pods = list(daemonset_pods or [])
         self.topology = topology
         self.max_slots = (device_scheduler_opts or {}).get("max_slots", 256)
+        # the ICE-cache snapshot ships on the wire so the sidecar masks the
+        # same offerings; the greedy fallback applies it locally too
+        self.unavailable_offerings = frozenset(unavailable_offerings)
 
     # -- the solve ---------------------------------------------------------
 
@@ -281,6 +285,7 @@ class RemoteScheduler:
                     pods,
                     topology=self.topology,
                     max_slots=self.max_slots,
+                    unavailable_offerings=self.unavailable_offerings,
                 )
             t0 = time.perf_counter()
             data, kernel = self.client.call("/solve", body)
@@ -316,6 +321,7 @@ class RemoteScheduler:
             existing_nodes=self.existing_nodes,
             daemonset_pods=self.daemonset_pods,
             topology=self.topology,
+            unavailable_offerings=self.unavailable_offerings,
         ).solve(pods)
 
     # -- response materialization -----------------------------------------

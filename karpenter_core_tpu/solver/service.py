@@ -53,6 +53,7 @@ class SolverDaemon:
             daemonset_pods=problem["daemonset_pods"],
             max_slots=problem["max_slots"],
             topology=problem["topology"],
+            unavailable_offerings=problem["unavailable_offerings"],
         )
         t0 = time.perf_counter()
         results = scheduler.solve(problem["pods"])

@@ -1,0 +1,574 @@
+"""Chaos-hardened reconcile: fault-isolated controllers, the
+unavailable-offerings (ICE) cache, and the seeded chaos harness.
+
+Three failure domains under test end-to-end:
+* a controller exception is isolated to its own requeue backoff — the pass
+  survives, the error is observable (metric + Warning event), and repeated
+  crash-looping degrades readyz;
+* a capacity stockout (typed ICE) marks the offering unavailable for a TTL
+  so the re-solve lands on the next-cheapest AVAILABLE offering on BOTH
+  solve paths — no create→ICE→delete livelock — and the offering returns
+  to service after expiry;
+* under a seeded schedule of store conflicts, 429s, latency, ICE storms and
+  provider create/delete faults the operator still converges: all
+  provisionable pods bound, nothing leaked, and identical seeds replay
+  identical event traces.
+"""
+import itertools
+
+import pytest
+
+from tests.helpers import make_nodepool, make_pod
+from tests.test_e2e import CATALOG, new_operator, replicated
+from tests.test_soak import assert_coherent
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.chaos import (
+    ChaosCloudProvider,
+    ChaosKubeClient,
+    ChaosSchedule,
+    IceStorm,
+)
+from karpenter_core_tpu.cloudprovider.kwok import KwokCloudProvider, build_catalog
+from karpenter_core_tpu.cloudprovider.types import OfferingKey
+from karpenter_core_tpu.cloudprovider.unavailableofferings import (
+    UNAVAILABLE_OFFERINGS_TTL,
+    UnavailableOfferings,
+)
+from karpenter_core_tpu.kube.store import ConflictError, KubeStore
+from karpenter_core_tpu.operator import (
+    CRASHLOOP_THRESHOLD,
+    Operator,
+    Options,
+)
+from karpenter_core_tpu.utils.clock import FakeClock
+
+
+def _reset_claim_counter():
+    """Claim names draw from a process-global counter; reproducibility
+    assertions compare event traces across runs, so each run restarts it."""
+    from karpenter_core_tpu.controllers.provisioning.scheduling import (
+        nodeclaimtemplate,
+    )
+
+    nodeclaimtemplate._claim_counter = itertools.count(1)
+
+
+def _bound_offering(op, pod_name: str) -> OfferingKey:
+    from karpenter_core_tpu.api.objects import Node, Pod
+
+    pod = op.kube.get(Pod, pod_name)
+    assert pod is not None and pod.node_name, f"{pod_name} not bound"
+    node = op.kube.get(Node, pod.node_name)
+    return OfferingKey(
+        node.labels[L.LABEL_INSTANCE_TYPE],
+        node.labels[L.LABEL_TOPOLOGY_ZONE],
+        node.labels[L.CAPACITY_TYPE_LABEL_KEY],
+    )
+
+
+class TestUnavailableOfferings:
+    def test_mark_expire_and_snapshot(self):
+        clock = FakeClock()
+        cache = UnavailableOfferings(clock)
+        key = OfferingKey("c-1x", "zone-a", "spot")
+        assert not cache.is_unavailable(key)
+        cache.mark(key)
+        assert cache.is_unavailable(key)
+        # plain tuples are the same identity (the wire decodes to tuples)
+        assert cache.is_unavailable(("c-1x", "zone-a", "spot"))
+        assert cache.snapshot() == frozenset([key])
+        clock.step(UNAVAILABLE_OFFERINGS_TTL - 1.0)
+        assert cache.is_unavailable(key)
+        # re-marking refreshes the TTL
+        cache.mark(key)
+        clock.step(2.0)
+        assert cache.is_unavailable(key)
+        clock.step(UNAVAILABLE_OFFERINGS_TTL)
+        assert not cache.is_unavailable(key)
+        assert cache.snapshot() == frozenset()
+
+    def test_default_operator_shares_one_cache_with_its_provider(self):
+        """Regression: UnavailableOfferings is falsy when empty (len 0), so
+        `passed_cache or own_cache` silently split lifecycle's cache from
+        the provider's create-pick cache. Every construction path must end
+        with ONE shared instance."""
+        op = Operator(clock=FakeClock())  # default kwok provider
+        assert op.cloud_provider.unavailable_offerings is op.unavailable_offerings
+        assert op.lifecycle.unavailable_offerings is op.unavailable_offerings
+        assert op.provisioner.unavailable_offerings is op.unavailable_offerings
+        # externally-built provider: the operator adopts ITS cache
+        op2 = new_operator()
+        assert (
+            op2.cloud_provider.unavailable_offerings
+            is op2.unavailable_offerings
+        )
+
+
+class TestCapacityStockout:
+    """The acceptance scenario: cheapest offering ICE'd -> pods land on the
+    next-cheapest AVAILABLE offering within one re-solve, on both paths."""
+
+    @pytest.mark.parametrize("solver", ["greedy", "tpu"])
+    def test_stockout_resolves_to_next_cheapest(self, solver):
+        # discover what an unconstrained run picks (the cheapest offering)
+        probe = new_operator(solver)
+        probe.kube.create(make_nodepool())
+        probe.kube.create(make_pod(cpu=1.0, name="probe"))
+        probe.run_until_idle()
+        cheapest = _bound_offering(probe, "probe")
+
+        # fresh world with that offering's capacity actually out
+        op = new_operator(solver)
+        op.cloud_provider.stockouts.add(cheapest)
+        op.kube.create(make_nodepool())
+        op.kube.create(make_pod(cpu=1.0, name="p0"))
+        iters = op.run_until_idle(max_iters=60)
+        assert iters < 60, "stockout livelocked the reconcile loop"
+
+        landed = _bound_offering(op, "p0")
+        assert landed != cheapest
+        # exactly one create->ICE->cache round, not a livelock
+        ice_events = op.recorder.with_reason("InsufficientCapacity")
+        assert len(ice_events) == 1, [e.message for e in ice_events]
+        assert op.unavailable_offerings.is_unavailable(cheapest)
+        # exactly one claim survives (the failed one was deleted)
+        assert len(op.kube.list_nodeclaims()) == 1
+
+        # TTL expiry returns the offering to service: capacity is back and
+        # the cache entry lapses, so a new pod lands on the cheapest again
+        op.cloud_provider.stockouts.clear()
+        op.clock.step(UNAVAILABLE_OFFERINGS_TTL + 1.0)
+        op.kube.create(make_pod(cpu=1.0, name="p1"))
+        op.run_until_idle(max_iters=60)
+        assert not op.unavailable_offerings.is_unavailable(cheapest)
+        assert _bound_offering(op, "p1") == cheapest
+
+    @pytest.mark.parametrize("solver", ["greedy", "tpu"])
+    def test_pinned_pod_fails_when_its_only_offering_is_iced(self, solver):
+        """A pod pinned to the stocked-out zone+capacity-type must FAIL the
+        solve (no offering), not get placed onto the masked row — this
+        exercises the greedy offering filter and the device off_avail
+        tensor mask directly."""
+        op = new_operator(solver)
+        op.kube.create(make_nodepool())
+        # pin to zone-a spot, then mark every (it, zone-a, spot) unavailable
+        for it in CATALOG:
+            op.unavailable_offerings.mark(
+                OfferingKey(it.name, "zone-a", L.CAPACITY_TYPE_SPOT),
+                ttl=10_000.0,
+            )
+        pod = make_pod(
+            cpu=1.0,
+            name="pinned",
+            zone_in=["zone-a"],
+            node_selector={L.CAPACITY_TYPE_LABEL_KEY: L.CAPACITY_TYPE_SPOT},
+        )
+        op.kube.create(pod)
+        op.run_until_idle(max_iters=40)
+        from karpenter_core_tpu.api.objects import Pod
+
+        assert not op.kube.get(Pod, "pinned").node_name
+        assert not op.kube.list_nodeclaims()
+
+    def test_codec_round_trips_unavailable_offerings(self):
+        from karpenter_core_tpu.solver import codec
+
+        keys = frozenset(
+            [
+                OfferingKey("c-1x-amd64-linux", "zone-a", "spot"),
+                OfferingKey("m-2x-arm64-linux", "zone-c", "on-demand"),
+            ]
+        )
+        data = codec.encode_solve_request(
+            [], {}, [], [], [], unavailable_offerings=keys
+        )
+        out = codec.decode_solve_request(data)
+        assert out["unavailable_offerings"] == keys
+
+
+class TestReconcileIsolation:
+    def _broken(self, op, controller_attr="garbage_collection"):
+        calls = {"n": 0}
+
+        def boom():
+            calls["n"] += 1
+            raise RuntimeError("chaos monkey")
+
+        getattr(op, controller_attr).reconcile = boom
+        return calls
+
+    def test_exception_is_isolated_and_observable(self):
+        from karpenter_core_tpu.metrics import wiring as m
+
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(make_pod(cpu=1.0, name="p0"))
+        calls = self._broken(op)
+        before = m.RECONCILE_ERRORS.value(
+            {"controller": "nodeclaim.gc", "error": "RuntimeError"}
+        )
+        op.run_until_idle()  # the pass survives; provisioning proceeds
+        assert all(p.node_name for p in op.kube.list_pods())
+        assert calls["n"] >= 1
+        assert m.RECONCILE_ERRORS.value(
+            {"controller": "nodeclaim.gc", "error": "RuntimeError"}
+        ) > before
+        events = [
+            e for e in op.recorder.with_reason("ReconcileError")
+            if e.involved_object == "Controller/nodeclaim.gc"
+        ]
+        assert events and events[0].type == "Warning"
+
+    def test_backoff_skips_until_elapsed(self):
+        op = new_operator()
+        calls = self._broken(op)
+        op.reconcile_once()
+        assert calls["n"] == 1
+        op.reconcile_once()  # same instant: still on 1s backoff
+        assert calls["n"] == 1
+        op.clock.step(1.01)
+        op.reconcile_once()
+        assert calls["n"] == 2
+
+    def test_crash_loop_flips_readyz_and_recovery_restores_it(self):
+        op = new_operator()
+        assert op.readyz()
+        calls = self._broken(op)
+        for _ in range(CRASHLOOP_THRESHOLD):
+            op.reconcile_once()
+            op.clock.step(120.0)  # past any backoff
+        assert calls["n"] == CRASHLOOP_THRESHOLD
+        assert not op.readyz()
+        # controller recovers -> next clean pass clears the fault state
+        op.garbage_collection.reconcile = lambda: None
+        op.reconcile_once()
+        assert op.readyz()
+
+    def test_broken_object_does_not_starve_controller_siblings(self):
+        """One perpetually-broken claim must not stop the lifecycle
+        controller from reconciling OTHER claims, and must not flip readyz
+        while the controller demonstrably still works (the fault state
+        clears on the next successful invocation)."""
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(make_pod(cpu=1.0, name="p0"))
+
+        real = op.lifecycle.reconcile
+        broken_name = {"value": None}
+
+        def selective(claim):
+            # break the FIRST claim seen, forever; others reconcile fine
+            if broken_name["value"] in (None, claim.name):
+                broken_name["value"] = claim.name
+                raise RuntimeError("broken object")
+            return real(claim)
+
+        op.lifecycle.reconcile = selective
+        op.run_until_idle(max_iters=60)
+        # a second pod arrives: its fresh claim must still launch and bind
+        # even though the first claim keeps crashing its reconciler
+        op.kube.create(make_pod(cpu=1.0, name="p1"))
+        op.run_until_idle(max_iters=60)
+        from karpenter_core_tpu.api.objects import Pod
+
+        assert op.kube.get(Pod, "p1").node_name
+        assert op.readyz()
+
+    def test_fault_clears_when_failing_workload_vanishes(self):
+        """A controller crash-looping on one object must not pin readyz
+        false after that object (and all its workload) is gone — the stale
+        fault entry drops on the first pass with nothing to reconcile."""
+        op = new_operator()
+        pool = make_nodepool()
+        op.kube.create(pool)
+
+        def boom(p):
+            raise RuntimeError("bad pool")
+
+        op.nodepool_hash.reconcile = boom
+        for _ in range(CRASHLOOP_THRESHOLD):
+            op.reconcile_once()
+            op.clock.step(120.0)
+        assert not op.readyz()
+        op.kube.delete(pool)  # the failing workload vanishes
+        op.clock.step(120.0)
+        op.reconcile_once()
+        assert op.readyz()
+
+    def test_conflicts_requeue_but_never_crash_loop(self):
+        """Injected optimistic-lock conflicts in ANY controller back off
+        like errors but must not degrade readyz — they are expected
+        races, not crashes (the termination-consistency story applied
+        uniformly)."""
+        op = new_operator()
+
+        def race():
+            raise ConflictError("stale resource_version")
+
+        op.garbage_collection.reconcile = race
+        for _ in range(CRASHLOOP_THRESHOLD + 2):
+            op.reconcile_once()
+            op.clock.step(120.0)
+        assert op.readyz()
+        # but they ARE observable as reconcile errors
+        assert op.recorder.with_reason("ReconcileError")
+
+    def test_provisioning_failure_does_not_kill_the_pass(self):
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(make_pod(cpu=1.0, name="p0"))
+
+        def explode():
+            raise RuntimeError("solver meltdown")
+
+        original = op.provisioner.provision
+        op.provisioner.provision = explode
+        op.reconcile_once()  # must not raise
+        assert not any(p.node_name for p in op.kube.list_pods())
+        # recovery: the batcher self-heal window re-solves the pending pods
+        op.provisioner.provision = original
+        op.clock.step(2.0)
+        op.run_until_idle()
+        assert all(p.node_name for p in op.kube.list_pods())
+
+
+class TestTerminationConflict:
+    def test_stale_resource_version_is_requeued_not_raised(self):
+        """Regression: a ConflictError on the termination controller's
+        node/claim writes used to propagate (and kill the pass); it now
+        requeues — drop the pass, retry against the fresh object."""
+        op = new_operator()
+        op.kube.create(make_nodepool())
+        op.kube.create(replicated(make_pod(cpu=1.0, name="p0")))
+        op.run_until_idle()
+        from karpenter_core_tpu.api.objects import Node
+
+        node = op.kube.list_nodes()[0]
+        op.kube.delete(node)  # finalizer holds it; termination drains
+
+        real_update = op.kube.update
+        state = {"raised": False}
+
+        def stale_once(obj):
+            if isinstance(obj, Node) and not state["raised"]:
+                state["raised"] = True
+                raise ConflictError("stale resource_version (chaos)")
+            return real_update(obj)
+
+        op.kube.update = stale_once
+        op.termination.reconcile(node)  # must not raise
+        assert state["raised"]
+        op.kube.update = real_update
+        op.run_until_idle()
+        assert node.name not in {n.name for n in op.kube.list_nodes()}
+
+
+class TestHttpClientRetry:
+    def _client(self, responses, fail_after=None):
+        from karpenter_core_tpu.kube.httpclient import HttpKubeClient
+
+        c = HttpKubeClient("127.0.0.1", 1, retry_backoff=0.001)
+        log = {"attempts": [], "sleeps": []}
+        queue = list(responses)
+
+        def fake(method, path, payload=None):
+            log["attempts"].append((method, path))
+            return queue.pop(0)
+
+        c._do_request = fake
+        c._sleep = log["sleeps"].append
+        return c, log
+
+    def test_get_retries_transient_5xx_then_succeeds(self):
+        c, log = self._client([
+            (503, {"error": "apiserver warming"}),
+            (429, {"error": "slow down"}),
+            (200, {"items": []}),
+        ])
+        assert c.list_pods() == []
+        assert len(log["attempts"]) == 3
+        # exponential: 1x, then 2x the base backoff
+        assert log["sleeps"] == [0.001, 0.002]
+
+    def test_get_retry_budget_is_bounded(self):
+        from karpenter_core_tpu.kube.store import TooManyRequestsError
+
+        c, log = self._client([(429, {"error": "n"})] * 4)
+        with pytest.raises(TooManyRequestsError):
+            c.list_pods()
+        assert len(log["attempts"]) == 4  # 1 + GET_RETRIES
+
+    def test_writes_are_never_retried(self):
+        c, log = self._client([(503, {"error": "blip"})])
+        with pytest.raises(RuntimeError):
+            c._request("POST", "/bind", {"name": "p"})
+        assert len(log["attempts"]) == 1
+        assert log["sleeps"] == []
+
+
+# -- the seeded chaos harness ------------------------------------------------
+
+
+def _chaos_operator(seed: int, solver: str = "greedy", storms=(), rates=None):
+    _reset_claim_counter()
+    clock = FakeClock()
+    store = KubeStore(clock)
+    schedule = ChaosSchedule(
+        seed=seed,
+        rates=rates
+        if rates is not None
+        else {
+            "kube.create.conflict": 0.08,
+            "kube.update.conflict": 0.05,
+            "kube.update.too_many_requests": 0.03,
+            "kube.bind.conflict": 0.05,
+            "kube.delete.too_many_requests": 0.04,
+            "kube.evict.latency": 0.10,
+            "cloud.create.create_error": 0.06,
+            "cloud.create.insufficient_capacity": 0.04,
+            "cloud.delete.delete_error": 0.06,
+        },
+    )
+    # the operator reconciles through the chaotic client; the provider
+    # materializes its fake nodes on the raw store (a provider is its own
+    # system, not a client of the apiserver under test)
+    provider = ChaosCloudProvider(
+        KwokCloudProvider(store, CATALOG), schedule, storms=storms, clock=clock
+    )
+    kube = ChaosKubeClient(store, schedule)
+    op = Operator(
+        kube=kube,
+        cloud_provider=provider,
+        clock=clock,
+        options=Options(solver=solver),
+    )
+    # workload churn (the test's own creates/deletes) models users whose
+    # requests already landed: it goes through the raw store, while every
+    # controller write rides the chaotic client
+    return op, schedule, store
+
+
+def _run_chaos_scenario(seed: int, solver: str = "greedy", waves: int = 3,
+                        pods_per_wave: int = 4):
+    cheapest = CATALOG[0].name  # ICE storm over a slice of the catalog
+    storm = IceStorm(
+        start=1_000_000.0 + 5.0,
+        duration=90.0,
+        offerings=tuple(
+            OfferingKey(it.name, zone, ct)
+            for it in CATALOG[:6]
+            for zone in ("zone-a", "zone-b")
+            for ct in (L.CAPACITY_TYPE_SPOT,)
+        ),
+    )
+    assert cheapest  # storm covers the head of the catalog
+    op, schedule, store = _chaos_operator(seed, solver=solver, storms=[storm])
+    store.create(make_nodepool())
+    serial = 0
+    for wave in range(waves):
+        for _ in range(pods_per_wave):
+            store.create(replicated(make_pod(
+                cpu=[0.5, 1.0, 2.0][serial % 3], name=f"w{serial}"
+            )))
+            serial += 1
+        op.run_until_idle(max_iters=400)
+        op.clock.step(61.0)  # past backoff caps and into/through the storm
+        op.run_until_idle(max_iters=400)
+    # storm over + caches expired: the world must settle coherent
+    op.clock.step(UNAVAILABLE_OFFERINGS_TTL + 1.0)
+    op.run_until_idle(max_iters=400)
+    return op, schedule
+
+
+class TestChaosSmoke:
+    """Tier-1 fixed-seed smoke: convergence invariants under the full fault
+    mix. reconcile_once never raises by construction of the isolation
+    wrapper — the run itself would fail loudly if it did."""
+
+    def test_converges_under_faults(self):
+        op, schedule = _run_chaos_scenario(seed=42)
+        assert schedule.draws > 0
+        assert_coherent(op)
+        assert op.readyz()
+
+    def test_identical_seeds_reproduce_identical_event_traces(self):
+        def trace(op):
+            return [
+                (e.involved_object, e.reason, e.message, e.timestamp)
+                for e in op.recorder.events
+            ]
+
+        op1, s1 = _run_chaos_scenario(seed=7)
+        op2, s2 = _run_chaos_scenario(seed=7)
+        assert s1.draws == s2.draws
+        assert trace(op1) == trace(op2)
+        assert {n.name for n in op1.kube.list_nodes()} == {
+            n.name for n in op2.kube.list_nodes()
+        }
+
+    def test_scripted_faults_consume_in_order(self):
+        # the remote.py FaultInjector contract, generalized per seam
+        s = ChaosSchedule(
+            seed=0,
+            script={"kube.create": ["conflict", "ok", "too_many_requests"]},
+        )
+        faults = [
+            s.next_fault("kube.create", ChaosKubeClient.WRITE_FAULTS)
+            for _ in range(4)
+        ]
+        assert faults == ["conflict", "ok", "too_many_requests", "ok"]
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    """The long soak: heavier churn, both solve paths, repeated storms."""
+
+    @pytest.mark.parametrize("solver", ["greedy", "tpu"])
+    def test_soak_converges(self, solver):
+        import random
+
+        rng = random.Random(99)
+        storm_offerings = tuple(
+            OfferingKey(it.name, zone, ct)
+            for it in CATALOG[:10]
+            for zone in ("zone-a", "zone-b", "zone-c")
+            for ct in (L.CAPACITY_TYPE_SPOT, L.CAPACITY_TYPE_ON_DEMAND)
+        )
+        storms = [
+            IceStorm(start=1_000_000.0 + 50.0 + i * 400.0, duration=120.0,
+                     offerings=storm_offerings)
+            for i in range(3)
+        ]
+        op, _, store = _chaos_operator(99, solver=solver, storms=storms)
+        store.create(make_nodepool())
+        live = {}
+        serial = 0
+        for cycle in range(10):
+            for _ in range(rng.randint(2, 6)):
+                name = f"s{serial}"
+                serial += 1
+                p = replicated(make_pod(
+                    cpu=rng.choice([0.25, 0.5, 1.0, 2.0]),
+                    memory_gib=rng.choice([0.5, 1.0, 2.0]),
+                    name=name,
+                ))
+                store.create(p)
+                live[name] = p
+            for name in rng.sample(
+                sorted(live), min(len(live), rng.randint(0, 4))
+            ):
+                from karpenter_core_tpu.api.objects import Pod
+
+                pod = store.get(Pod, name)
+                if pod is not None:
+                    store.delete(pod)
+                del live[name]
+            op.run_until_idle(max_iters=400)
+            op.clock.step(rng.choice([5.0, 61.0, 400.0]))
+            op.run_until_idle(max_iters=400)
+            assert_coherent(op)
+        op.clock.step(UNAVAILABLE_OFFERINGS_TTL + 1.0)
+        op.run_until_idle(max_iters=400)
+        assert_coherent(op)
+        assert op.readyz()

@@ -98,3 +98,58 @@ class TestProvisioningOverHttp:
         op.kube.poll()
         op.run_until_idle(disrupt=False)
         assert op.kube.get(Pod, "ext0").node_name
+
+
+class TestTypedErrorRoundTrip:
+    """The 409/429 contracts through httpserver + httpclient: the SAME
+    typed errors the in-memory store raises must surface from the wire, so
+    controllers (and the conflict-requeue/eviction-backoff paths built on
+    them) behave identically over either client."""
+
+    def test_stale_resource_version_round_trips_as_conflict(self, http_port):
+        from karpenter_core_tpu.kube.store import ConflictError
+
+        client = HttpKubeClient("127.0.0.1", http_port)
+        client.create(make_pod(cpu=0.5, name="c0"))
+        stale = client.get(Pod, "c0")
+        # a second writer wins the race; the stale object's update must 409
+        fresh = client.get(Pod, "c0")
+        fresh.metadata.labels["winner"] = "true"
+        client.update(fresh)
+        stale.metadata.labels["winner"] = "false"
+        with pytest.raises(ConflictError):
+            client.update(stale)
+        # and the winning write is untouched on the server
+        assert client.get(Pod, "c0").metadata.labels["winner"] == "true"
+
+    def test_create_of_existing_object_round_trips_as_conflict(
+        self, http_port
+    ):
+        from karpenter_core_tpu.kube.store import ConflictError
+
+        client = HttpKubeClient("127.0.0.1", http_port)
+        client.create(make_pod(cpu=0.5, name="dup0"))
+        with pytest.raises(ConflictError):
+            client.create(make_pod(cpu=0.5, name="dup0"))
+
+    def test_pdb_blocked_eviction_round_trips_as_429(self, http_port):
+        from karpenter_core_tpu.api.objects import (
+            LabelSelector,
+            ObjectMeta,
+            PodDisruptionBudget,
+        )
+        from karpenter_core_tpu.kube.store import TooManyRequestsError
+
+        client = HttpKubeClient("127.0.0.1", http_port)
+        client.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="block-all"),
+            selector=LabelSelector(match_labels=(("app", "web"),)),
+            min_available=1,
+        ))
+        pod = replicated(make_pod(cpu=0.5, name="e0", labels={"app": "web"}))
+        client.create(pod)
+        client.bind(pod, "some-node")
+        with pytest.raises(TooManyRequestsError):
+            client.evict(pod)
+        # the pod survived the blocked eviction, still bound
+        assert client.get(Pod, "e0").node_name == "some-node"

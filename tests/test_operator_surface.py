@@ -72,6 +72,18 @@ class TestOptionsParse:
         with pytest.raises(ValueError):
             Options.parse(["--verbose", "--solver", "tpu"], env={})
 
+    @pytest.mark.parametrize("flag", [
+        "--solver-timeout", "--batch-max-duration", "--poll-interval",
+    ])
+    @pytest.mark.parametrize("value", ["0", "-1", "-0.5"])
+    def test_non_positive_durations_rejected(self, flag, value):
+        with pytest.raises(ValueError, match="must be positive"):
+            Options.parse([flag, value], env={})
+
+    def test_non_positive_duration_rejected_from_env(self):
+        with pytest.raises(ValueError, match="must be positive"):
+            Options.parse([], env={"KARPENTER_SOLVER_TIMEOUT": "0"})
+
     def test_loop_flags_both_forms(self):
         o = Options.parse(
             ["--poll-interval=2.5", "--max-iters", "7"], env={}
@@ -98,7 +110,7 @@ class TestCLI:
         from karpenter_core_tpu.main import main
 
         assert main(["--solver", "greedy", "--max-iters", "2",
-                     "--poll-interval", "0"]) == 0
+                     "--poll-interval", "0.01"]) == 0
 
 
 class TestHydration:
