@@ -23,10 +23,14 @@ Secondary lines (reported in `detail`):
 
 Every config reports `parity_nodes_delta` = device nodes − greedy nodes
 on the identical pod set (the north star demands node-count parity, not
-just all-scheduled). Prints ONE JSON line; vs_baseline is pods/sec over
-the reference's enforced 100 pods/sec floor. Runs on whatever backend JAX
-selects (real TPU chip under the driver). Env knobs: BENCH_PODS /
-BENCH_TYPES (primary config), BENCH_FAST=1 (primary only, skips parity).
+just all-scheduled), plus a `phases` breakdown of the final warm solve
+(host plan / prepare / device kernel / decode seconds, device<->host
+bytes, adaptive slot usage, prepared-cache hits) so regressions localize
+to a phase without re-profiling. Prints ONE JSON line; vs_baseline is
+pods/sec over the reference's enforced 100 pods/sec floor. Runs on
+whatever backend JAX selects (real TPU chip under the driver). Env knobs:
+BENCH_PODS / BENCH_TYPES (primary config), BENCH_FAST=1 (primary only,
+skips parity).
 """
 from __future__ import annotations
 
@@ -260,6 +264,24 @@ def _spread(times):
     }
 
 
+def _phase_breakdown(sched) -> dict:
+    """Per-phase split of the LAST solve (DeviceScheduler.last_phase_stats):
+    host plan (topology groups + class sort), host prepare (tensor
+    build/cache), device dispatch incl. the result fetch, host decode —
+    plus the device<->host bytes actually moved, so the next round can see
+    where the remaining time lives without re-profiling."""
+    st = sched.last_phase_stats or {}
+    out = {}
+    for k in ("plan_s", "prepare_s", "kernel_s", "decode_s"):
+        if k in st:
+            out[k] = round(st[k], 4)
+    for k in ("fetch_bytes", "h2d_bytes", "rounds", "slots", "used_slots",
+              "prep_cache_hits", "prep_cache_misses"):
+        if k in st:
+            out[k] = int(st[k])
+    return out
+
+
 def _solve_bench(pods, nodepools, catalog, max_slots=1024, repeats=5,
                  parity=True):
     from karpenter_core_tpu.models.provisioner import DeviceScheduler
@@ -283,6 +305,9 @@ def _solve_bench(pods, nodepools, catalog, max_slots=1024, repeats=5,
         "cold_solve_s": round(cold, 3),
         "pods_per_sec": round(len(pods) / p50_raw, 1),
         "nodes": res.node_count(),
+        # phase split of the final warm solve (steady-state: prepared-state
+        # caches hot, adaptive slot axis settled)
+        "phases": _phase_breakdown(sched),
     })
     if parity:
         greedy_nodes, greedy_s = _greedy_nodes(pods, nodepools, catalog)

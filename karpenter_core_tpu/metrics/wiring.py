@@ -153,6 +153,16 @@ SOLVER_RELAX_ROUNDS = REGISTRY.counter(
     "solver_relaxation_rounds_total",
     "Preference-relaxation re-solves",
 )
+SOLVER_PREP_CACHE = REGISTRY.counter(
+    "solver_prepared_cache_total",
+    "Prepared-state (class batch) cache lookups by outcome (hit|miss) —"
+    " the incremental re-solve signal: steady-state solves should hit",
+)
+SOLVER_FETCH_BYTES = REGISTRY.counter(
+    "solver_device_fetch_bytes_total",
+    "Bytes fetched device->host per solve round (per-class decision planes"
+    " + used-slot topology windows, after slicing)",
+)
 
 # -- solverd sidecar RPC (solver/{service,remote,supervisor}.py) -----------
 
@@ -179,6 +189,11 @@ SOLVER_RPC_FALLBACKS = REGISTRY.counter(
 SOLVER_CIRCUIT_STATE = REGISTRY.gauge(
     "solver_circuit_breaker_state",
     "Sidecar circuit breaker: 0 closed, 1 half-open, 2 open",
+)
+SOLVERD_SCHED_CACHE = REGISTRY.counter(
+    "solverd_scheduler_cache_total",
+    "Sidecar DeviceScheduler reuse across RPC solves by outcome (hit|miss)"
+    " — a hit carries the prepared-state caches across the wire boundary",
 )
 SOLVER_SIDECAR_RESTARTS = REGISTRY.counter(
     "solver_sidecar_restarts_total",

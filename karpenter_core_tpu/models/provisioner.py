@@ -29,7 +29,8 @@ fallback placements respect limits exactly like greedy.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
+import time
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -77,7 +78,9 @@ from karpenter_core_tpu.ops.ffd import (
     ClassStep,
     FFDStatics,
     SlotState,
+    aggregate_takes,
     ffd_solve,
+    ffd_solve_donated,
 )
 from karpenter_core_tpu.scheduling import Requirement, Requirements, Taints
 from karpenter_core_tpu.solver.snapshot import PodClass, group_pods
@@ -128,6 +131,21 @@ def _bucket(n: int, lo: int = 8) -> int:
     repeated solves with drifting shapes (class counts, vocab growth, pod
     mixes) hit the jit cache instead of recompiling for seconds."""
     return max(lo, 1 << max(n - 1, 1).bit_length())
+
+
+def _bucket_steps(n: int, lo: int = 8) -> int:
+    """Half-octave bucket (… 8, 12, 16, 24, 32 …) for the SCAN STEP axis
+    only. Scan length costs wall-clock linearly — a diverse 50k topology
+    mix lands ~11.5k steps, and a pure power-of-two pad burns 40% of the
+    kernel on inert steps — so the step axis trades one extra jit entry
+    per octave for a <=33% (avg ~17%) pad ceiling. Tensor axes keep the
+    pure power-of-two buckets: their padding costs memory, not scan
+    iterations."""
+    p = _bucket(n, lo)
+    half = (p // 4) * 3
+    if half >= lo and n <= half:
+        return half
+    return p
 
 
 def _pad(a: np.ndarray, targets: dict, fill) -> np.ndarray:
@@ -193,6 +211,14 @@ class _Prepared:
     n_zones: int
     n_cts: int
     level_iters: int = 32
+    # prepared-state reuse plumbing (PR 3): Cp is the bucketed class axis
+    # the decision planes aggregate to; _batch is the prepared-cache entry
+    # the per-class tensors came from (ClassStep device arrays are cached
+    # on it by _class_steps); step_class is the device [Jp] step->class
+    # index driving the on-device takes aggregation.
+    n_classes_padded: int = 8
+    _batch: dict = field(default_factory=dict)
+    step_class: object = None
 
 
 class DeviceScheduler:
@@ -271,6 +297,54 @@ class DeviceScheduler:
             for nct in self.templates
         ]
 
+        # -- prepared-state caches (PR 3 incremental re-solve) -------------
+        # Everything encoded over a frozen vocab is a pure function of
+        # (vocab fingerprint, entity): catalog/template/existing-node
+        # tensors cache per fingerprint (_fp_cache), per-class rows cache
+        # per (fingerprint, class signature) (_row_cache), and the fully
+        # stacked class batch — including the device-resident ClassStep —
+        # caches per (fingerprint, slot count, topology-plan digest, class
+        # signature+count tuple) (_batch_cache). Relaxation rounds union
+        # the prior round's vocab (_round_frozen) so spec-shrinking relaxes
+        # keep the fingerprint and rebuild only the classes they mutated.
+        self._catalog = None
+        self._exist_label_reqs = None
+        self._universe = None
+        self._base_resources = None
+        self._fp_ids: Dict[tuple, int] = {}
+        self._fp_cache: Dict[int, dict] = {}
+        self._row_cache: Dict[tuple, dict] = {}
+        self._batch_cache: Dict[tuple, dict] = {}
+        self._round_frozen = None
+        # adaptive slot-axis sizing: warm solves start at a bucket sized
+        # from the previous solve's observed usage instead of max_slots
+        self._slots_hint: Optional[int] = None
+        self._h2d_bytes = 0
+        self.last_phase_stats: Dict[str, float] = {}
+
+    _FP_CACHE_CAP = 4
+    _BATCH_CACHE_CAP = 4
+    # entry-count bound on the per-class row cache: each row carries two
+    # [K,V] bool planes plus small vectors (~10-20KB at production K/V),
+    # so 20k entries stays in the low hundreds of MB — far above any real
+    # class-mix working set (the diverse 50k bench lands ~6k classes) but
+    # safely below sidecar OOM territory under label-churn signatures
+    _ROW_CACHE_CAP = 20_000
+
+    def update_topology_context(self, topology: Optional[Topology]) -> None:
+        """Swap the cluster topology context in place. Per-round Topology
+        state is rebuilt from the context on every solve, so a cached
+        scheduler (solverd reuses them across RPC calls keyed on the
+        problem fingerprint, which deliberately ignores the pod-derived
+        excluded-uid list) takes the request's live context here instead
+        of rebuilding the whole scheduler."""
+        self._topology_context = topology
+
+    def _dev(self, a: np.ndarray):
+        """Host->device put with byte accounting for the phase breakdown."""
+        self._h2d_bytes += a.nbytes
+        return jnp.asarray(a)
+
     # ------------------------------------------------------------------
 
     def prewarm(self, class_buckets: Sequence[int] = (8, 64, 256)) -> None:
@@ -315,9 +389,32 @@ class DeviceScheduler:
             k: dict(v) for k, v in self.remaining_resources.items()
         }
         existing_sims: List[ExistingNodeSim] = []
-        max_slots = self.max_slots
-        while max_slots < len(self.existing_nodes):
-            max_slots *= 2
+        E = len(self.existing_nodes)
+        base_slots = self.max_slots
+        while base_slots < E:
+            base_slots *= 2
+        # Adaptive slot axis: every kernel plane is [N, ...], so running a
+        # 235-node solve at the caller's 4096-slot ceiling wastes ~16x the
+        # per-step HBM traffic on slots that can never take. Warm solves
+        # start at a bucket sized from the last solve's observed usage
+        # (2x headroom); an overflow costs one cheap small-N scan and
+        # retries larger, so the packing is identical — padding slots are
+        # inert by construction (kind=0 never takes; tested by the
+        # slot-axis-invariance parity test).
+        if self._slots_hint:
+            max_slots = min(
+                base_slots,
+                max(_bucket(max(2 * self._slots_hint, E + 1)), 64),
+            )
+        else:
+            max_slots = base_slots
+        self._round_frozen = None  # vocab union seed is per solve() call
+        self.last_phase_stats = stats = {
+            "plan_s": 0.0, "prepare_s": 0.0, "kernel_s": 0.0,
+            "decode_s": 0.0, "fetch_bytes": 0, "h2d_bytes": 0,
+            "rounds": 0, "slots": max_slots, "used_slots": 0,
+            "prep_cache_hits": 0, "prep_cache_misses": 0,
+        }
 
         from karpenter_core_tpu.metrics import wiring as m
 
@@ -328,6 +425,8 @@ class DeviceScheduler:
             if not first_round:
                 m.SOLVER_RELAX_ROUNDS.inc()
             first_round = False
+            stats["rounds"] += 1
+            stats["slots"] = max_slots
             with m.SOLVER_SOLVE_DURATION.time():
                 result = self._solve_once(all_pods, max_slots)
             if result is None:  # slot overflow — retry larger
@@ -339,7 +438,13 @@ class DeviceScheduler:
                     return Results(
                         new_node_claims=[], existing_nodes=[], pod_errors=errors
                     )
-                max_slots *= 2
+                if max_slots < base_slots:
+                    # the adaptive shrink guessed low — jump back toward
+                    # the configured ceiling fast (x4) before the classic
+                    # doubling takes over past it
+                    max_slots = min(max_slots * 4, base_slots)
+                else:
+                    max_slots *= 2
                 continue
             claims, existing_sims, failed = result
             errors = {p.uid: msg for p, msg in failed}
@@ -351,6 +456,12 @@ class DeviceScheduler:
                     relaxed_any = True
             if not relaxed_any:
                 break
+        if stats["used_slots"]:
+            # decay, don't snap: a burst of small solves (prewarm, quiet
+            # cluster) must not drop the hint so far a normal batch pays a
+            # ladder of overflow retries
+            prev = self._slots_hint or 0
+            self._slots_hint = max(int(stats["used_slots"]), prev // 2)
 
         for c in claims:
             c.finalize_scheduling()
@@ -369,6 +480,9 @@ class DeviceScheduler:
             # no viable templates and no existing capacity: everything fails
             return [], [], [(p, "no nodepool matched pod") for p in pods]
 
+        stats = self.last_phase_stats
+        self._h2d_bytes = 0
+        t0 = time.perf_counter()
         # one Topology per solve round; every pod's groups are (re)built so
         # relaxed specs take effect (topology.go NewTopology:60-86)
         ctx = self._topology_context
@@ -394,55 +508,88 @@ class DeviceScheduler:
         classes = self._sorted_classes(pods, topo)
         plan = topoplan.plan_topology(classes, topo)
         self._composition_cache: Dict[tuple, tuple] = {}
+        stats["plan_s"] += time.perf_counter() - t0
 
         from karpenter_core_tpu.metrics import wiring as m
 
+        t0 = time.perf_counter()
         try:
             with m.SOLVER_PREPARE_DURATION.time():
                 prep = self._prepare_with_vocab(plan, max_slots, topo)
+                steps = self._class_steps(prep)
         except _SlotOverflow:
             return None
+        stats["prepare_s"] += time.perf_counter() - t0
+        stats["h2d_bytes"] += self._h2d_bytes
 
+        t0 = time.perf_counter()
         kernel_timer = m.SOLVER_KERNEL_DURATION.time()
         kernel_timer.__enter__()
-        state, takes, unplaced = ffd_solve(
+        # the donating twin consumes init_state's buffers in place (HBM
+        # churn); _Prepared rebuilds them per round, so mark them spent
+        state, takes, unplaced = ffd_solve_donated(
             prep.init_state,
-            self._class_steps(prep),
+            steps,
             prep.statics,
             level_iters=prep.level_iters,
         )
-        # one device->host transfer for everything decode reads; the slot
-        # planes ride along only when topology decode needs them
+        prep.init_state = None
+        # fuse the per-step takes down to per-class decision planes on
+        # device, then fetch the tiny head scalars to learn how many slots
+        # the solve actually touched — every remaining plane is sliced to
+        # that bucketed window before the single bulk fetch, so the
+        # device->host transfer scales with nodes PACKED, not max_slots
+        Cp = prep.n_classes_padded
+        takes_bc, unplaced_bc = aggregate_takes(
+            takes, unplaced, prep.step_class, num_classes=Cp
+        )
+        head = jax.device_get(
+            {"overflow": state.overflow, "next_free": state.next_free}
+        )
+        if bool(head["overflow"]):
+            kernel_timer.__exit__(None, None, None)
+            stats["kernel_s"] += time.perf_counter() - t0
+            return None
+        N = prep.n_slots
+        used = max(int(head["next_free"]), len(prep.existing_sims), 1)
+        stats["used_slots"] = max(stats["used_slots"], used)
+        ub = min(N, _bucket(used))
+
+        def win(a):  # bucketed used-slot window on the slot axis
+            return a[:ub] if ub < N else a
+
         fetch = dict(
-            overflow=state.overflow,
-            takes=takes,
-            unplaced=unplaced,
-            template=state.template,
-            # decode reads class_it host-side (_decode_composition); it
-            # rides the single post-scan fetch instead of its own sync
-            class_it=prep.class_it,
+            takes_bc=takes_bc[:, :ub] if ub < N else takes_bc,
+            unplaced_bc=unplaced_bc,
+            template=win(state.template),
         )
         if plan.has_device_topology():
             fetch.update(
-                valmask=state.valmask,
-                defines=state.defines,
-                complement=state.complement,
-                gt=state.gt,
-                lt=state.lt,
-                itmask=state.itmask,
-                hcount=state.hcount,
+                valmask=win(state.valmask),
+                defines=win(state.defines),
+                complement=win(state.complement),
+                gt=win(state.gt),
+                lt=win(state.lt),
+                itmask=win(state.itmask),
+                hcount=win(state.hcount),
                 zcount=state.zcount,
             )
+        else:
+            # only the topology-free decode reads class_it host-side
+            # (_decode_composition); it rides the single post-scan fetch
+            fetch["class_it"] = prep.class_it
         out = jax.device_get(fetch)
         kernel_timer.__exit__(None, None, None)
-        if bool(out["overflow"]):
-            return None
+        stats["kernel_s"] += time.perf_counter() - t0
+        fetched = sum(np.asarray(v).nbytes for v in out.values()) + 16
+        stats["fetch_bytes"] += fetched  # + the head scalars
+        m.SOLVER_FETCH_BYTES.inc(by=fetched)
         # slice bucketed device shapes back to the natural sizes decode
         # (and the topoplan arrays) index with
-        J = len(plan.steps)
+        C = len(prep.classes)
         sh = self._pad_shapes
-        out["takes"] = np.asarray(out["takes"])[:J]
-        out["unplaced"] = np.asarray(out["unplaced"])[:J]
+        out["takes_bc"] = np.asarray(out["takes_bc"])[:C]
+        out["unplaced_bc"] = np.asarray(out["unplaced_bc"])[:C]
         if plan.has_device_topology():
             out["valmask"] = np.asarray(out["valmask"])[:, : sh["K"], : sh["V"]]
             out["defines"] = np.asarray(out["defines"])[:, : sh["K"]]
@@ -452,11 +599,15 @@ class DeviceScheduler:
             out["itmask"] = np.asarray(out["itmask"])[:, : sh["T"]]
             out["hcount"] = np.asarray(out["hcount"])[:, : sh["Gh"]]
             out["zcount"] = np.asarray(out["zcount"])[: sh["Gz"], : sh["V"]]
-        prep.class_it = np.asarray(out["class_it"])[:, : sh["T"]]
+        else:
+            prep.class_it = np.asarray(out["class_it"])[:, : sh["T"]]
+        t0 = time.perf_counter()
         with m.SOLVER_DECODE_DURATION.time():
             claims, existing_sims, failed = self._decode(prep, out)
+        stats["decode_s"] += time.perf_counter() - t0
 
         # ineligible topology classes: host loop over the post-device cluster
+        t0 = time.perf_counter()
         fallback_pods = [p for cls in plan.fallback_classes for p in cls.pods]
         if fallback_pods:
             m.SOLVER_HOST_FALLBACK_PODS.inc(
@@ -471,6 +622,7 @@ class DeviceScheduler:
             )
             if err is not None:
                 failed.append((p, err))
+        stats["decode_s"] += time.perf_counter() - t0
         return claims, existing_sims, failed
 
     # ------------------------------------------------------------------
@@ -533,90 +685,172 @@ class DeviceScheduler:
     ) -> _Prepared:
         """Topology-free prepare entry for the consolidation sweep and the
         sharded-solver tests (callers guarantee no topology-coupled pods)."""
+        # direct prepares are not relaxation rounds: don't union a previous
+        # solve()'s vocab into this closed world
+        self._round_frozen = None
         plan = topoplan.plan_topology(self._sorted_classes(pods, topo), topo)
         return self._prepare_with_vocab(plan, max_slots, topo)
 
-    def _prepare_with_vocab(
-        self, plan: topoplan.TopoPlan, max_slots, topo: Topology
-    ) -> _Prepared:
-        from karpenter_core_tpu.solver.vocab import Vocab, encode_requirements_batch
+    # -- prepared-state construction (cached; see __init__) ---------------
 
-        classes = plan.device_classes
+    def _exist_reqs(self) -> List[Requirements]:
+        if self._exist_label_reqs is None:
+            self._exist_label_reqs = [
+                Requirements.from_labels(n.labels) for n in self.existing_nodes
+            ]
+        return self._exist_label_reqs
+
+    def _vocab_universe(self):
+        """Scheduler-lifetime label universe: (base key->values from
+        templates + existing-node labels + offerings, IT-requirement
+        key->values kept separate — catalog instance types contribute
+        VALUES only for keys some other entity mentions; see the
+        closed-world argument in solver/vocab.py and the exactness note on
+        the original inline build)."""
+        if self._universe is None:
+            base: Dict[str, set] = {}
+
+            def obs(reqs):
+                for key, req in reqs.items():
+                    base.setdefault(key, set()).update(req.values)
+
+            for t in self.templates:
+                obs(t.requirements)
+            for r in self._exist_reqs():
+                obs(r)
+            for it in self._catalog_union():
+                for off in it.offerings:
+                    obs(off.requirements)
+            it_vals: Dict[str, set] = {}
+            for it in self._catalog_union():
+                for key, req in it.requirements.items():
+                    it_vals.setdefault(key, set()).update(req.values)
+            self._universe = (base, it_vals)
+        return self._universe
+
+    def _build_vocab(self, classes: List[PodClass], plan: topoplan.TopoPlan):
+        """Canonical closed-world vocab for one solve round.
+
+        Keys and values intern in SORTED order, so two rounds with the
+        same label universe produce identical id assignments — the
+        fingerprint equality the prepared-state caches key on. Relaxation
+        rounds union the previous round's vocab (_round_frozen): a relax
+        only strips preferred terms, so the union IS the round-1 vocab and
+        every cached tensor survives the re-solve."""
+        from karpenter_core_tpu.solver.vocab import Vocab
+
+        base, it_vals = self._vocab_universe()
+        merged = {k: set(v) for k, v in base.items()}
+        for cls in classes:
+            for key, req in cls.requirements.items():
+                merged.setdefault(key, set()).update(req.values)
+        # catalog ITs contribute values only for keys mentioned by a
+        # non-catalog entity (class/template/node/offering)
+        mentioned = set(merged)
+        for key, vals in it_vals.items():
+            tgt = merged.setdefault(key, set())
+            if key in mentioned:
+                tgt.update(vals)
+        # topology-domain universe joins the closed world (the kernel's
+        # admissibility masks index the label-group keys' value rows)
+        for dg in plan.label_groups:
+            merged.setdefault(dg.key, set()).update(dg.group.domains)
+        if self._round_frozen is not None:
+            for key, names in zip(
+                self._round_frozen.key_names, self._round_frozen.value_names
+            ):
+                merged.setdefault(key, set()).update(names)
+        v = Vocab()
+        for key in sorted(merged):
+            v.key_id(key)
+            for val in sorted(merged[key]):
+                v.value_id(key, val)
+        return v.finalize()
+
+    def _resource_axis(self, classes: List[PodClass]) -> List[str]:
+        """Resource axis: the 4 well-known names, then the catalog/daemon
+        extras, then any class-only extras — each block sorted so the axis
+        (and with it the fingerprint) is stable under drifting pod mixes.
+        Daemon overhead joins every fresh claim's requests, so its resource
+        names must be on the axis or the vectorized fit check would
+        silently drop them."""
+        if self._base_resources is None:
+            names = dict.fromkeys(["cpu", "memory", "pods", "ephemeral-storage"])
+            extra = set()
+            for it in self._catalog_union():
+                extra.update(it.allocatable())
+            for o in self.daemon_overhead:
+                extra.update(o)
+            for n in sorted(extra):
+                if n not in names:
+                    names[n] = None
+            self._base_resources = list(names)
+        names = dict.fromkeys(self._base_resources)
+        extra = set()
+        for c in classes:
+            extra.update(c.requests)
+        for n in sorted(extra):
+            if n not in names:
+                names[n] = None
+        return list(names)
+
+    def _stat_inc(self, key: str) -> None:
+        st = self.last_phase_stats
+        if key in st:
+            st[key] += 1
+
+    def _fp_entry(self, frozen, resource_names: List[str]) -> Tuple[dict, int]:
+        """Catalog/template/existing-node tensors for one closed world,
+        cached per (vocab fingerprint, resource axis, existing-node set).
+        Nothing here depends on the pod mix: steady-state solves and every
+        relaxation round reuse both the host planes and the
+        device-resident copies (zero re-encode, zero re-transfer)."""
+        fp = (
+            frozen.fingerprint(),
+            tuple(resource_names),
+            tuple(n.name for n in self.existing_nodes),
+            tuple(id(n) for n in self.existing_nodes),
+        )
+        if len(self._fp_ids) > 64:  # interner bound (fp tuples are large)
+            self._fp_ids.clear()
+            self._fp_cache.clear()
+            self._row_cache.clear()
+            self._batch_cache.clear()
+        fpid = self._fp_ids.setdefault(fp, len(self._fp_ids))
+        e = self._fp_cache.get(fpid)
+        if e is not None:
+            return e, fpid
+
         catalog = self._catalog_union()
-        T, S = len(catalog), len(self.templates)
+        T, S, E = len(catalog), len(self.templates), len(self.existing_nodes)
         # T == 0 (existing-capacity-only solve) keeps a dummy never-viable
         # IT axis so reductions over T stay well-formed; same for the
         # template axis S (gathers on a zero-size axis are invalid)
-        pad_T = max(T, 1)
-        pad_S = max(S, 1)
-        exist_label_reqs = [
-            Requirements.from_labels(n.labels) for n in self.existing_nodes
-        ]
-
-        vocab = Vocab()
-        for cls in classes:
-            vocab.observe_requirements(cls.requirements)
-        for t in self.templates:
-            vocab.observe_requirements(t.requirements)
-        for r in exist_label_reqs:
-            vocab.observe_requirements(r)
-        for it in catalog:
-            for off in it.offerings:
-                vocab.observe_requirements(off.requirements)
-        # Catalog instance types contribute VALUES only for keys some other
-        # entity mentions. An 800-type catalog otherwise pushes V to 800 via
-        # the instance-type name key and bloats every [N,K,V] slot plane;
-        # instance-type narrowing rides the dedicated [N,T] itmask instead.
-        # Exactness: keys only the catalog defines never meet a non-catalog
-        # requirement in any shared-key comparison, and class/template-vs-IT
-        # compat stays correct because an unobserved IT value yields an
-        # all-false mask — empty intersection — exactly when the other side's
-        # explicit values differ (closed-world argument in solver/vocab.py).
-        mentioned = set(vocab.keys)
-        for it in catalog:
-            for key, req in it.requirements.items():
-                vocab.key_id(key)
-                if key in mentioned:
-                    for v in req.values:
-                        vocab.value_id(key, v)
-        # topology-domain universe joins the closed world (the kernel's
-        # admissibility masks index the label-group keys' value rows)
-        topoplan.observe_domains(plan, vocab)
-        frozen = vocab.finalize()
-        topoplan.finalize_arrays(plan, frozen, topo)
-        well_known = np.array(
-            [k in apilabels.WELL_KNOWN_LABELS for k in frozen.key_names], dtype=bool
-        )
-
-        # resource axis
-        resource_names = list(
-            dict.fromkeys(
-                ["cpu", "memory", "pods", "ephemeral-storage"]
-                + [n for c in classes for n in c.requests]
-                + [n for it in catalog for n in it.allocatable()]
-                # daemon overhead joins every fresh claim's requests, so its
-                # resource names must be on the axis or the vectorized fit
-                # check would silently drop them
-                + [n for o in self.daemon_overhead for n in o]
-            )
-        )
+        pad_T, pad_S = max(T, 1), max(S, 1)
+        K, V = frozen.K, frozen.V
         R = len(resource_names)
+
+        well_known = np.array(
+            [k in apilabels.WELL_KNOWN_LABELS for k in frozen.key_names],
+            dtype=bool,
+        )
 
         # Integer-unit quantization: the device planes hold integer-valued
         # float32 (milli-units for cpu and counts, Mi for memory-like
         # resources), so every in-kernel sum/difference/division is EXACT
         # below 2^24 and exact-boundary fits are neither rejected (the old
-        # K_MARGIN shaved floor((alloc-req)/r) by one at exact fits, opening
-        # a fresh node where the greedy oracle's float64 math packs the last
-        # pod) nor spuriously accepted. Requests round UP, capacity rounds
-        # DOWN — the device stays conservative at sub-unit granularity and
-        # the float64 decode refit repairs any residual optimism.
-        # cpu is the only fractional k8s resource (milli-granular); memory
-        # and hugepages quantize to Mi (exact up to 2^24 Mi = 16 TiB per
-        # slot sum), ephemeral-storage to Gi (NVMe-dense nodes reach tens
-        # of TB; Gi keeps them far under 2^24); everything else (pods,
-        # integral extended resources) keeps unit granularity so the 24-bit
-        # exact-integer headroom isn't burned on a pointless inflation.
+        # K_MARGIN shaved floor((alloc-req)/r) by one at exact fits,
+        # opening a fresh node where the greedy oracle's float64 math packs
+        # the last pod) nor spuriously accepted. Requests round UP,
+        # capacity rounds DOWN — the device stays conservative at sub-unit
+        # granularity and the float64 decode refit repairs any residual
+        # optimism. cpu is the only fractional k8s resource
+        # (milli-granular); memory and hugepages quantize to Mi (exact up
+        # to 2^24 Mi = 16 TiB per slot sum), ephemeral-storage to Gi
+        # (NVMe-dense nodes reach tens of TB; Gi keeps them far under
+        # 2^24); everything else (pods, integral extended resources) keeps
+        # unit granularity so the 24-bit exact-integer headroom isn't
+        # burned on a pointless inflation.
         _MI, _GI = 2.0**20, 2.0**30
         quant = np.array(
             [
@@ -632,11 +866,10 @@ class DeviceScheduler:
             dtype=np.float64,
         )
         # the exactness invariant the margin-free kernel floor rests on:
-        # quantized values
-        # must stay integer-representable in float32. Clamping is the
-        # enforcement — capacity clamps low (conservative), and a clamped
-        # request exceeds every real node anyway; the float64 decode refit
-        # repairs either direction.
+        # quantized values must stay integer-representable in float32.
+        # Clamping is the enforcement — capacity clamps low (conservative),
+        # and a clamped request exceeds every real node anyway; the float64
+        # decode refit repairs either direction.
         _QMAX = float(2**24 - 1)
 
         def _qraw(rl: dict) -> np.ndarray:
@@ -655,77 +888,6 @@ class DeviceScheduler:
             x = np.floor(_qraw(rl) * (1.0 + 1e-12) + 1e-9)
             return np.minimum(x, _QMAX).astype(np.float32)
 
-        class_masks = _neutralize(
-            encode_requirements_batch(frozen, [c.requirements for c in classes])
-        )
-        # strict (pod_domains) masks — what topology admissibility consults
-        # (topology.go:166-188 passes strict reqs when preferences exist)
-        from karpenter_core_tpu.scheduling.requirements import (
-            has_preferred_node_affinity,
-        )
-
-        strict_enc = encode_requirements_batch(
-            frozen,
-            [
-                c.strict_requirements
-                if c.pods and has_preferred_node_affinity(c.pods[0])
-                else c.requirements
-                for c in classes
-            ],
-        )
-        smask = np.where(
-            strict_enc.defines[:, :, None], strict_enc.mask, True
-        ) if len(classes) else np.ones((0, frozen.K, frozen.V), dtype=bool)
-        it_masks = encode_requirements_batch(frozen, [it.requirements for it in catalog])
-        tmpl_masks = _neutralize(
-            encode_requirements_batch(frozen, [t.requirements for t in self.templates])
-        )
-        if S == 0:  # dummy neutral template row (never selected: tmpl_ok False)
-            tmpl_masks = EntityMasks(
-                mask=np.ones((pad_S, frozen.K, frozen.V), dtype=bool),
-                defines=np.zeros((pad_S, frozen.K), dtype=bool),
-                concrete=np.zeros((pad_S, frozen.K), dtype=bool),
-                negative=np.ones((pad_S, frozen.K), dtype=bool),
-                gt=np.full((pad_S, frozen.K), GT_NONE, dtype=np.int32),
-                lt=np.full((pad_S, frozen.K), LT_NONE, dtype=np.int32),
-            )
-        exist_masks = (
-            _neutralize(encode_requirements_batch(frozen, exist_label_reqs))
-            if exist_label_reqs
-            else None
-        )
-
-        C = len(classes)
-
-        # dispatch the device compat kernels NOW and fetch after the host
-        # loops below — jax dispatch is async, so the [C, T] intersect and
-        # [C, S] compatible computes overlap the rvec/offering Python work
-        # instead of blocking back-to-back.
-        # class axis buckets before the jitted kernels, or a drifting class
-        # count recompiles them every solve (the shape-churn cliff)
-        cm, im, tm = class_masks, it_masks, tmpl_masks
-        Cp = _bucket(C)
-
-        def cpad(a, fill):
-            return _pad(a, {0: Cp}, fill)
-
-        cmask_p = np.where(
-            cpad(cm.defines, False)[:, :, None], cpad(cm.mask, False), True
-        )
-        class_it_dev = mops.intersects(
-            cmask_p, cpad(cm.defines, False), cpad(cm.concrete, False),
-            cpad(cm.negative, True), cpad(cm.gt, GT_NONE),
-            cpad(cm.lt, LT_NONE),
-            im.mask, im.defines, im.concrete, im.negative, im.gt, im.lt,
-        ) if C and T else None
-        tmpl_compat_dev = mops.compatible(
-            cmask_p, cpad(cm.defines, False), cpad(cm.concrete, False),
-            cpad(cm.negative, True), cpad(cm.gt, GT_NONE),
-            cpad(cm.lt, LT_NONE),
-            tm.mask, tm.defines, tm.concrete, tm.negative, tm.gt, tm.lt,
-            jnp.asarray(well_known),
-        ) if C and S else None
-
         def rvec64q(rl: dict) -> np.ndarray:
             """Requests-side quantization, float64 (ceil, unclamped)."""
             return np.ceil(_qraw(rl) * (1.0 - 1e-12) - 1e-9)
@@ -734,12 +896,25 @@ class DeviceScheduler:
             """Capacity-side quantization, float64 (floor, unclamped)."""
             return np.floor(_qraw(rl) * (1.0 + 1e-12) + 1e-9)
 
-        class_requests = np.stack(
-            [rvec(resutil.requests_for_pods(c.pods[0])) for c in classes]
-        ) if classes else np.zeros((0, R), dtype=np.float32)
-        class_requests64q = np.stack(
-            [rvec64q(resutil.requests_for_pods(c.pods[0])) for c in classes]
-        ) if classes else np.zeros((0, R), dtype=np.float64)
+        from karpenter_core_tpu.solver.vocab import encode_requirements_batch
+
+        it_masks = encode_requirements_batch(
+            frozen, [it.requirements for it in catalog]
+        )
+        tmpl_masks = _neutralize(
+            encode_requirements_batch(
+                frozen, [t.requirements for t in self.templates]
+            )
+        )
+        if S == 0:  # dummy neutral template row (never selected)
+            tmpl_masks = EntityMasks(
+                mask=np.ones((pad_S, K, V), dtype=bool),
+                defines=np.zeros((pad_S, K), dtype=bool),
+                concrete=np.zeros((pad_S, K), dtype=bool),
+                negative=np.ones((pad_S, K), dtype=bool),
+                gt=np.full((pad_S, K), GT_NONE, dtype=np.int32),
+                lt=np.full((pad_S, K), LT_NONE, dtype=np.int32),
+            )
 
         it_alloc = np.zeros((pad_T, R), dtype=np.float32)
         it_alloc64q = np.zeros((pad_T, R), dtype=np.float64)
@@ -768,15 +943,8 @@ class DeviceScheduler:
                 if z is not None and c_ is not None:
                     off_avail[ti, z, c_] = True
 
-        taint_ok = np.array(
-            [
-                [_tolerates_taints(c.tolerations, t.taints) for t in self.templates]
-                for c in classes
-            ],
-            dtype=bool,
-        ) if C and S else np.zeros((C, pad_S), dtype=bool)
-
-        # template-IT viability from the host prefilter (exact reference path)
+        # template-IT viability from the host prefilter (exact reference
+        # path)
         it_index = {id(it): i for i, it in enumerate(catalog)}
         tmpl_it = np.zeros((pad_S, pad_T), dtype=bool)
         for si, t in enumerate(self.templates):
@@ -789,80 +957,54 @@ class DeviceScheduler:
             [rvec64q(o) for o in self.daemon_overhead]
         ) if S else np.zeros((pad_S, R), dtype=np.float64)
 
-
-        # initial slot state with existing nodes seeded in rows [0, E)
-        N = max_slots
-        K, V = frozen.K, frozen.V
-        E = len(self.existing_nodes)
-        if E > N:
-            raise _SlotOverflow()
-
-        valmask = np.ones((N, K, V), dtype=bool)
-        defines = np.zeros((N, K), dtype=bool)
-        complement = np.ones((N, K), dtype=bool)
-        negative = np.ones((N, K), dtype=bool)
-        gt = np.full((N, K), GT_NONE, dtype=np.int32)
-        lt = np.full((N, K), LT_NONE, dtype=np.int32)
-        itmask = np.zeros((N, pad_T), dtype=bool)
-        requests = np.zeros((N, R), dtype=np.float32)
-        capacity = np.full((N, R), np.float32(BIG))
-        kind = np.zeros((N,), dtype=np.int8)
-        template_arr = np.full((N,), -1, dtype=np.int32)
-
-        existing_sims = []
+        # existing-node init rows (seeded into slot rows [0, E) each round)
+        exist_masks = (
+            _neutralize(encode_requirements_batch(frozen, self._exist_reqs()))
+            if E
+            else None
+        )
+        ex_valmask = np.ones((E, K, V), dtype=bool)
+        ex_defines = np.zeros((E, K), dtype=bool)
+        ex_complement = np.ones((E, K), dtype=bool)
+        ex_negative = np.ones((E, K), dtype=bool)
+        ex_gt = np.full((E, K), GT_NONE, dtype=np.int32)
+        ex_lt = np.full((E, K), LT_NONE, dtype=np.int32)
+        ex_requests = np.zeros((E, R), dtype=np.float32)
+        ex_capacity = np.zeros((E, R), dtype=np.float32)
         for ei, node in enumerate(self.existing_nodes):
-            sim = ExistingNodeSim(node, topo, self._node_daemon_overhead(node))
-            existing_sims.append(sim)
-            valmask[ei] = exist_masks.mask[ei]
-            defines[ei] = exist_masks.defines[ei]
-            complement[ei] = np.where(
+            # same arithmetic as ExistingNodeSim: daemon overhead minus the
+            # node's own daemon requests, floored at zero
+            remaining = resutil.subtract(
+                self._node_daemon_overhead(node), node.daemon_requests
+            )
+            for k_ in list(remaining):
+                if remaining[k_] < 0:
+                    remaining[k_] = 0.0
+            ex_requests[ei] = rvec(remaining)
+            ex_capacity[ei] = rvec_cap(node.available)
+            ex_valmask[ei] = exist_masks.mask[ei]
+            ex_defines[ei] = exist_masks.defines[ei]
+            ex_complement[ei] = np.where(
                 exist_masks.defines[ei], ~exist_masks.concrete[ei], True
             )
-            negative[ei] = np.where(
+            ex_negative[ei] = np.where(
                 exist_masks.defines[ei], exist_masks.negative[ei], True
             )
-            gt[ei] = exist_masks.gt[ei]
-            lt[ei] = exist_masks.lt[ei]
-            requests[ei] = rvec(sim.requests)
-            capacity[ei] = rvec_cap(sim.cached_available)
-            kind[ei] = 1
+            ex_gt[ei] = exist_masks.gt[ei]
+            ex_lt[ei] = exist_masks.lt[ei]
 
-        exist_taint_ok = np.ones((C, N), dtype=bool)
-        for ci, cls in enumerate(classes):
-            for ei, node in enumerate(self.existing_nodes):
-                exist_taint_ok[ci, ei] = _tolerates_taints(
-                    cls.tolerations, node.taints
-                )
-
-        # topology count state: hostname-group counts seeded per existing
-        # slot; positive counts on non-slot hostnames only matter for the
-        # affinity bootstrap check (h_possel0)
-        slot_names = [n.name for n in self.existing_nodes]
-        hcount0 = topoplan.initial_hcounts(plan, slot_names, N).T  # [N, Gh]
-        slot_name_set = set(slot_names)
-        h_possel0 = np.zeros((plan.Gh,), dtype=bool)
-        for gi, dg in enumerate(plan.host_groups):
-            h_possel0[gi] = any(
-                cnt > 0
-                for name, cnt in dg.group.domains.items()
-                if name not in slot_name_set
-            )
-
-        # -- shape bucketing (the jit-cache / compile-cliff defense) --------
+        # -- shape bucketing (the jit-cache / compile-cliff defense) -------
         # Padded entities are inert by construction: keys/values pad to the
         # neutral invariant (all-True slot valmask, False class/template
         # masks under defines=False), instance types/templates pad
         # never-viable, topology groups pad owner/sel=False, resources pad
-        # zero-request. The kernel runs at padded shapes; _solve_once slices
-        # outputs back to natural sizes before decode.
+        # zero-request. The kernel runs at padded shapes; _solve_once
+        # slices outputs back to natural sizes before decode.
         Kp = _bucket(K)
         Vp = _bucket(V)
         Tp = _bucket(pad_T)
         Sp = _bucket(pad_S, lo=2)
         Rp = _bucket(R, lo=4)
-        Ghp = _bucket(plan.Gh, lo=1)
-        Gzp = _bucket(plan.Gz, lo=1)
-        self._pad_shapes = dict(K=K, V=V, T=pad_T, Gh=plan.Gh, Gz=plan.Gz)
 
         def pad_masks(mask, defines_, concrete_like_complement, negative_,
                       gt_, lt_):
@@ -886,50 +1028,279 @@ class DeviceScheduler:
             tmpl_masks.gt,
             tmpl_masks.lt,
         )
-        statics = FFDStatics(
-            it_alloc=jnp.asarray(_pad(it_alloc, {0: Tp, 1: Rp}, 0.0)),
-            off_avail=jnp.asarray(_pad(off_avail, {0: Tp}, False)),
-            zone_key=jnp.int32(zone_kid),
-            ct_key=jnp.int32(ct_kid),
-            tmpl_mask=jnp.asarray(_pad(tm_mask, {0: Sp}, True)),
-            tmpl_defines=jnp.asarray(_pad(tm_def, {0: Sp}, False)),
-            tmpl_complement=jnp.asarray(_pad(tm_comp, {0: Sp}, True)),
-            tmpl_negative=jnp.asarray(_pad(tm_neg, {0: Sp}, True)),
-            tmpl_gt=jnp.asarray(_pad(tm_gt, {0: Sp}, GT_NONE)),
-            tmpl_lt=jnp.asarray(_pad(tm_lt, {0: Sp}, LT_NONE)),
-            tmpl_it=jnp.asarray(_pad(tmpl_it, {0: Sp, 1: Tp}, False)),
-            tmpl_overhead=jnp.asarray(_pad(tmpl_overhead, {0: Sp, 1: Rp}, 0.0)),
-            well_known=jnp.asarray(_pad(well_known, {0: Kp}, False)),
-            gt_none=jnp.int32(GT_NONE),
-            lt_none=jnp.int32(LT_NONE),
-            h_type=jnp.asarray(_pad(plan.h_type, {0: Ghp}, 0)),
-            h_skew=jnp.asarray(_pad(plan.h_skew, {0: Ghp}, 0)),
-            h_possel0=jnp.asarray(_pad(h_possel0, {0: Ghp}, False)),
-            z_type=jnp.asarray(_pad(plan.z_type, {0: Gzp}, 0)),
-            z_skew=jnp.asarray(_pad(plan.z_skew, {0: Gzp}, 0)),
-            z_key=jnp.asarray(_pad(plan.z_key, {0: Gzp}, 0)),
-            z_mindom=jnp.asarray(
-                _pad(plan.z_mindom, {0: Gzp}, topoplan.NO_MIN_DOMAINS)
-            ),
-            z_domains=jnp.asarray(_pad(plan.z_domains, {0: Gzp, 1: Vp}, False)),
-            z_rank=jnp.asarray(_pad(plan.z_rank, {0: Gzp, 1: Vp}, RANK_NONE)),
-        )
 
+        e = dict(
+            fp=fp,
+            resource_names=list(resource_names),
+            quant=quant,
+            rvec=rvec, rvec_cap=rvec_cap,
+            rvec64q=rvec64q, rvec64q_cap=rvec64q_cap,
+            it_masks=it_masks,
+            tmpl_masks=tmpl_masks,
+            tmpl_mask_np=tmpl_masks.mask,
+            it_alloc=it_alloc, it_alloc64q=it_alloc64q,
+            off_avail=off_avail, tmpl_it=tmpl_it,
+            tmpl_overhead=tmpl_overhead, tmpl_overhead64q=tmpl_overhead64q,
+            tmpl_zone_mask=tmpl_masks.mask[:, zone_kid, :Z],
+            tmpl_ct_mask=tmpl_masks.mask[:, ct_kid, :CT],
+            zone_kid=zone_kid, ct_kid=ct_kid, Z=Z, CT=CT,
+            K=K, V=V, R=R, T=T, S=S, E=E, pad_T=pad_T, pad_S=pad_S,
+            Kp=Kp, Vp=Vp, Tp=Tp, Sp=Sp, Rp=Rp,
+            well_known=well_known,
+            ex_valmask=ex_valmask, ex_defines=ex_defines,
+            ex_complement=ex_complement, ex_negative=ex_negative,
+            ex_gt=ex_gt, ex_lt=ex_lt,
+            ex_requests=ex_requests, ex_capacity=ex_capacity,
+            # device-resident copies (reused across solves via this cache)
+            it_alloc_d=self._dev(_pad(it_alloc, {0: Tp, 1: Rp}, 0.0)),
+            off_avail_d=self._dev(_pad(off_avail, {0: Tp}, False)),
+            zone_key_d=jnp.int32(zone_kid),
+            ct_key_d=jnp.int32(ct_kid),
+            tm_mask_d=self._dev(_pad(tm_mask, {0: Sp}, True)),
+            tm_def_d=self._dev(_pad(tm_def, {0: Sp}, False)),
+            tm_comp_d=self._dev(_pad(tm_comp, {0: Sp}, True)),
+            tm_neg_d=self._dev(_pad(tm_neg, {0: Sp}, True)),
+            tm_gt_d=self._dev(_pad(tm_gt, {0: Sp}, GT_NONE)),
+            tm_lt_d=self._dev(_pad(tm_lt, {0: Sp}, LT_NONE)),
+            tmpl_it_d=self._dev(_pad(tmpl_it, {0: Sp, 1: Tp}, False)),
+            tmpl_overhead_d=self._dev(
+                _pad(tmpl_overhead, {0: Sp, 1: Rp}, 0.0)
+            ),
+            well_known_pad_d=self._dev(_pad(well_known, {0: Kp}, False)),
+            well_known_d=self._dev(well_known),
+            # natural-shape entity planes for the compat kernels
+            im_planes_d=tuple(
+                self._dev(np.asarray(x))
+                for x in (
+                    it_masks.mask, it_masks.defines, it_masks.concrete,
+                    it_masks.negative, it_masks.gt, it_masks.lt,
+                )
+            ) if T else None,
+            tm_planes_d=tuple(
+                self._dev(np.asarray(x))
+                for x in (
+                    tmpl_masks.mask, tmpl_masks.defines, tmpl_masks.concrete,
+                    tmpl_masks.negative, tmpl_masks.gt, tmpl_masks.lt,
+                )
+            ),
+        )
+        if len(self._fp_cache) >= self._FP_CACHE_CAP:
+            old = next(iter(self._fp_cache))
+            del self._fp_cache[old]
+            self._row_cache = {
+                k: v for k, v in self._row_cache.items() if k[0] != old
+            }
+            self._batch_cache = {
+                k: v for k, v in self._batch_cache.items() if k[0] != old
+            }
+        self._fp_cache[fpid] = e
+        return e, fpid
+
+    def _plan_digest(self, plan: topoplan.TopoPlan) -> bytes:
+        """Content digest of the lowered topology plan — everything the
+        class batch (owner/sel incidence, water-fill steps, domain ranks)
+        bakes into its tensors. zcount0 is deliberately excluded: live
+        domain counts feed init_state, which is rebuilt every round."""
+        import hashlib
+
+        h = hashlib.sha1()
+        for a in (
+            plan.h_type, plan.h_skew, plan.h_sel, plan.h_owner,
+            plan.z_type, plan.z_skew, plan.z_key, plan.z_mindom,
+            plan.z_sel, plan.z_owner, plan.z_domains, plan.z_rank,
+        ):
+            h.update(b"|")
+            if a is not None:
+                h.update(np.ascontiguousarray(a).tobytes())
+        for s in plan.steps:
+            h.update(
+                (
+                    f";{s.class_idx},{s.sub_value},{int(s.sub_first)},"
+                    f"{int(s.sub_last)},{s.wf_group},{s.wf_key}"
+                ).encode()
+            )
+            if s.zone_rest is not None:
+                h.update(np.ascontiguousarray(s.zone_rest).tobytes())
+        return h.digest()
+
+    def _class_batch(
+        self,
+        fpid: int,
+        frozen,
+        entry: dict,
+        plan: topoplan.TopoPlan,
+        classes: List[PodClass],
+        N: int,
+    ) -> dict:
+        """Stacked per-class tensors + the device compat/viability results.
+
+        Cached on (fingerprint, slot count, plan digest, ordered class
+        signature+count tuple): a steady-state re-solve — including every
+        sidecar RPC with an unchanged cluster — returns the whole batch
+        (and its device-resident ClassStep, attached by _class_steps)
+        without touching numpy. Relaxation rounds miss here but hit the
+        per-class row cache for every class the relax did NOT mutate."""
+        digest = self._plan_digest(plan)
+        sig_tuple = tuple((cls.signature, cls.count) for cls in classes)
+        key = (fpid, N, digest, sig_tuple)
+        from karpenter_core_tpu.metrics import wiring as m
+
+        b = self._batch_cache.get(key)
+        if b is not None:
+            self._stat_inc("prep_cache_hits")
+            m.SOLVER_PREP_CACHE.inc({"outcome": "hit"})
+            return b
+        self._stat_inc("prep_cache_misses")
+        m.SOLVER_PREP_CACHE.inc({"outcome": "miss"})
+
+        from karpenter_core_tpu.scheduling.requirements import (
+            has_preferred_node_affinity,
+        )
+        from karpenter_core_tpu.solver.vocab import encode_requirements_batch
+
+        C = len(classes)
+        K, V, R = entry["K"], entry["V"], entry["R"]
+        T, S, E = entry["T"], entry["S"], entry["E"]
+        Kp, Vp, Tp, Sp, Rp = (
+            entry["Kp"], entry["Vp"], entry["Tp"], entry["Sp"], entry["Rp"]
+        )
+        Z, CT = entry["Z"], entry["CT"]
+        zone_kid, ct_kid = entry["zone_kid"], entry["ct_kid"]
+
+        rows: List[Optional[dict]] = []
+        miss: List[int] = []
+        for i, cls in enumerate(classes):
+            r = self._row_cache.get((fpid, cls.signature))
+            rows.append(r)
+            if r is None:
+                miss.append(i)
+        if miss:
+            enc = encode_requirements_batch(
+                frozen, [classes[i].requirements for i in miss]
+            )
+            # strict (pod_domains) masks — what topology admissibility
+            # consults (topology.go:166-188 passes strict reqs when
+            # preferences exist)
+            strict_enc = encode_requirements_batch(
+                frozen,
+                [
+                    classes[i].strict_requirements
+                    if classes[i].pods
+                    and has_preferred_node_affinity(classes[i].pods[0])
+                    else classes[i].requirements
+                    for i in miss
+                ],
+            )
+            for j, i in enumerate(miss):
+                cls = classes[i]
+                req = resutil.requests_for_pods(cls.pods[0])
+                row = dict(
+                    mask=enc.mask[j],
+                    defines=enc.defines[j],
+                    concrete=enc.concrete[j],
+                    negative=enc.negative[j],
+                    gt=enc.gt[j],
+                    lt=enc.lt[j],
+                    smask=np.where(
+                        strict_enc.defines[j][:, None], strict_enc.mask[j],
+                        True,
+                    ),
+                    req=entry["rvec"](req),
+                    req64=entry["rvec64q"](req),
+                    taint_ok=np.array(
+                        [
+                            _tolerates_taints(cls.tolerations, t.taints)
+                            for t in self.templates
+                        ],
+                        dtype=bool,
+                    ),
+                    exist_taint_ok=np.array(
+                        [
+                            _tolerates_taints(cls.tolerations, n.taints)
+                            for n in self.existing_nodes
+                        ],
+                        dtype=bool,
+                    ),
+                )
+                self._row_cache[(fpid, cls.signature)] = row
+                rows[i] = row
+            if len(self._row_cache) > self._ROW_CACHE_CAP:
+                self._row_cache.clear()
+
+        if C:
+            class_masks = _neutralize(
+                EntityMasks(
+                    mask=np.stack([r["mask"] for r in rows]),
+                    defines=np.stack([r["defines"] for r in rows]),
+                    concrete=np.stack([r["concrete"] for r in rows]),
+                    negative=np.stack([r["negative"] for r in rows]),
+                    gt=np.stack([r["gt"] for r in rows]),
+                    lt=np.stack([r["lt"] for r in rows]),
+                )
+            )
+            smask = np.stack([r["smask"] for r in rows])
+            class_requests = np.stack([r["req"] for r in rows])
+            class_requests64q = np.stack([r["req64"] for r in rows])
+        else:
+            class_masks = EntityMasks(
+                mask=np.ones((0, K, V), dtype=bool),
+                defines=np.zeros((0, K), dtype=bool),
+                concrete=np.zeros((0, K), dtype=bool),
+                negative=np.ones((0, K), dtype=bool),
+                gt=np.full((0, K), GT_NONE, dtype=np.int32),
+                lt=np.full((0, K), LT_NONE, dtype=np.int32),
+            )
+            smask = np.ones((0, K, V), dtype=bool)
+            class_requests = np.zeros((0, R), dtype=np.float32)
+            class_requests64q = np.zeros((0, R), dtype=np.float64)
+
+        taint_ok = (
+            np.stack([r["taint_ok"] for r in rows])
+            if C and S
+            else np.zeros((C, entry["pad_S"]), dtype=bool)
+        )
+        exist_taint_ok = np.ones((C, N), dtype=bool)
+        if C and E:
+            exist_taint_ok[:, :E] = np.stack(
+                [r["exist_taint_ok"] for r in rows]
+            )
+
+        Cp = _bucket(C)
+
+        def cpad(a, fill):
+            return _pad(a, {0: Cp}, fill)
+
+        cm = class_masks
         # Fresh-node viability + kstar per class, ON DEVICE (ops/masks
-        # fresh_viability) over the statics' BUCKETED arrays, so drifting
+        # fresh_viability) over the BUCKETED arrays, so drifting
         # template/catalog/resource counts reuse the jit entry like every
         # other kernel: the compat results never detour through the host,
-        # and the solve's only device sync is the post-scan output fetch
-        # (class_it rides along in it for the decode). Dead-on equal to the
-        # retired host loop: same quantized float32 floor arithmetic,
-        # first-template-wins (pad rows carry tmpl_ok False and can never
-        # be chosen).
+        # and the solve's only device sync is the post-scan output fetch.
+        # Dead-on equal to the retired host loop: same quantized float32
+        # floor arithmetic, first-template-wins (pad rows carry tmpl_ok
+        # False and can never be chosen).
         if C and S and T:
+            cmask_p = np.where(
+                cpad(cm.defines, False)[:, :, None], cpad(cm.mask, False),
+                True,
+            )
+            class_args = (
+                self._dev(cmask_p),
+                self._dev(cpad(cm.defines, False)),
+                self._dev(cpad(cm.concrete, False)),
+                self._dev(cpad(cm.negative, True)),
+                self._dev(cpad(cm.gt, GT_NONE)),
+                self._dev(cpad(cm.lt, LT_NONE)),
+            )
+            class_it_dev = mops.intersects(*class_args, *entry["im_planes_d"])
+            tmpl_compat_dev = mops.compatible(
+                *class_args, *entry["tm_planes_d"], entry["well_known_d"]
+            )
             class_it_b = jnp.pad(
                 class_it_dev,
                 ((0, 0), (0, Tp - class_it_dev.shape[1])),
             ) if class_it_dev.shape[1] < Tp else class_it_dev
-            tmpl_ok_b = jnp.asarray(
+            tmpl_ok_b = self._dev(
                 _pad(taint_ok, {0: Cp, 1: Sp}, False)
             ) & jnp.pad(
                 tmpl_compat_dev,
@@ -938,19 +1309,15 @@ class DeviceScheduler:
             new_template, kstar = mops.fresh_viability(
                 class_it_b,
                 tmpl_ok_b,
-                statics.tmpl_it,
-                jnp.asarray(cpad(class_masks.mask[:, zone_kid, :Z], False)),
-                jnp.asarray(cpad(class_masks.mask[:, ct_kid, :CT], False)),
-                jnp.asarray(
-                    _pad(tmpl_masks.mask[:, zone_kid, :Z], {0: Sp}, False)
-                ),
-                jnp.asarray(
-                    _pad(tmpl_masks.mask[:, ct_kid, :CT], {0: Sp}, False)
-                ),
-                statics.off_avail,
-                statics.it_alloc,
-                statics.tmpl_overhead,
-                jnp.asarray(cpad(_pad(class_requests, {1: Rp}, 0.0), 0.0)),
+                entry["tmpl_it_d"],
+                self._dev(cpad(cm.mask[:, zone_kid, :Z], False)),
+                self._dev(cpad(cm.mask[:, ct_kid, :CT], False)),
+                self._dev(_pad(entry["tmpl_zone_mask"], {0: Sp}, False)),
+                self._dev(_pad(entry["tmpl_ct_mask"], {0: Sp}, False)),
+                entry["off_avail_d"],
+                entry["it_alloc_d"],
+                entry["tmpl_overhead_d"],
+                self._dev(cpad(_pad(class_requests, {1: Rp}, 0.0), 0.0)),
             )
             class_it = class_it_b  # [Cp, Tp] device-resident
             tmpl_ok = tmpl_ok_b  # [Cp, Sp] device-resident
@@ -959,36 +1326,187 @@ class DeviceScheduler:
             tmpl_ok = jnp.zeros((Cp, Sp), dtype=bool)
             new_template = jnp.full((Cp,), -1, dtype=jnp.int32)
             kstar = jnp.zeros((Cp,), dtype=jnp.int32)
+
+        b = dict(
+            class_masks=class_masks,
+            smask=smask,
+            class_requests=class_requests,
+            class_requests64q=class_requests64q,
+            taint_ok=taint_ok,
+            exist_taint_ok=exist_taint_ok,
+            class_it=class_it,
+            tmpl_ok=tmpl_ok,
+            new_template=new_template,
+            kstar=kstar,
+            Cp=Cp,
+            class_steps=None,
+            step_class=None,
+        )
+        if len(self._batch_cache) >= self._BATCH_CACHE_CAP:
+            del self._batch_cache[next(iter(self._batch_cache))]
+        self._batch_cache[key] = b
+        return b
+
+    def _make_init_state(
+        self,
+        entry: dict,
+        plan: topoplan.TopoPlan,
+        N: int,
+        hcount0: np.ndarray,
+        Ghp: int,
+        Gzp: int,
+    ) -> SlotState:
+        """Fresh device SlotState with existing nodes seeded in rows
+        [0, E). Rebuilt every round from the fp entry's cached host rows:
+        ffd_solve_donated consumes the previous round's buffers in place,
+        so they can never be reused across dispatches."""
+        K, V, R = entry["K"], entry["V"], entry["R"]
+        E = entry["E"]
+        Kp, Vp, Tp, Rp = entry["Kp"], entry["Vp"], entry["Tp"], entry["Rp"]
+
+        valmask = np.ones((N, K, V), dtype=bool)
+        defines = np.zeros((N, K), dtype=bool)
+        complement = np.ones((N, K), dtype=bool)
+        negative = np.ones((N, K), dtype=bool)
+        gt = np.full((N, K), GT_NONE, dtype=np.int32)
+        lt = np.full((N, K), LT_NONE, dtype=np.int32)
+        requests = np.zeros((N, R), dtype=np.float32)
+        capacity = np.full((N, R), np.float32(BIG))
+        kind = np.zeros((N,), dtype=np.int8)
+        template_arr = np.full((N,), -1, dtype=np.int32)
+        if E:
+            valmask[:E] = entry["ex_valmask"]
+            defines[:E] = entry["ex_defines"]
+            complement[:E] = entry["ex_complement"]
+            negative[:E] = entry["ex_negative"]
+            gt[:E] = entry["ex_gt"]
+            lt[:E] = entry["ex_lt"]
+            requests[:E] = entry["ex_requests"]
+            capacity[:E] = entry["ex_capacity"]
+            kind[:E] = 1
+
         # slot valmask pads True everywhere: defined keys re-acquire False
-        # pad columns on first intersection with a (False-padded) class mask;
-        # EXISTING slots' defined keys must pad False now or anti-affinity
-        # rowcounts see phantom values
+        # pad columns on first intersection with a (False-padded) class
+        # mask; EXISTING slots' defined keys must pad False now or
+        # anti-affinity rowcounts see phantom values
         valmask_p = _pad(valmask, {1: Kp, 2: Vp}, True)
         defines_p = _pad(defines, {1: Kp}, False)
-        valmask_p[:, : K] = np.where(
+        valmask_p[:, :K] = np.where(
             defines[:, :K, None],
             _pad(valmask, {2: Vp}, False)[:, :K],
             valmask_p[:, :K],
         )
-        init_state = SlotState(
-            valmask=jnp.asarray(valmask_p),
-            defines=jnp.asarray(defines_p),
-            complement=jnp.asarray(_pad(complement, {1: Kp}, True)),
-            negative=jnp.asarray(_pad(negative, {1: Kp}, True)),
-            gt=jnp.asarray(_pad(gt, {1: Kp}, GT_NONE)),
-            lt=jnp.asarray(_pad(lt, {1: Kp}, LT_NONE)),
-            itmask=jnp.asarray(_pad(itmask, {1: Tp}, False)),
-            requests=jnp.asarray(_pad(requests, {1: Rp}, 0.0)),
-            capacity=jnp.asarray(_pad(capacity, {1: Rp}, np.float32(BIG))),
-            kind=jnp.asarray(kind),
-            template=jnp.asarray(template_arr),
+        return SlotState(
+            valmask=self._dev(valmask_p),
+            defines=self._dev(defines_p),
+            complement=self._dev(_pad(complement, {1: Kp}, True)),
+            negative=self._dev(_pad(negative, {1: Kp}, True)),
+            gt=self._dev(_pad(gt, {1: Kp}, GT_NONE)),
+            lt=self._dev(_pad(lt, {1: Kp}, LT_NONE)),
+            itmask=self._dev(np.zeros((N, Tp), dtype=bool)),
+            requests=self._dev(_pad(requests, {1: Rp}, 0.0)),
+            capacity=self._dev(_pad(capacity, {1: Rp}, np.float32(BIG))),
+            kind=self._dev(kind),
+            template=self._dev(template_arr),
             podcount=jnp.zeros((N,), dtype=jnp.int32),
             next_free=jnp.int32(E),
             overflow=jnp.asarray(False),
-            hcount=jnp.asarray(_pad(hcount0, {1: Ghp}, 0)),
-            zcount=jnp.asarray(_pad(plan.zcount0, {0: Gzp, 1: Vp}, 0)),
+            hcount=self._dev(_pad(hcount0, {1: Ghp}, 0)),
+            zcount=self._dev(_pad(plan.zcount0, {0: Gzp, 1: Vp}, 0)),
             carry=jnp.int32(0),
         )
+
+    def _prepare_with_vocab(
+        self, plan: topoplan.TopoPlan, max_slots, topo: Topology
+    ) -> _Prepared:
+        """Assemble the device problem, reusing every tensor the pod mix
+        did not invalidate.
+
+        Three cache layers (see __init__) make re-solves incremental: the
+        canonical vocab fingerprint keys the catalog/template/existing-node
+        tensors (_fp_entry); per-class rows key on the class signature so
+        a relaxation round re-encodes only the classes the relax mutated;
+        and the stacked class batch — host planes plus the device-resident
+        compat/viability results and the scanned ClassStep — keys on the
+        ordered signature+count tuple and the topology-plan digest, so a
+        steady-state re-solve skips the numpy rebuild entirely. Only
+        genuinely per-round state is rebuilt every call: the plan lowering,
+        the live count seeds (hcount0/zcount0), and init_state, whose
+        device buffers are donated to the kernel and cannot outlive one
+        dispatch."""
+        classes = plan.device_classes
+        catalog = self._catalog_union()
+        E = len(self.existing_nodes)
+        N = max_slots
+        if E > N:
+            raise _SlotOverflow()
+
+        frozen = self._build_vocab(classes, plan)
+        self._round_frozen = frozen
+        topoplan.finalize_arrays(plan, frozen, topo)
+        resource_names = self._resource_axis(classes)
+        entry, fpid = self._fp_entry(frozen, resource_names)
+        batch = self._class_batch(fpid, frozen, entry, plan, classes, N)
+
+        K, V = frozen.K, frozen.V
+        Ghp = _bucket(plan.Gh, lo=1)
+        Gzp = _bucket(plan.Gz, lo=1)
+        Vp = entry["Vp"]
+        self._pad_shapes = dict(
+            K=K, V=V, T=entry["pad_T"], Gh=plan.Gh, Gz=plan.Gz
+        )
+
+        # per-round existing-node sims (they register with this round's
+        # topology); their encoded rows come from the fp entry
+        existing_sims = [
+            ExistingNodeSim(node, topo, self._node_daemon_overhead(node))
+            for node in self.existing_nodes
+        ]
+
+        # topology count state: hostname-group counts seeded per existing
+        # slot; positive counts on non-slot hostnames only matter for the
+        # affinity bootstrap check (h_possel0)
+        slot_names = [n.name for n in self.existing_nodes]
+        hcount0 = topoplan.initial_hcounts(plan, slot_names, N).T  # [N, Gh]
+        slot_name_set = set(slot_names)
+        h_possel0 = np.zeros((plan.Gh,), dtype=bool)
+        for gi, dg in enumerate(plan.host_groups):
+            h_possel0[gi] = any(
+                cnt > 0
+                for name, cnt in dg.group.domains.items()
+                if name not in slot_name_set
+            )
+
+        statics = FFDStatics(
+            it_alloc=entry["it_alloc_d"],
+            off_avail=entry["off_avail_d"],
+            zone_key=entry["zone_key_d"],
+            ct_key=entry["ct_key_d"],
+            tmpl_mask=entry["tm_mask_d"],
+            tmpl_defines=entry["tm_def_d"],
+            tmpl_complement=entry["tm_comp_d"],
+            tmpl_negative=entry["tm_neg_d"],
+            tmpl_gt=entry["tm_gt_d"],
+            tmpl_lt=entry["tm_lt_d"],
+            tmpl_it=entry["tmpl_it_d"],
+            tmpl_overhead=entry["tmpl_overhead_d"],
+            well_known=entry["well_known_pad_d"],
+            gt_none=jnp.int32(GT_NONE),
+            lt_none=jnp.int32(LT_NONE),
+            h_type=self._dev(_pad(plan.h_type, {0: Ghp}, 0)),
+            h_skew=self._dev(_pad(plan.h_skew, {0: Ghp}, 0)),
+            h_possel0=self._dev(_pad(h_possel0, {0: Ghp}, False)),
+            z_type=self._dev(_pad(plan.z_type, {0: Gzp}, 0)),
+            z_skew=self._dev(_pad(plan.z_skew, {0: Gzp}, 0)),
+            z_key=self._dev(_pad(plan.z_key, {0: Gzp}, 0)),
+            z_mindom=self._dev(
+                _pad(plan.z_mindom, {0: Gzp}, topoplan.NO_MIN_DOMAINS)
+            ),
+            z_domains=self._dev(_pad(plan.z_domains, {0: Gzp, 1: Vp}, False)),
+            z_rank=self._dev(_pad(plan.z_rank, {0: Gzp, 1: Vp}, RANK_NONE)),
+        )
+
+        init_state = self._make_init_state(entry, plan, N, hcount0, Ghp, Gzp)
 
         # level-search iterations: the water level is bounded by seeded
         # topology counts + pods in this solve
@@ -1007,33 +1525,35 @@ class DeviceScheduler:
             vocab=frozen,
             resource_names=resource_names,
             catalog=catalog,
-            class_masks=class_masks,
-            class_requests=class_requests,
+            class_masks=batch["class_masks"],
+            class_requests=batch["class_requests"],
             classes=classes,
             templates=self.templates,
-            class_it=class_it,
-            tmpl_ok=tmpl_ok,
-            new_template=new_template,
-            kstar=kstar,
+            class_it=batch["class_it"],
+            tmpl_ok=batch["tmpl_ok"],
+            new_template=batch["new_template"],
+            kstar=batch["kstar"],
             statics=statics,
             init_state=init_state,
-            exist_taint_ok=exist_taint_ok,
+            exist_taint_ok=batch["exist_taint_ok"],
             existing_sims=existing_sims,
             n_slots=N,
             topo=topo,
             plan=plan,
-            smask=smask,
-            it_alloc64q=it_alloc64q,
-            class_requests64q=class_requests64q,
-            tmpl_overhead64q=tmpl_overhead64q,
-            off_avail_np=off_avail,
-            tmpl_it_np=tmpl_it,
-            tmpl_mask_np=tmpl_masks.mask,
-            zone_kid=zone_kid,
-            ct_kid=ct_kid,
-            n_zones=Z,
-            n_cts=CT,
+            smask=batch["smask"],
+            it_alloc64q=entry["it_alloc64q"],
+            class_requests64q=batch["class_requests64q"],
+            tmpl_overhead64q=entry["tmpl_overhead64q"],
+            off_avail_np=entry["off_avail"],
+            tmpl_it_np=entry["tmpl_it"],
+            tmpl_mask_np=entry["tmpl_mask_np"],
+            zone_kid=entry["zone_kid"],
+            ct_kid=entry["ct_kid"],
+            n_zones=entry["Z"],
+            n_cts=entry["CT"],
             level_iters=level_iters,
+            n_classes_padded=batch["Cp"],
+            _batch=batch,
         )
 
     def _class_steps(self, prep: _Prepared) -> ClassStep:
@@ -1042,7 +1562,14 @@ class DeviceScheduler:
         admissible domain (ops/topoplan.py). All axes pad to the bucketed
         shapes of prep.statics/init_state; steps pad to a bucketed count
         with inert entries (count=0, no viable template — the scan carries
-        state through them unchanged)."""
+        state through them unchanged). The finished device-resident
+        ClassStep caches on the class batch (prep._batch), so steady-state
+        re-solves skip both the host assembly and the host->device
+        transfer."""
+        cached = prep._batch.get("class_steps")
+        if cached is not None:
+            prep.step_class = prep._batch["step_class"]
+            return cached
         cm = prep.class_masks
         plan = prep.plan
         steps = plan.steps
@@ -1052,7 +1579,7 @@ class DeviceScheduler:
             [prep.classes[ci].count for ci in cis], dtype=np.int32
         )
         J = len(steps)
-        Jp = _bucket(J)
+        Jp = _bucket_steps(J)
         Kp = int(prep.statics.well_known.shape[0])
         Vp = int(prep.statics.z_domains.shape[1])
         Tp = int(prep.statics.it_alloc.shape[0])
@@ -1099,57 +1626,63 @@ class DeviceScheduler:
         defines = _pad(cm.defines[cis], {0: Jp, 1: Kp}, False)
         mask = np.where(defines[:, :, None], mask, True)  # neutral pads
         smask = _pad(prep.smask[cis], {0: Jp, 1: Kp, 2: Vp}, True)
-        return ClassStep(
-            mask=jnp.asarray(mask),
-            defines=jnp.asarray(defines),
-            concrete=jnp.asarray(_pad(cm.concrete[cis], {0: Jp, 1: Kp}, False)),
-            negative=jnp.asarray(_pad(cm.negative[cis], {0: Jp, 1: Kp}, True)),
-            gt=jnp.asarray(_pad(cm.gt[cis], {0: Jp, 1: Kp}, GT_NONE)),
-            lt=jnp.asarray(_pad(cm.lt[cis], {0: Jp, 1: Kp}, LT_NONE)),
-            count=jnp.asarray(_pad(counts, {0: Jp}, 0)),
-            requests=jnp.asarray(
+        step = ClassStep(
+            mask=self._dev(mask),
+            defines=self._dev(defines),
+            concrete=self._dev(_pad(cm.concrete[cis], {0: Jp, 1: Kp}, False)),
+            negative=self._dev(_pad(cm.negative[cis], {0: Jp, 1: Kp}, True)),
+            gt=self._dev(_pad(cm.gt[cis], {0: Jp, 1: Kp}, GT_NONE)),
+            lt=self._dev(_pad(cm.lt[cis], {0: Jp, 1: Kp}, LT_NONE)),
+            count=self._dev(_pad(counts, {0: Jp}, 0)),
+            requests=self._dev(
                 _pad(prep.class_requests[cis], {0: Jp, 1: Rp}, 0.0)
             ),
             class_it=jnp.where(valid_j[:, None], class_it_g, False),
             tmpl_ok=jnp.where(valid_j[:, None], tmpl_ok_g, False),
-            exist_taint_ok=jnp.asarray(
+            exist_taint_ok=self._dev(
                 _pad(prep.exist_taint_ok[cis], {0: Jp}, False)
             ),
             new_template=jnp.where(valid_j, prep.new_template[ci_j], -1),
             kstar=jnp.where(valid_j, prep.kstar[ci_j], 0),
-            smask=jnp.asarray(smask),
-            h_sel=jnp.asarray(_pad(plan.h_sel[cis], {0: Jp, 1: Ghp}, False)),
-            h_owner=jnp.asarray(_pad(plan.h_owner[cis], {0: Jp, 1: Ghp}, False)),
-            z_sel=jnp.asarray(_pad(plan.z_sel[cis], {0: Jp, 1: Gzp}, False)),
-            z_owner=jnp.asarray(_pad(plan.z_owner[cis], {0: Jp, 1: Gzp}, False)),
-            sub_value=jnp.asarray(
+            smask=self._dev(smask),
+            h_sel=self._dev(_pad(plan.h_sel[cis], {0: Jp, 1: Ghp}, False)),
+            h_owner=self._dev(_pad(plan.h_owner[cis], {0: Jp, 1: Ghp}, False)),
+            z_sel=self._dev(_pad(plan.z_sel[cis], {0: Jp, 1: Gzp}, False)),
+            z_owner=self._dev(_pad(plan.z_owner[cis], {0: Jp, 1: Gzp}, False)),
+            sub_value=self._dev(
                 stepvec([s.sub_value for s in steps], np.int32, -1)
             ),
-            sub_first=jnp.asarray(
+            sub_first=self._dev(
                 stepvec([s.sub_first for s in steps], bool, True)
             ),
-            sub_last=jnp.asarray(
+            sub_last=self._dev(
                 stepvec([s.sub_last for s in steps], bool, True)
             ),
-            wf_group=jnp.asarray(
+            wf_group=self._dev(
                 stepvec([s.wf_group for s in steps], np.int32, -1)
             ),
-            wf_key=jnp.asarray(
+            wf_key=self._dev(
                 stepvec([s.wf_key for s in steps], np.int32, -1)
             ),
-            zone_rest=jnp.asarray(_pad(zone_rest, {0: Jp, 1: Vp}, False)),
+            zone_rest=self._dev(_pad(zone_rest, {0: Jp, 1: Vp}, False)),
         )
+        prep._batch["class_steps"] = step
+        prep._batch["step_class"] = ci_j
+        prep.step_class = ci_j
+        return step
 
     def _catalog_union(self) -> List[InstanceType]:
-        seen = {}
-        for t in self.templates:
-            for it in t.instance_type_options:
-                seen.setdefault(id(it), it)
-        # include full per-pool catalogs so class_it covers everything
-        for its in self.instance_types.values():
-            for it in its:
-                seen.setdefault(id(it), it)
-        return list(seen.values())
+        if self._catalog is None:
+            seen = {}
+            for t in self.templates:
+                for it in t.instance_type_options:
+                    seen.setdefault(id(it), it)
+            # include full per-pool catalogs so class_it covers everything
+            for its in self.instance_types.values():
+                for it in its:
+                    seen.setdefault(id(it), it)
+            self._catalog = list(seen.values())
+        return self._catalog
 
     def _node_daemon_overhead(self, node: SimNode) -> dict:
         return resutil.requests_for_pods(
@@ -1173,27 +1706,21 @@ class DeviceScheduler:
         placement the host-side checks reject is re-placed through the host
         greedy add; only pods the host path also rejects surface as failures
         (and re-enter via relaxation)."""
-        takes = np.asarray(out["takes"])
-        unplaced = np.asarray(out["unplaced"])
+        # per-class decision planes: the step->class merge already ran on
+        # device (ops/ffd.aggregate_takes), so decode starts from the
+        # [C, used-slots] matrix instead of replaying J scan steps
+        takes_bc = np.asarray(out["takes_bc"])
+        unplaced_by_class = np.asarray(out["unplaced_bc"]).astype(np.int64)
         slot_template = np.asarray(out["template"])
         plan = prep.plan
-        steps = plan.steps
         C = len(prep.classes)
-        J = takes.shape[0] if takes.size else 0
         E = len(prep.existing_sims)
         failed: list = []
         divergent: List[Pod] = []
 
-        # merge sub-steps per (slot, class) — pods of a class are
-        # interchangeable — and collect per-class unplaced tails
         assigned: Dict[int, Dict[int, int]] = {}
-        unplaced_by_class = np.zeros((C,), dtype=np.int64)
-        for j in range(J):
-            ci = steps[j].class_idx
-            unplaced_by_class[ci] += int(unplaced[j])
-            for n in np.nonzero(takes[j])[0]:
-                slot = assigned.setdefault(int(n), {})
-                slot[ci] = slot.get(ci, 0) + int(takes[j, int(n)])
+        for ci, n in zip(*np.nonzero(takes_bc)):
+            assigned.setdefault(int(n), {})[int(ci)] = int(takes_bc[ci, n])
         for ci, cls in enumerate(prep.classes):
             k_unplaced = int(unplaced_by_class[ci])
             if k_unplaced:

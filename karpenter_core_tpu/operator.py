@@ -239,7 +239,12 @@ class Operator:
                 )
 
                 self.solver_supervisor = SolverSupervisor(
-                    on_event=self._publish_sidecar_event
+                    on_event=self._publish_sidecar_event,
+                    # the spawned sidecar arms jax.profiler capture lazily
+                    # (POST /profile), so pass the operator's profile dir
+                    # through: TPU-side traces become grabbable from the
+                    # running child without a redeploy
+                    profile_dir=self.options.profile_dir,
                 )
                 addr = self.solver_supervisor.start()
             self.solver_client = SolverClient(

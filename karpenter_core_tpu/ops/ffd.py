@@ -651,11 +651,57 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics,
     return state2, (take_all, unplaced)
 
 
-@partial(jax.jit, static_argnames=("level_iters",))
-def ffd_solve(state: SlotState, classes: ClassStep, statics: FFDStatics,
-              level_iters: int = LEVEL_ITERS):
-    """Scan all classes; returns (final state, takes [C, N], unplaced [C])."""
+def _ffd_solve_impl(state: SlotState, classes: ClassStep, statics: FFDStatics,
+                    level_iters: int = LEVEL_ITERS):
     final, (takes, unplaced) = jax.lax.scan(
         lambda st, c: ffd_step(st, c, statics, level_iters), state, classes
     )
     return final, takes, unplaced
+
+
+# Scan all classes; returns (final state, takes [J, N], unplaced [J]).
+ffd_solve = partial(jax.jit, static_argnames=("level_iters",))(
+    _ffd_solve_impl
+)
+
+# Donating twin for the provisioning hot path: the SlotState carry (the
+# [N,K,V] requirement planes, the [N,T] itmask, and the hcount/zcount
+# topology count planes) is consumed in place instead of double-buffered,
+# cutting HBM churn per solve. Callers MUST pass a freshly device-put
+# state — models/provisioner rebuilds init_state per round — which is why
+# ffd_solve (tests, sharded harness, consolidation) keeps the non-donating
+# signature. Donation is a no-op on CPU; the CPU path aliases ffd_solve so
+# the test mesh doesn't warn on every compile. The backend probe happens
+# lazily at first CALL (we're about to dispatch anyway), never at import —
+# importing this module must not initialize the XLA runtime.
+_donated_impl = None
+
+
+def ffd_solve_donated(state: SlotState, classes: ClassStep,
+                      statics: FFDStatics, level_iters: int = LEVEL_ITERS):
+    global _donated_impl
+    if _donated_impl is None:
+        if jax.default_backend() != "cpu":
+            _donated_impl = partial(
+                jax.jit, static_argnames=("level_iters",), donate_argnums=(0,)
+            )(_ffd_solve_impl)
+        else:
+            _donated_impl = ffd_solve
+    return _donated_impl(state, classes, statics, level_iters=level_iters)
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def aggregate_takes(takes, unplaced, step_class, num_classes: int):
+    """Fuse the per-step scan outputs down to per-CLASS decision planes on
+    device: takes_by_class [Cp, N], unplaced_by_class [Cp].
+
+    This is the decode contract's on-device half — the host used to fetch
+    the full [J, N] takes matrix (water-fill sub-steps inflate J well past
+    the class count) and merge sub-steps per (slot, class) in a Python
+    loop; the merge is an exact segment-sum over the step->class index, so
+    it runs in one fused dispatch and the fetch shrinks to the class axis.
+    Pad steps are inert (zero takes/unplaced), so routing them to segment 0
+    is harmless."""
+    tbc = jax.ops.segment_sum(takes, step_class, num_segments=num_classes)
+    ubc = jax.ops.segment_sum(unplaced, step_class, num_segments=num_classes)
+    return tbc, ubc

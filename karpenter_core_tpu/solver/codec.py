@@ -383,8 +383,34 @@ def encode_solve_request(
     return _json_payload(header)
 
 
+def problem_fingerprint(header: dict) -> str:
+    """Stable content hash of a solve request's PROBLEM half — everything
+    except the pending pods (nodepools, catalog, existing nodes, daemonset
+    pods, topology context, limits, ICE snapshot). Two requests with equal
+    fingerprints describe the same cluster, so the sidecar can reuse one
+    DeviceScheduler — and with it the prepared-state caches — across RPC
+    calls, re-solving only the pod mix. Computed over the decoded JSON
+    header (wire-canonical), not the npz bytes, so compression framing
+    never perturbs it."""
+    import hashlib
+
+    probe = {k: v for k, v in header.items() if k != "pods"}
+    # the topology context's excluded-uid list is derived from the PENDING
+    # pods (provisioner excludes them from existing counts), so it belongs
+    # to the pod half: hashing it would churn the scheduler cache on every
+    # reconcile. The solve side re-reads the request's live context on
+    # every cache hit (SolverDaemon.solve -> update_topology_context), so
+    # dropping it here never serves stale exclusions.
+    if probe.get("topology"):
+        probe["topology"] = {**probe["topology"], "excluded": []}
+    return hashlib.sha256(
+        json.dumps(probe, sort_keys=True).encode()
+    ).hexdigest()
+
+
 def decode_solve_request(data: bytes) -> dict:
-    """Inverse of encode_solve_request; returns a kwargs-style dict."""
+    """Inverse of encode_solve_request; returns a kwargs-style dict (plus
+    ``fingerprint``, the problem-half content hash for scheduler reuse)."""
     from karpenter_core_tpu.kube import serial
 
     h = _json_header(data)
@@ -393,6 +419,7 @@ def decode_solve_request(data: bytes) -> dict:
     from karpenter_core_tpu.cloudprovider.types import OfferingKey
 
     return {
+        "fingerprint": problem_fingerprint(h),
         "nodepools": [serial.decode(d) for d in h["nodepools"]],
         "instance_types": _decode_it_table(h["it_table"], h["it_pools"]),
         "existing_nodes": [_decode_sim_node(d) for d in h["existing_nodes"]],

@@ -8,9 +8,14 @@ boundary" while nothing served it). It speaks HTTP+npz instead of
 gRPC+proto — same split, stdlib transport (the kube/httpserver.py pattern):
 
 * ``POST /solve``        — full scheduler input -> DeviceScheduler.solve
+                           (schedulers cached per problem fingerprint, so
+                           repeat solves against an unchanged cluster reuse
+                           the prepared-state caches across RPC calls)
 * ``POST /consolidate``  — consolidation prefix sweep (frontier_core)
 * ``GET  /healthz``      — liveness + readiness (warm-up finished)
 * ``GET  /metrics``      — the sidecar's own registry, exposition format
+* ``POST /profile``      — toggle jax.profiler trace capture around solves
+                           (requires ``--profile-dir``); GET reports state
 
 Responses carry ``X-Solver-Seconds`` (device solve wall time) so the client
 can split its RPC histogram into transit vs kernel. Boot enables the
@@ -33,33 +38,89 @@ _OCTET = "application/octet-stream"
 
 
 class SolverDaemon:
-    """Request execution, transport-free (tests drive it directly)."""
+    """Request execution, transport-free (tests drive it directly).
 
-    def __init__(self):
+    Schedulers are cached per problem fingerprint (everything in the solve
+    request EXCEPT the pending pods — see codec.problem_fingerprint): a
+    control plane re-solving against an unchanged cluster reuses the same
+    DeviceScheduler across RPC calls, which carries the prepared-state
+    caches (vocab-keyed catalog tensors, per-class rows, device-resident
+    class steps) across the wire boundary. Any change to the problem half
+    changes the fingerprint and builds a fresh scheduler, so cached and
+    uncached solves are packing-identical by construction (conformance
+    battery in tests/test_solverd.py). Solves serialize on a lock — the
+    sidecar owns one device, and a cached DeviceScheduler is not
+    reentrant."""
+
+    _SCHED_CACHE_CAP = 4
+
+    def __init__(self, profile_dir: str = None):
         self.ready = False
         self.solves = 0
+        self.profile_dir = profile_dir
+        self.profiling = False
+        self._sched_cache = {}
+        self._lock = threading.Lock()
 
     # -- endpoints ---------------------------------------------------------
 
     def solve(self, body: bytes):
         """bytes -> (response bytes, solve seconds)."""
+        from karpenter_core_tpu.metrics import wiring as m
         from karpenter_core_tpu.models.provisioner import DeviceScheduler
 
         problem = codec.decode_solve_request(body)
-        scheduler = DeviceScheduler(
-            problem["nodepools"],
-            problem["instance_types"],
-            existing_nodes=problem["existing_nodes"],
-            daemonset_pods=problem["daemonset_pods"],
-            max_slots=problem["max_slots"],
-            topology=problem["topology"],
-            unavailable_offerings=problem["unavailable_offerings"],
-        )
-        t0 = time.perf_counter()
-        results = scheduler.solve(problem["pods"])
-        dt = time.perf_counter() - t0
+        with self._lock:
+            scheduler = self._sched_cache.get(problem["fingerprint"])
+            if scheduler is None:
+                m.SOLVERD_SCHED_CACHE.inc({"outcome": "miss"})
+                scheduler = DeviceScheduler(
+                    problem["nodepools"],
+                    problem["instance_types"],
+                    existing_nodes=problem["existing_nodes"],
+                    daemonset_pods=problem["daemonset_pods"],
+                    max_slots=problem["max_slots"],
+                    topology=problem["topology"],
+                    unavailable_offerings=problem["unavailable_offerings"],
+                )
+                if len(self._sched_cache) >= self._SCHED_CACHE_CAP:
+                    del self._sched_cache[next(iter(self._sched_cache))]
+                self._sched_cache[problem["fingerprint"]] = scheduler
+            else:
+                m.SOLVERD_SCHED_CACHE.inc({"outcome": "hit"})
+                # the fingerprint ignores the pod-derived excluded-uid
+                # list; hand the cached scheduler this request's live
+                # topology context so exclusions are never stale
+                scheduler.update_topology_context(problem["topology"])
+            t0 = time.perf_counter()
+            with self._maybe_profile():
+                results = scheduler.solve(problem["pods"])
+            dt = time.perf_counter() - t0
         self.solves += 1
         return codec.encode_solve_results(results, dt), dt
+
+    def _maybe_profile(self):
+        """jax.profiler trace context when profiling is toggled on and a
+        --profile-dir was configured; a no-op context otherwise. Lets TPU
+        traces be captured from a RUNNING sidecar (POST /profile) without
+        a redeploy."""
+        import contextlib
+
+        if self.profiling and self.profile_dir:
+            import jax.profiler
+
+            return jax.profiler.trace(self.profile_dir)
+        return contextlib.nullcontext()
+
+    def toggle_profile(self, enable: bool = None) -> dict:
+        if enable is None:
+            enable = not self.profiling
+        self.profiling = bool(enable) and self.profile_dir is not None
+        return {
+            "profiling": self.profiling,
+            "profile_dir": self.profile_dir,
+            "configured": self.profile_dir is not None,
+        }
 
     def consolidate(self, body: bytes):
         from karpenter_core_tpu.models.consolidation import frontier_core
@@ -129,17 +190,36 @@ class _Handler(BaseHTTPRequestHandler):
                 self, 200, REGISTRY.render().encode(),
                 "text/plain; version=0.0.4; charset=utf-8",
             )
+        elif path == "/profile":
+            import json as _json
+
+            send_body(
+                self, 200,
+                _json.dumps(self.daemon.toggle_profile(
+                    self.daemon.profiling  # GET reports, never toggles
+                )).encode(),
+            )
         else:
             send_body(self, 404, b'{"error": "not found"}')
 
     def do_POST(self) -> None:
-        path = self.path.split("?")[0]
+        path, _, query = self.path.partition("?")
         body = read_body(self)
         try:
             if path == "/solve":
                 out, dt = self.daemon.solve(body)
             elif path == "/consolidate":
                 out, dt = self.daemon.consolidate(body)
+            elif path == "/profile":
+                import json as _json
+                from urllib.parse import parse_qs
+
+                q = parse_qs(query)
+                enable = None
+                if "enable" in q:
+                    enable = q["enable"][0] not in ("0", "false", "off")
+                state = self.daemon.toggle_profile(enable)
+                return send_body(self, 200, _json.dumps(state).encode())
             else:
                 return send_body(self, 404, b'{"error": "not found"}')
         except Exception as e:
@@ -181,9 +261,16 @@ def main() -> int:
         "--prewarm", action="store_true",
         help="compile the common shape buckets before serving traffic",
     )
+    ap.add_argument(
+        "--profile-dir", default=None,
+        help="directory for jax.profiler traces; solves are wrapped in a"
+        " trace capture while profiling is toggled on via POST /profile"
+        " (off by default), so TPU-side traces can be grabbed from a"
+        " running sidecar without redeploying",
+    )
     args = ap.parse_args()
 
-    daemon = SolverDaemon()
+    daemon = SolverDaemon(profile_dir=args.profile_dir)
     httpd = serve(args.port, host=args.host, daemon=daemon, ready=False)
     # the supervisor (solver/supervisor.py) reads this line to learn the
     # bound address — same handshake as kube/httpserver.py

@@ -45,6 +45,14 @@ class PodClass:
     tolerations: tuple
     requests: dict
     pods: List[Pod] = field(default_factory=list)
+    # the raw-spec equivalence key this class was grouped under (see
+    # _spec_signature). Everything the solver encodes per class — value
+    # masks, strict masks, quantized request vectors, taint rows — is a
+    # pure function of (signature, vocab, catalog), which is what lets the
+    # prepared-state cache in models/provisioner reuse encoded rows across
+    # solves and relaxation rounds instead of re-running the numpy encode
+    # for every class every round.
+    signature: tuple = ()
 
     @property
     def count(self) -> int:
@@ -135,6 +143,7 @@ def group_pods(pods: Sequence[Pod], label_aware: bool = True) -> List[PodClass]:
                 strict_requirements=Requirements.from_pod_strict(pod),
                 tolerations=tuple(pod.tolerations),
                 requests=dict(pod.resource_requests),
+                signature=(label_aware, sig),
             )
             classes[sig] = cls
         cls.pods.append(pod)

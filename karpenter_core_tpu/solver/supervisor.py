@@ -23,7 +23,9 @@ import time
 from typing import Callable, List, Optional
 
 
-def default_command(port: int, prewarm: bool = False) -> List[str]:
+def default_command(
+    port: int, prewarm: bool = False, profile_dir: Optional[str] = None
+) -> List[str]:
     cmd = [
         sys.executable,
         "-m",
@@ -33,6 +35,10 @@ def default_command(port: int, prewarm: bool = False) -> List[str]:
     ]
     if prewarm:
         cmd.append("--prewarm")
+    if profile_dir:
+        # the sidecar arms jax.profiler capture lazily (POST /profile), so
+        # passing the directory at spawn time costs nothing until toggled
+        cmd.extend(["--profile-dir", profile_dir])
     return cmd
 
 
@@ -42,6 +48,7 @@ class SolverSupervisor:
         command: Optional[List[str]] = None,
         port: int = 0,
         prewarm: bool = False,
+        profile_dir: Optional[str] = None,
         backoff_initial: float = 1.0,
         backoff_max: float = 30.0,
         stable_window: float = 60.0,
@@ -49,7 +56,7 @@ class SolverSupervisor:
         time_fn=time.monotonic,
         on_event: Optional[Callable[[str, str], None]] = None,
     ):
-        self.command = command or default_command(port, prewarm)
+        self.command = command or default_command(port, prewarm, profile_dir)
         self.backoff_initial = backoff_initial
         self.backoff_max = backoff_max
         # deadline on the handshake line: a child that wedges before

@@ -114,6 +114,18 @@ class FrozenVocab:
     valid: np.ndarray  # [K, V] bool — padded slots are False
     well_known_mask: np.ndarray = field(default=None)  # [K] set by encoder
 
+    def fingerprint(self) -> tuple:
+        """Structural identity of the closed world: same keys, same values,
+        same id assignment. Two solves whose vocabs share a fingerprint can
+        share every tensor encoded over the vocab (the prepared-state cache
+        key in models/provisioner); building vocabs in canonical sorted
+        order (see models/provisioner._build_vocab) makes the fingerprint
+        stable across drifting pod mixes with the same label universe."""
+        return (
+            tuple(self.key_names),
+            tuple(tuple(names) for names in self.value_names),
+        )
+
 
 @dataclass
 class EntityMasks:

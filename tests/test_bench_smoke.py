@@ -1,0 +1,60 @@
+"""Tier-1-safe fast-bench smoke (ISSUE 3 satellite).
+
+bench.py is the driver's only window into round-over-round performance; a
+broken harness (import error, schema drift, a config that asserts) is
+invisible until a round burns its TPU budget discovering it. This runs the
+harness end-to-end as a subprocess — BENCH_FAST=1 primary-only, tiny
+BENCH_PODS/BENCH_TYPES, CPU backend — and asserts it exits 0 with one
+well-formed JSON line carrying the schema downstream tooling reads,
+including the PR-3 per-phase breakdown.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_fast_bench_emits_well_formed_json():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "BENCH_FAST": "1",
+            "BENCH_PODS": "64",
+            "BENCH_TYPES": "40",
+        },
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = None
+    for cand in reversed(proc.stdout.strip().splitlines()):
+        try:
+            line = json.loads(cand)
+            break
+        except ValueError:
+            continue
+    assert line is not None, f"no JSON line in bench output: {proc.stdout[-500:]}"
+
+    assert line["metric"] == "solve_throughput_64pods_40types"
+    assert line["unit"] == "pods/sec"
+    assert line["value"] > 0
+    assert isinstance(line["budget_ok"], bool)
+    primary = line["detail"]["primary"]
+    for key in ("p50_solve_s", "p99_solve_s", "cold_solve_s", "pods_per_sec",
+                "nodes", "warm_times_s"):
+        assert key in primary, key
+    # the per-phase breakdown rides every _solve_bench config
+    phases = primary["phases"]
+    for key in ("plan_s", "prepare_s", "kernel_s", "decode_s",
+                "fetch_bytes", "h2d_bytes", "used_slots"):
+        assert key in phases, key
+    assert phases["fetch_bytes"] > 0
+    # slots touched on device can exceed final claims (sparse-tail repack
+    # drops empty claims) but never undershoot them
+    assert phases["used_slots"] >= primary["nodes"] > 0

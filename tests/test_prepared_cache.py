@@ -1,0 +1,239 @@
+"""Prepared-state reuse + shape-bucket jit stability (ISSUE 3 tentpole).
+
+Three contracts:
+
+* identical packings with and without the cache — a steady-state re-solve
+  that hits the class-batch cache must produce the same claims a fresh
+  scheduler produces, and a relaxation round must reuse the round-1 vocab
+  fingerprint (union semantics) instead of forking the cache;
+* slot-axis invariance — the adaptive slot shrink (warm solves run at a
+  bucket sized from observed usage, overflow retries grow) relies on
+  padding slots being inert: the same problem at max_slots=64 and 1024
+  must pack identically;
+* the shape buckets actually hold the jit cache — a drifting sequence of
+  pod counts/class mixes inside one bucket must trigger ZERO new kernel
+  compilations, observed through JAX's compilation-count monitoring hook
+  (catches future compile-cliff regressions that only show up as latency).
+"""
+import jax
+import numpy as np
+
+from tests.helpers import make_nodepool
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.api.objects import (
+    Affinity,
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.cloudprovider.kwok import bench_catalog
+from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+GIB = 2.0**30
+
+
+def _pods(n, a=4, b=4, prefix="p"):
+    """n pods over an a x b shape grid -> min(n, a*b) equivalence classes."""
+    return [
+        Pod(
+            metadata=ObjectMeta(name=f"{prefix}{i}"),
+            resource_requests={
+                "cpu": 0.1 * (1 + i % a),
+                "memory": 0.25 * GIB * (1 + (i // a) % b),
+            },
+        )
+        for i in range(n)
+    ]
+
+
+def _topo_pods(n, n_deploys=2):
+    """Mixed topology pods: zone spread + hostname anti-affinity cohorts."""
+    pods = []
+    for i in range(n):
+        dep = i % n_deploys
+        requests = {"cpu": 0.2 * (1 + i % 3), "memory": 0.5 * GIB}
+        if i % 2 == 0:
+            labels = {"app": f"spread-{dep}"}
+            pods.append(Pod(
+                metadata=ObjectMeta(name=f"t{i}", labels=labels),
+                resource_requests=requests,
+                topology_spread_constraints=[TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=L.LABEL_TOPOLOGY_ZONE,
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(
+                        match_labels=tuple(sorted(labels.items()))
+                    ),
+                )],
+            ))
+        else:
+            labels = {"app": f"anti-{dep}"}
+            pods.append(Pod(
+                metadata=ObjectMeta(name=f"t{i}", labels=labels),
+                resource_requests=requests,
+                affinity=Affinity(pod_anti_affinity=PodAffinity(required=[
+                    PodAffinityTerm(
+                        topology_key=L.LABEL_HOSTNAME,
+                        label_selector=LabelSelector(
+                            match_labels=tuple(sorted(labels.items()))
+                        ),
+                    )
+                ])),
+            ))
+    return pods
+
+
+def _claim_shape(res):
+    """Order-free packing signature: sorted (pod count, instance count)."""
+    return sorted(
+        (len(c.pods), len(c.instance_type_options))
+        for c in res.new_node_claims
+    )
+
+
+def _sched(catalog, max_slots=256):
+    pool = make_nodepool("default")
+    return DeviceScheduler([pool], {"default": list(catalog)},
+                           max_slots=max_slots)
+
+
+class TestCachedResolveParity:
+    def test_steady_state_resolve_identical_packing(self):
+        catalog = bench_catalog(16)
+        pods = _topo_pods(120)
+        cached = _sched(catalog)
+        first = cached.solve(pods)
+        second = cached.solve(pods)
+        third = cached.solve(pods)
+        # by the third solve the batch cache must be hot (the second may
+        # rebuild once for the adaptive slot shrink)
+        assert cached.last_phase_stats["prep_cache_hits"] >= 1
+        fresh = _sched(catalog).solve(pods)
+        assert first.all_pods_scheduled() and third.all_pods_scheduled()
+        assert first.node_count() == second.node_count() == third.node_count()
+        assert first.node_count() == fresh.node_count()
+        assert _claim_shape(third) == _claim_shape(fresh)
+
+    def test_relaxation_round_keeps_fingerprint(self):
+        from karpenter_core_tpu.api.objects import (
+            NodeAffinity,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+            PreferredSchedulingTerm,
+        )
+
+        catalog = bench_catalog(8)
+        pods = []
+        for i in range(30):
+            pods.append(Pod(
+                metadata=ObjectMeta(name=f"r{i}"),
+                resource_requests={"cpu": 0.5, "memory": 1.0 * GIB},
+                affinity=Affinity(node_affinity=NodeAffinity(
+                    preferred=[PreferredSchedulingTerm(
+                        weight=1,
+                        preference=NodeSelectorTerm(match_expressions=(
+                            NodeSelectorRequirement(
+                                "no-such-label", "In", ("nope",)
+                            ),
+                        )),
+                    )],
+                )),
+            ))
+        sched = _sched(catalog, max_slots=64)
+        res = sched.solve(pods)
+        assert res.all_pods_scheduled()
+        assert sched.last_phase_stats["rounds"] >= 2
+        # the relax stripped a preferred term (specs shrank); the round-2
+        # vocab unions round 1's, so the fingerprint — and the fp-keyed
+        # catalog tensors — must not fork
+        assert len(sched._fp_cache) == 1
+
+    def test_drifting_mix_correct_across_cache_generations(self):
+        catalog = bench_catalog(12)
+        sched = _sched(catalog)
+        for n in (40, 72, 40, 96):
+            res = sched.solve(_pods(n))
+            assert res.all_pods_scheduled()
+            fresh = _sched(catalog).solve(_pods(n))
+            assert res.node_count() == fresh.node_count()
+
+
+class TestSlotAxisInvariance:
+    def test_same_packing_at_any_slot_budget(self):
+        catalog = bench_catalog(16)
+        pods = _topo_pods(90)
+        small = _sched(catalog, max_slots=64).solve(pods)
+        large = _sched(catalog, max_slots=1024).solve(pods)
+        assert small.all_pods_scheduled() and large.all_pods_scheduled()
+        assert small.node_count() == large.node_count()
+        assert _claim_shape(small) == _claim_shape(large)
+
+    def test_overflow_retry_recovers_from_low_hint(self):
+        catalog = bench_catalog(8)
+        sched = _sched(catalog, max_slots=256)
+        tiny = sched.solve(_pods(4))
+        assert tiny.all_pods_scheduled()
+        assert sched._slots_hint  # hint now tiny
+        # hostname anti-affinity forces ~one node per pod: far past the
+        # shrunken first attempt, so the solve must overflow-retry upward
+        pods = []
+        for i in range(40):
+            labels = {"app": "wide"}
+            pods.append(Pod(
+                metadata=ObjectMeta(name=f"w{i}", labels=labels),
+                resource_requests={"cpu": 0.1, "memory": 0.25 * GIB},
+                affinity=Affinity(pod_anti_affinity=PodAffinity(required=[
+                    PodAffinityTerm(
+                        topology_key=L.LABEL_HOSTNAME,
+                        label_selector=LabelSelector(
+                            match_labels=(("app", "wide"),)
+                        ),
+                    )
+                ])),
+            ))
+        res = sched.solve(pods)
+        assert res.all_pods_scheduled()
+        assert res.node_count() == 40
+
+
+class TestShapeBucketsHoldJitCache:
+    def test_zero_new_compilations_inside_one_bucket(self):
+        """Solve a drifting sequence of pod counts / class mixes that stays
+        inside one shape bucket on every bucketed axis (classes 13..16 ->
+        Cp=16, steps -> 16, level_iters window 65..127 pods, slots settle
+        at one used-bucket) and assert zero new kernel compilations via
+        jax.monitoring — the compile-cliff canary."""
+        catalog = bench_catalog(8)
+        sched = _sched(catalog, max_slots=64)
+        # warm: first solve at the cold slot budget, second at the shrunken
+        # adaptive budget (its one legitimate recompile), third confirms
+        # the hint fixed point before we start listening
+        for n in (80, 84, 88):
+            assert sched.solve(_pods(n, a=4, b=4)).all_pods_scheduled()
+
+        from karpenter_core_tpu.ops.ffd import ffd_solve
+
+        compiles = []
+
+        def listener(name, **kw):
+            if name == "/jax/compilation_cache/compile_requests_use_cache":
+                compiles.append(name)
+
+        jax.monitoring.register_event_listener(listener)
+        try:
+            cache_before = ffd_solve._cache_size()
+            for n, (a, b) in ((92, (4, 4)), (108, (8, 2)), (123, (2, 8))):
+                res = sched.solve(_pods(n, a=a, b=b))
+                assert res.all_pods_scheduled()
+            assert ffd_solve._cache_size() == cache_before
+            assert compiles == [], (
+                f"{len(compiles)} new compilations inside one shape bucket"
+            )
+        finally:
+            from jax._src import monitoring as _monitoring
+
+            _monitoring._unregister_event_listener_by_callback(listener)

@@ -177,6 +177,73 @@ class TestExactBoundaryFits:
         assert dres.all_pods_scheduled()
         assert dres.node_count() <= gres.node_count()
 
+    def test_exact_fit_binds_one_quantum_over_does_not(self):
+        """Regression pin for the ~1e-13 resource-boundary drift workaround
+        (requests round UP, capacity rounds DOWN, float64 decode twins):
+
+        * pods summing EXACTLY to a power-of-two allocatable must bind to
+          one node — the r4 cfg3 cliff was this fit getting shaved;
+        * one QUANTUM (1 milli-cpu) over must NOT bind — the rounding
+          absorbs only sub-quantum noise, never a representable overshoot.
+        """
+        catalog = _one_type_catalog(cpu=8.0, mem_gib=64.0)
+        alloc_cpu = catalog[0].allocatable()["cpu"]
+        assert alloc_cpu == 8.0  # power-of-two boundary, no overhead model
+        exact = [
+            Pod(
+                metadata=ObjectMeta(name=f"e{i}"),
+                resource_requests={"cpu": 0.5, "memory": 1.0 * 2**20},
+            )
+            for i in range(16)  # 16 x 0.5 == 8.0 exactly
+        ]
+        gres, dres = _solve_both(exact, catalog)
+        assert dres.all_pods_scheduled()
+        assert gres.node_count() == 1
+        assert dres.node_count() == 1
+
+        over = [
+            Pod(
+                metadata=ObjectMeta(name=f"o{i}"),
+                resource_requests={"cpu": 0.5, "memory": 1.0 * 2**20},
+            )
+            for i in range(15)
+        ] + [
+            Pod(
+                metadata=ObjectMeta(name="o15"),
+                # 0.501 cores: one milli-cpu past the exact fill
+                resource_requests={"cpu": 0.501, "memory": 1.0 * 2**20},
+            )
+        ]
+        gres, dres = _solve_both(over, catalog)
+        assert dres.all_pods_scheduled()
+        assert gres.node_count() == 2
+        assert dres.node_count() == 2
+
+    def test_one_ulp_over_is_absorbed_as_fixed_point_noise(self):
+        """One float64 ULP past the boundary is BELOW the request quantum
+        and inside the deliberate 1e-12 relative guard band: k8s
+        resource.Quantity is fixed-point decimal (resources.go:28-66), so
+        a true API quantity cannot express capacity+1ULP — the drift can
+        only be float noise from host arithmetic, and the quantizer must
+        swallow it rather than open a phantom second node. (The raw-float
+        greedy oracle DOES trip on this adversarial non-decimal input;
+        the device solver is the one matching the fixed-point model, so
+        this asserts the device packing only.)"""
+        catalog = _one_type_catalog(cpu=8.0, mem_gib=64.0)
+        per = float(np.nextafter(0.5, 1.0))  # 0.5 + 1 ULP
+        pods = [
+            Pod(
+                metadata=ObjectMeta(name=f"u{i}"),
+                resource_requests={"cpu": per, "memory": 1.0 * 2**20},
+            )
+            for i in range(16)  # raw float sum: 8.000000000000002
+        ]
+        pool = make_nodepool("default")
+        d = DeviceScheduler([pool], {"default": list(catalog)}, max_slots=64)
+        dres = d.solve(pods)
+        assert dres.all_pods_scheduled()
+        assert dres.node_count() == 1
+
     def test_device_never_overpacks_vs_host_refit(self):
         """Sub-unit odd requests: device may quantize-conservative but the
         result must stay valid (every claim's float64 requests fit)."""

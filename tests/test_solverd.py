@@ -568,3 +568,130 @@ class TestSupervisor:
         sup = self._sup("print('nope', flush=True)")
         with pytest.raises(RuntimeError):
             sup.start()
+
+
+class TestSchedulerReuse:
+    """PR 3: the sidecar caches DeviceSchedulers per problem fingerprint
+    (everything but the pods), carrying the prepared-state caches across
+    RPC calls. The cache must be invisible in the packings and must miss
+    whenever the problem half actually changes."""
+
+    # one live problem half, re-encoded per request like a real operator
+    # (fresh objects would carry fresh uids — legitimately a new problem)
+    POOLS = [make_nodepool()]
+    CATALOG = fake_instance_types(5)
+    ALT_CATALOG = fake_instance_types(3)
+
+    def _request(self, pods, catalog=None, max_slots=64):
+        catalog = catalog or self.CATALOG
+        return codec.encode_solve_request(
+            self.POOLS, {"default": list(catalog)}, [], [], pods,
+            max_slots=max_slots,
+        )
+
+    def test_cached_and_fresh_solves_identical(self):
+        daemon = service.SolverDaemon()
+        pods = [make_pod(cpu=1.0, name=f"c{i}") for i in range(12)]
+        body = self._request(pods)
+        out1, _ = daemon.solve(body)
+        assert len(daemon._sched_cache) == 1
+        out2, _ = daemon.solve(body)
+        assert len(daemon._sched_cache) == 1  # same fingerprint reused
+        fresh_out, _ = service.SolverDaemon().solve(body)
+
+        def shape(data):
+            h = codec.decode_solve_results(data)
+            return (
+                sorted(
+                    (tuple(sorted(c["pod_uids"])),
+                     tuple(sorted(c["instance_types"])))
+                    for c in h["claims"]
+                ),
+                sorted(h["errors"]),
+            )
+
+        assert shape(out1) == shape(out2) == shape(fresh_out)
+
+    def test_pod_derived_topology_exclusions_do_not_churn_cache(self):
+        """The provisioner builds each request's Topology with the PENDING
+        pods' uids excluded, so the excluded list changes every reconcile.
+        It must not change the fingerprint (or the scheduler cache would
+        never hit in the real operator path) — and a cache hit must still
+        see the request's live exclusions, not the cached ones."""
+        from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+            Topology,
+        )
+
+        daemon = service.SolverDaemon()
+        for r in range(3):
+            pods = [make_pod(cpu=1.0, name=f"x{r}-{i}") for i in range(3 + r)]
+            topo = Topology(
+                domains={},
+                excluded_pod_uids={p.uid for p in pods},
+            )
+            body = codec.encode_solve_request(
+                self.POOLS, {"default": list(self.CATALOG)}, [], [], pods,
+                topology=topo, max_slots=32,
+            )
+            out, _ = daemon.solve(body)
+            assert codec.decode_solve_results(out)["errors"] == {}
+        assert len(daemon._sched_cache) == 1
+        # the cached scheduler carries the LAST request's context
+        ctx = next(iter(daemon._sched_cache.values()))._topology_context
+        assert all(uid.startswith("uid-") for uid in ctx.excluded_pods)
+
+    def test_problem_change_misses_cache(self):
+        daemon = service.SolverDaemon()
+        pods = [make_pod(cpu=1.0, name=f"m{i}") for i in range(4)]
+        daemon.solve(self._request(pods))
+        # same problem, different pod mix: fingerprint unchanged
+        daemon.solve(self._request(
+            [make_pod(cpu=2.0, name=f"m2{i}") for i in range(6)]
+        ))
+        assert len(daemon._sched_cache) == 1
+        # a different catalog IS a different problem
+        daemon.solve(self._request(pods, catalog=self.ALT_CATALOG))
+        assert len(daemon._sched_cache) == 2
+
+
+class TestProfileToggle:
+    def test_toggle_requires_configured_dir(self):
+        daemon = service.SolverDaemon()
+        state = daemon.toggle_profile(True)
+        assert state == {
+            "profiling": False, "profile_dir": None, "configured": False,
+        }
+
+    def test_profile_endpoint_toggles_and_wraps_solves(self, tmp_path):
+        daemon = service.SolverDaemon(profile_dir=str(tmp_path))
+        srv = service.serve(0, daemon=daemon)
+        try:
+            import json
+            from urllib.request import Request, urlopen
+
+            base = f"http://{sidecar_addr(srv)}"
+            st = json.loads(urlopen(
+                Request(f"{base}/profile", method="POST", data=b""),
+                timeout=10,
+            ).read())
+            assert st["profiling"] is True
+            # a solve under the toggle must succeed and emit a trace dir
+            pods = [make_pod(cpu=1.0, name="prof0")]
+            body = codec.encode_solve_request(
+                [make_nodepool()], {"default": fake_instance_types(3)},
+                [], [], pods, max_slots=16,
+            )
+            out, _ = daemon.solve(body)
+            assert codec.decode_solve_results(out)["errors"] == {}
+            assert any(tmp_path.iterdir()), "no profiler trace written"
+            st = json.loads(urlopen(
+                Request(f"{base}/profile?enable=0", method="POST", data=b""),
+                timeout=10,
+            ).read())
+            assert st["profiling"] is False
+            # GET reports without toggling
+            st = json.loads(urlopen(f"{base}/profile", timeout=10).read())
+            assert st["profiling"] is False
+        finally:
+            srv.shutdown()
+            srv.server_close()
