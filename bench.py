@@ -694,10 +694,52 @@ def main():
         raise SystemExit(1)
 
 
+def _lint_report():
+    """``bench.py --lint``: run graftlint over the tree and report per-rule
+    wall time as one JSON line (same contract as the solve benches), so the
+    lint pass's cost is tracked alongside kernel perf as the tree grows."""
+    import sys
+
+    t0 = time.perf_counter()
+    from tools.graftlint import run as lint_run
+    from tools.graftlint.engine import LINT_BUDGET_SECONDS
+
+    result = lint_run(["karpenter_core_tpu"])
+    total = time.perf_counter() - t0
+    for f, _src in result.new:
+        # surface the actual violations (stderr keeps the stdout contract
+        # of exactly one JSON line)
+        print(f.render(), file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "graftlint_wall_seconds",
+                "value": round(total, 4),
+                "unit": "s",
+                "budget_ok": total < LINT_BUDGET_SECONDS,
+                "detail": {
+                    "files": result.files,
+                    "new_findings": len(result.new),
+                    "baselined": len(result.baselined),
+                    "suppressed": len(result.suppressed),
+                    "rule_seconds": {
+                        rid: round(dt, 4)
+                        for rid, dt in sorted(result.rule_seconds.items())
+                    },
+                },
+            }
+        )
+    )
+    if result.new or total >= LINT_BUDGET_SECONDS:
+        raise SystemExit(1)
+
+
 if __name__ == "__main__":
     import sys
 
-    if "--restart-probe" in sys.argv:
+    if "--lint" in sys.argv:
+        _lint_report()
+    elif "--restart-probe" in sys.argv:
         _restart_probe()
     else:
         main()

@@ -54,6 +54,9 @@ def _ffd_scan(state, classes, statics, it_price, n_existing):
     return final.next_free, jnp.sum(unplaced), final.overflow, price_lb
 
 
+# graftlint: disable=GL103 -- must NOT donate: the state is prep.init_state
+# from the DeviceScheduler's prepared cache, reused by later solves and
+# sweeps against the same cluster; donation would invalidate the cache
 @jax.jit
 def _prefix_scan(state: SlotState, classes: ClassStep, statics, kind_batch,
                  count_batch, it_price, n_existing):

@@ -711,6 +711,8 @@ class DeviceScheduler:
             base: Dict[str, set] = {}
 
             def obs(reqs):
+                # graftlint: disable=GL201 -- pure set-union accumulation;
+                # the interning below (_build_vocab) sorts before minting ids
                 for key, req in reqs.items():
                     base.setdefault(key, set()).update(req.values)
 
@@ -723,6 +725,8 @@ class DeviceScheduler:
                     obs(off.requirements)
             it_vals: Dict[str, set] = {}
             for it in self._catalog_union():
+                # graftlint: disable=GL201 -- pure set-union accumulation;
+                # _build_vocab sorts before minting ids
                 for key, req in it.requirements.items():
                     it_vals.setdefault(key, set()).update(req.values)
             self._universe = (base, it_vals)
@@ -740,14 +744,18 @@ class DeviceScheduler:
         from karpenter_core_tpu.solver.vocab import Vocab
 
         base, it_vals = self._vocab_universe()
+        # graftlint: disable=GL201 -- all three loops below are pure
+        # set-union accumulation into `merged`; the interning loop at the
+        # bottom sorts keys AND values before minting any id, so iteration
+        # order here cannot reach the fingerprint
         merged = {k: set(v) for k, v in base.items()}
         for cls in classes:
-            for key, req in cls.requirements.items():
+            for key, req in cls.requirements.items():  # graftlint: disable=GL201 -- set union, id-free
                 merged.setdefault(key, set()).update(req.values)
         # catalog ITs contribute values only for keys mentioned by a
         # non-catalog entity (class/template/node/offering)
         mentioned = set(merged)
-        for key, vals in it_vals.items():
+        for key, vals in it_vals.items():  # graftlint: disable=GL201 -- set union, id-free
             tgt = merged.setdefault(key, set())
             if key in mentioned:
                 tgt.update(vals)
@@ -1087,9 +1095,12 @@ class DeviceScheduler:
         if len(self._fp_cache) >= self._FP_CACHE_CAP:
             old = next(iter(self._fp_cache))
             del self._fp_cache[old]
+            # graftlint: disable=GL201 -- cache eviction rebuilds; dict->
+            # dict filters preserve insertion order and mint no ids
             self._row_cache = {
                 k: v for k, v in self._row_cache.items() if k[0] != old
             }
+            # graftlint: disable=GL201 -- order-preserving filter, no ids
             self._batch_cache = {
                 k: v for k, v in self._batch_cache.items() if k[0] != old
             }
@@ -1471,6 +1482,9 @@ class DeviceScheduler:
         slot_name_set = set(slot_names)
         h_possel0 = np.zeros((plan.Gh,), dtype=bool)
         for gi, dg in enumerate(plan.host_groups):
+            # graftlint: disable=GL201 -- any() over domain counts is an
+            # order-insensitive reduction (and short-circuits; sorting
+            # would force materializing every domain)
             h_possel0[gi] = any(
                 cnt > 0
                 for name, cnt in dg.group.domains.items()

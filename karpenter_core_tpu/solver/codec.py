@@ -23,6 +23,10 @@ from karpenter_core_tpu.solver.vocab import EntityMasks, Vocab
 
 _HEADER_KEY = "__header__"
 
+# the snapshot (pre-tensorized subproblem) wire; the full solverd wire
+# below versions separately as SOLVE_WIRE_VERSION
+SNAPSHOT_WIRE_VERSION = 1
+
 
 def _masks_to_arrays(prefix: str, m: EntityMasks, out: Dict[str, np.ndarray]):
     out[f"{prefix}_mask"] = m.mask
@@ -56,7 +60,7 @@ def encode_request(
     """Serialize one solve request. The vocab's interning tables travel in
     the header so the solver reconstructs the identical closed world."""
     header = {
-        "version": 1,
+        "version": SNAPSHOT_WIRE_VERSION,
         "resource_names": list(resource_names),
         "key_names": list(vocab.key_names),
         "value_names": [list(v) for v in vocab.value_names],
@@ -81,6 +85,13 @@ def decode_request(data: bytes):
     class_requests, class_counts, it_masks, it_allocatable)."""
     z = np.load(io.BytesIO(data))
     header = json.loads(bytes(z[_HEADER_KEY]).decode())
+    if header.get("version") != SNAPSHOT_WIRE_VERSION:
+        # explicit skew error, same policy as the solverd decoders below: a
+        # sender on a different wire layout must not surface as a shape
+        # mismatch three layers deeper
+        raise ValueError(
+            f"unsupported snapshot wire version {header.get('version')}"
+        )
     # re-intern through Vocab so derived tables (int_values, valid) match
     # the sender's exactly — insertion order preserves every id
     v = Vocab()
@@ -181,7 +192,10 @@ def _decode_req(d: dict):
 
 
 def _encode_reqs(reqs) -> List[dict]:
-    return [_encode_req(r) for r in reqs.values()]
+    # key-sorted so the wire bytes — and the problem fingerprint computed
+    # over the decoded header — are canonical for one logical Requirements
+    # regardless of host-side insertion order
+    return [_encode_req(reqs[k]) for k in sorted(reqs)]
 
 
 def _decode_reqs(items: List[dict]):
@@ -242,7 +256,9 @@ def _encode_it_table(instance_types: Dict[str, list]) -> Tuple[list, dict]:
     table: List[dict] = []
     index: Dict[int, int] = {}
     pools: Dict[str, List[int]] = {}
-    for pool, its in instance_types.items():
+    # pool-sorted so the table's row order (a wire LIST, which the problem
+    # fingerprint hashes positionally) is canonical per logical catalog
+    for pool, its in sorted(instance_types.items()):
         rows = []
         for it in its:
             ti = index.get(id(it))
@@ -264,7 +280,7 @@ def _encode_volume_usage(vu) -> Optional[dict]:
         return None
     return {
         "limits": dict(vu.limits),
-        "volumes": {k: sorted(v) for k, v in vu.volumes.items()},
+        "volumes": {k: sorted(v) for k, v in sorted(vu.volumes.items())},
     }
 
 
@@ -316,16 +332,25 @@ def _decode_sim_node(d: dict):
     )
 
 
+def _pod_sort_key(p):
+    return (p.metadata.namespace or "", p.metadata.name or "", p.uid)
+
+
 def _encode_topology(topo) -> Optional[dict]:
     from karpenter_core_tpu.kube import serial
 
     if topo is None:
         return None
     return {
-        "domains": {k: sorted(v) for k, v in topo.domains.items()},
+        "domains": {k: sorted(v) for k, v in sorted(topo.domains.items())},
+        # canonical (node, pod) order: domain counting on decode is
+        # order-insensitive, and this list rides the problem fingerprint
         "existing_pods": [
             [serial.encode(p), dict(labels), name]
-            for p, labels, name in topo.existing_pods
+            for p, labels, name in sorted(
+                topo.existing_pods,
+                key=lambda t: (t[2], _pod_sort_key(t[0])),
+            )
         ],
         "excluded": sorted(topo.excluded_pods),
     }
@@ -366,13 +391,31 @@ def encode_solve_request(
     from karpenter_core_tpu.kube import serial
 
     table, pools = _encode_it_table(instance_types)
+    # every PROBLEM-half list is hashed positionally by problem_fingerprint,
+    # so each gets a canonical order: a restarted operator (or a second
+    # replica) relisting the same cluster in a different order must produce
+    # the same fingerprint, or the sidecar's warm scheduler cache misses on
+    # every solve. Safe because the decode side is order-insensitive: the
+    # DeviceScheduler re-sorts nodepools/existing nodes itself and daemon
+    # overhead is a sum. The pending pods keep caller order — it is the
+    # queue order the solve lifts to classes, and it is excluded from the
+    # fingerprint anyway.
     header = {
         "version": SOLVE_WIRE_VERSION,
-        "nodepools": [serial.encode(np_) for np_ in nodepools],
+        "nodepools": [
+            serial.encode(np_)
+            for np_ in sorted(nodepools, key=lambda n: n.metadata.name)
+        ],
         "it_table": table,
         "it_pools": pools,
-        "existing_nodes": [_encode_sim_node(n) for n in existing_nodes],
-        "daemonset_pods": [serial.encode(p) for p in daemonset_pods],
+        "existing_nodes": [
+            _encode_sim_node(n)
+            for n in sorted(existing_nodes, key=lambda n: n.name)
+        ],
+        "daemonset_pods": [
+            serial.encode(p)
+            for p in sorted(daemonset_pods, key=_pod_sort_key)
+        ],
         "pods": [serial.encode(p) for p in pods],
         "topology": _encode_topology(topology),
         "max_slots": max_slots,
@@ -394,6 +437,9 @@ def problem_fingerprint(header: dict) -> str:
     never perturbs it."""
     import hashlib
 
+    # graftlint: disable=GL201 -- json.dumps(sort_keys=True) below
+    # canonicalizes every dict key recursively; build order never reaches
+    # the hash (only LIST order would, and no list is built here)
     probe = {k: v for k, v in header.items() if k != "pods"}
     # the topology context's excluded-uid list is derived from the PENDING
     # pods (provisioner excludes them from existing counts), so it belongs

@@ -61,6 +61,7 @@ class SolverDaemon:
         self.profiling = False
         self._sched_cache = {}
         self._lock = threading.Lock()
+        self._state_lock = threading.Lock()
 
     # -- endpoints ---------------------------------------------------------
 
@@ -96,7 +97,9 @@ class SolverDaemon:
             with self._maybe_profile():
                 results = scheduler.solve(problem["pods"])
             dt = time.perf_counter() - t0
-        self.solves += 1
+            # counter increment stays under the solve lock: handler threads
+            # run concurrently and a bare += is a lost update
+            self.solves += 1
         return codec.encode_solve_results(results, dt), dt
 
     def _maybe_profile(self):
@@ -113,14 +116,19 @@ class SolverDaemon:
         return contextlib.nullcontext()
 
     def toggle_profile(self, enable: bool = None) -> dict:
-        if enable is None:
-            enable = not self.profiling
-        self.profiling = bool(enable) and self.profile_dir is not None
-        return {
-            "profiling": self.profiling,
-            "profile_dir": self.profile_dir,
-            "configured": self.profile_dir is not None,
-        }
+        # read-modify-write (enable=None flips the current state) under its
+        # own small lock: two concurrent POST /profile toggles must not both
+        # read the same old value. Deliberately NOT self._lock — a toggle
+        # must not queue behind a multi-second solve.
+        with self._state_lock:
+            if enable is None:
+                enable = not self.profiling
+            self.profiling = bool(enable) and self.profile_dir is not None
+            return {
+                "profiling": self.profiling,
+                "profile_dir": self.profile_dir,
+                "configured": self.profile_dir is not None,
+            }
 
     def consolidate(self, body: bytes):
         from karpenter_core_tpu.models.consolidation import frontier_core

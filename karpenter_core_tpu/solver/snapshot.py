@@ -214,6 +214,8 @@ def encode_snapshot(
     frozen = vocab.finalize()
 
     well_known = np.zeros((frozen.K,), dtype=bool)
+    # graftlint: disable=GL201 -- writes land at vocab-assigned kid
+    # indices, so iteration order cannot affect the plane
     for key, kid in frozen.keys.items():
         well_known[kid] = key in apilabels.WELL_KNOWN_LABELS
     frozen.well_known_mask = well_known
@@ -269,6 +271,9 @@ def encode_snapshot(
     TA = max(len(taint_list), 1)
     class_tolerates = np.zeros((C, TA), dtype=bool)
     for i, cls in enumerate(classes):
+        # graftlint: disable=GL201 -- writes land at tid indices assigned
+        # above in extra_taints arrival order, so iteration order cannot
+        # affect the matrix
         for t, tid in taint_ids.items():
             class_tolerates[i, tid] = any(
                 tol.tolerates(t) for tol in cls.tolerations

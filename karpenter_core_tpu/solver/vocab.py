@@ -59,13 +59,18 @@ class Vocab:
         return vid
 
     def observe_requirements(self, reqs: Requirements) -> None:
-        for key, req in reqs.items():
+        # canonical observation order: key/value ids are POSITIONAL, so two
+        # observers walking the same logical requirements in different dict/
+        # set orders would otherwise mint different id assignments — and
+        # with them different FrozenVocab.fingerprint()s for the same
+        # closed world (the prepared-state cache key)
+        for key, req in sorted(reqs.items()):
             self.key_id(key)
-            for v in req.values:
+            for v in sorted(req.values):
                 self.value_id(key, v)
 
     def observe_labels(self, labels: dict) -> None:
-        for k, v in labels.items():
+        for k, v in sorted(labels.items()):
             self.value_id(k, v)
 
     @property
@@ -165,6 +170,8 @@ def encode_requirements_batch(
     lt = np.full((N, K), LT_NONE, dtype=np.int64)
 
     for n, reqs in enumerate(batch):
+        # graftlint: disable=GL201 -- writes land at vocab-assigned kid
+        # indices, so iteration order cannot affect the tensors
         for key, req in reqs.items():
             kid = vocab.keys[key]
             defines[n, kid] = True

@@ -1,0 +1,13 @@
+"""GL201 bad: unordered iteration inside an encoding function."""
+
+
+def encode_header(labels, tags):
+    names = [k for k, _v in labels.items()]  # dict arrival order
+    extras = []
+    for t in set(tags):  # set order is undefined
+        extras.append(t)
+    return names + extras
+
+
+def fingerprint(req):
+    return tuple(v for v in req.values)  # Requirement.values is a set

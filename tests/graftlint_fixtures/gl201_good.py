@@ -1,0 +1,12 @@
+"""GL201 good: canonical iteration, or code outside the encode context."""
+
+
+def encode_header(labels, tags):
+    names = [k for k, _v in sorted(labels.items())]
+    extras = list(enumerate(sorted(set(tags))))
+    return names, extras
+
+
+def apply_defaults(labels):
+    # not an encoding/fingerprint function: free to iterate naturally
+    return {k: v or "none" for k, v in labels.items()}

@@ -1,0 +1,7 @@
+"""GL202 bad: fingerprint hashing json without canonical key order."""
+import hashlib
+import json
+
+
+def problem_fingerprint(header):
+    return hashlib.sha256(json.dumps(header).encode()).hexdigest()

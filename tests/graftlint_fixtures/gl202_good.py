@@ -1,0 +1,13 @@
+"""GL202 good: sort_keys=True, or json.dumps outside fingerprint code."""
+import hashlib
+import json
+
+
+def problem_fingerprint(header):
+    return hashlib.sha256(
+        json.dumps(header, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def render_debug(header):
+    return json.dumps(header)  # presentation, not identity

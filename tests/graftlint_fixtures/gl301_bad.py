@@ -1,0 +1,8 @@
+"""GL301 bad: thread lifetime left to the default."""
+import threading
+
+
+def start_worker(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    return t

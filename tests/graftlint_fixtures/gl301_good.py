@@ -1,0 +1,15 @@
+"""GL301 good: every thread decides its shutdown behavior explicitly."""
+import threading
+
+
+def start_worker(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+
+
+def start_blocking_worker(fn):
+    # non-daemon on purpose: this one must finish before exit
+    t = threading.Thread(target=fn, daemon=False)
+    t.start()
+    return t

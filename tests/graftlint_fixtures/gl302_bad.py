@@ -1,0 +1,17 @@
+"""GL302 bad: counter bumped outside the lock in a threaded module."""
+import threading
+
+
+class Daemon:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.solves = 0
+        self.cache = {}
+
+    def handle(self, key, value):
+        with self._lock:
+            self.cache[key] = value
+        self.solves += 1  # lost update under concurrent handlers
+
+    def serve(self):
+        threading.Thread(target=self.handle, daemon=True).start()

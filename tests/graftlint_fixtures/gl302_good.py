@@ -1,0 +1,17 @@
+"""GL302 good: read-modify-writes hold the owning lock."""
+import threading
+
+
+class Daemon:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.solves = 0
+        self.cache = {}
+
+    def handle(self, key, value):
+        with self._lock:
+            self.cache[key] = value
+            self.solves += 1
+
+    def serve(self):
+        threading.Thread(target=self.handle, daemon=True).start()

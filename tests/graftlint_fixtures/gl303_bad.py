@@ -1,0 +1,42 @@
+"""GL303 bad: one attribute, two lock disciplines."""
+import threading
+
+
+class Journal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []
+
+    def record(self, event):
+        with self._lock:
+            self.events.append(event)
+
+    def reset(self):
+        self.events = []  # bare write to a lock-guarded attribute
+
+    def serve(self):
+        threading.Thread(target=self.record, daemon=True).start()
+
+
+class TwoLocks:
+    """Same attribute, two different owning locks — also mixed."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+    def bump_elsewhere(self):
+        with self._lock:
+            self.count += 1
+
+    def reset(self):
+        with self._state_lock:  # wrong lock: no mutual exclusion vs bump
+            self.count = 0
+
+    def serve(self):
+        threading.Thread(target=self.bump, daemon=True).start()

@@ -1,0 +1,19 @@
+"""GL303 good: every write to the shared attribute holds the lock."""
+import threading
+
+
+class Journal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []
+
+    def record(self, event):
+        with self._lock:
+            self.events.append(event)
+
+    def reset(self):
+        with self._lock:
+            self.events = []
+
+    def serve(self):
+        threading.Thread(target=self.record, daemon=True).start()

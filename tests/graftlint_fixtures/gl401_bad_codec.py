@@ -1,0 +1,13 @@
+"""GL401 bad: one-sided wire fields and a missing decode twin."""
+
+
+def _encode_blob(b) -> dict:
+    return {"name": b.name, "size": b.size, "flags": b.flags}
+
+
+def _decode_blob(d: dict):
+    return (d["name"], d["size"])  # "flags" drops on the floor
+
+
+def encode_orphan(o) -> dict:
+    return {"payload": o.payload}  # no decode_orphan anywhere

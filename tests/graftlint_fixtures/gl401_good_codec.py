@@ -1,0 +1,21 @@
+"""GL401 good: field parity, plus the passthrough-decode form."""
+import json
+
+
+def _encode_blob(b) -> dict:
+    return {"name": b.name, "size": b.size, "flags": b.flags}
+
+
+def _decode_blob(d: dict):
+    return (d["name"], d["size"], d["flags"])
+
+
+def encode_results(r) -> bytes:
+    return json.dumps({"version": 1, "claims": r.claims}).encode()
+
+
+def decode_results(data: bytes) -> dict:
+    h = json.loads(data)
+    if h.get("version") != 1:
+        raise ValueError("skew")
+    return h  # passthrough: every remaining key is consumed downstream

@@ -1,0 +1,6 @@
+"""GL402 bad: an emission site with no registered instrument."""
+from karpenter_core_tpu.metrics import wiring as m
+
+
+def record(n):
+    m.PHANTOM_SERIES_TOTAL.inc(by=n)

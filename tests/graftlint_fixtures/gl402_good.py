@@ -1,0 +1,10 @@
+"""GL402 good: emission sites resolve to REGISTRY definitions."""
+from karpenter_core_tpu.metrics.registry import REGISTRY
+
+FIXTURE_EVENTS_TOTAL = REGISTRY.counter(
+    "graftlint_fixture_events_total", "fixture-only instrument"
+)
+
+
+def record(n):
+    FIXTURE_EVENTS_TOTAL.inc(by=n)

@@ -1,0 +1,19 @@
+"""GL101 bad: host syncs inside a traced region."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def solve(x):
+    y = np.asarray(x)  # materializes the tracer on host
+    total = jnp.sum(y)
+    return float(total)  # concretizes a tracer
+
+
+def helper(v):
+    return v.item()  # device->host sync
+
+
+def scan_root(xs):
+    return jax.lax.scan(lambda c, x: (c + helper(x), c), 0.0, xs)

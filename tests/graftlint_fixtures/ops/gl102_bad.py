@@ -1,0 +1,28 @@
+"""GL102 bad: Python branching on a tracer value."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clamp(x, limit):
+    if x > limit:  # tracer branch: trace error or baked-in branch
+        return limit
+    return jnp.abs(x)
+
+
+# static_argnames are per-entry: `steps` is static HERE...
+from functools import partial  # noqa: E402
+
+
+@partial(jax.jit, static_argnames=("steps",))
+def unrolled(x, steps):
+    for _ in range(steps):
+        x = x * 2.0
+    return x
+
+
+@jax.jit
+def other(x, steps):
+    if steps > 3:  # ...but NOT here: this steps is a tracer
+        return x
+    return -x

@@ -1,0 +1,16 @@
+"""GL102 good: static args, None checks, and shape branches are fine."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def clamp(x, limit, mode, fallback=None):
+    if mode:  # static arg: resolved at trace time
+        x = jnp.abs(x)
+    if fallback is None:  # structure check, not a tracer value
+        fallback = limit
+    if x.shape[0] > 1:  # shapes are trace-time constants
+        x = x[:1]
+    return jnp.where(x > limit, limit, x)  # tracer branch done on device

@@ -1,0 +1,7 @@
+"""GL103 bad: a jit entry point threads slot-state without donation."""
+import jax
+
+
+@jax.jit
+def run_scan(state, classes):
+    return state, classes

@@ -1,0 +1,14 @@
+"""GL103 good: the slot-state carry is donated (or absent)."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def run_scan(state, classes):
+    return state, classes
+
+
+@jax.jit
+def aggregate(takes, unplaced):
+    return takes, unplaced

@@ -1,0 +1,497 @@
+"""Auto-generated wire round-trip battery for solver/codec.py.
+
+The codec is a pair of hand-written encode/decode paths; the failure mode
+is a field that lands on one side only (the ``unavailable_offerings``
+near-miss PR 2 fixed by hand, now also machine-checked by graftlint's
+GL401). This battery closes the loop at runtime:
+
+* PAIRING — every ``encode_X``/``_encode_X`` in the module has a decode
+  twin (introspected from the module, so a new codec entry registers
+  itself into this test or fails it);
+* FIELD COVERAGE — the field sets of every wire dataclass (SimNode,
+  InstanceType, Offering, Requirement, OfferingKey) are pinned against
+  the exact sets the codec serializes, so adding a dataclass field
+  without touching the codec fails here by construction — even though no
+  sample can populate a field that didn't exist when the sample was
+  written;
+* ROUND TRIP — encode→decode over richly-populated samples is
+  field-for-field identical, driven by dataclass/slots introspection
+  rather than hand-listed asserts.
+"""
+from __future__ import annotations
+
+import dataclasses
+import inspect
+
+import numpy as np
+import pytest
+
+from tests.helpers import make_nodepool, make_pod
+
+from karpenter_core_tpu.cloudprovider.fake import fake_instance_types
+from karpenter_core_tpu.cloudprovider.types import (
+    InstanceType,
+    Offering,
+    OfferingKey,
+)
+from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import (
+    SimNode,
+)
+from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+    Topology,
+)
+from karpenter_core_tpu.scheduling.requirement import Requirement
+from karpenter_core_tpu.scheduling.volumeusage import VolumeUsage
+from karpenter_core_tpu.solver import codec
+
+
+# ---------------------------------------------------------------------------
+# introspected deep equality
+# ---------------------------------------------------------------------------
+
+
+def deep_eq(a, b, path="$"):
+    """Field-for-field equality via introspection; returns a list of
+    difference descriptions (empty = equal)."""
+    diffs = []
+    if dataclasses.is_dataclass(a) and not isinstance(a, type):
+        if type(a) is not type(b):
+            return [f"{path}: type {type(a).__name__} != {type(b).__name__}"]
+        for f in dataclasses.fields(a):
+            if f.name.startswith("_"):
+                continue  # caches, not wire state
+            diffs += deep_eq(
+                getattr(a, f.name), getattr(b, f.name), f"{path}.{f.name}"
+            )
+        return diffs
+    if isinstance(a, Requirement):
+        if not isinstance(b, Requirement):
+            return [f"{path}: {type(b).__name__} is not a Requirement"]
+        for slot in Requirement.__slots__:
+            diffs += deep_eq(
+                getattr(a, slot), getattr(b, slot), f"{path}.{slot}"
+            )
+        return diffs
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            return [f"{path}: arrays differ"]
+        return []
+    if isinstance(a, dict):
+        if not isinstance(b, dict):
+            return [f"{path}: {type(b).__name__} is not a dict"]
+        if set(a) != set(b):
+            return [f"{path}: keys {sorted(a)} != {sorted(b)}"]
+        for k in a:
+            diffs += deep_eq(a[k], b[k], f"{path}[{k!r}]")
+        return diffs
+    if isinstance(a, (set, frozenset)):
+        if set(a) != set(b):
+            return [f"{path}: sets differ ({a} != {b})"]
+        return []
+    if isinstance(a, (list, tuple)):
+        if len(a) != len(b):
+            return [f"{path}: length {len(a)} != {len(b)}"]
+        for i, (x, y) in enumerate(zip(a, b)):
+            diffs += deep_eq(x, y, f"{path}[{i}]")
+        return diffs
+    if (
+        type(a) is type(b)
+        and hasattr(a, "__dict__")
+        and not isinstance(a, (str, int, float, bool))
+    ):
+        # plain objects (VolumeUsage, API objects' helpers): compare their
+        # public attributes; underscore attrs are caches/derived state
+        for k in sorted(set(vars(a)) | set(vars(b))):
+            if k.startswith("_"):
+                continue
+            diffs += deep_eq(
+                vars(a).get(k), vars(b).get(k), f"{path}.{k}"
+            )
+        return diffs
+    if a != b:
+        return [f"{path}: {a!r} != {b!r}"]
+    return []
+
+
+def assert_deep_eq(a, b, what):
+    diffs = deep_eq(a, b)
+    assert not diffs, f"{what} round-trip drift:\n" + "\n".join(diffs[:20])
+
+
+# ---------------------------------------------------------------------------
+# samples
+# ---------------------------------------------------------------------------
+
+
+def sample_requirement() -> Requirement:
+    return Requirement(
+        "topology.kubernetes.io/zone",
+        complement=True,
+        values={"z3", "z1"},
+        greater_than=2,
+        less_than=9,
+        min_values=2,
+    )
+
+
+def sample_volume_usage() -> VolumeUsage:
+    vu = VolumeUsage()
+    vu.add_limit("ebs.csi", 4)
+    vu.add_limit("nfs.csi", 2)
+    vu.volumes = {"ebs.csi": {"default/pvc-a", "default/pvc-b"}}
+    return vu
+
+
+def sample_sim_node(name="existing-0") -> SimNode:
+    from karpenter_core_tpu.api.objects import Taint
+
+    return SimNode(
+        name=name,
+        labels={"karpenter.sh/nodepool": "default", "k": "v"},
+        taints=[Taint(key="dedicated", value="gpu", effect="NoSchedule")],
+        available={"cpu": 3.0, "memory": 8.0 * 2**30},
+        capacity={"cpu": 4.0, "memory": 16.0 * 2**30},
+        daemon_requests={"cpu": 0.1},
+        initialized=False,
+        nodeclaim_name="claim-0",
+        nodepool_name="default",
+        volume_usage=sample_volume_usage(),
+    )
+
+
+def sample_topology() -> Topology:
+    bound = make_pod(cpu=0.5, name="bound-0")
+    return Topology(
+        domains={"topology.kubernetes.io/zone": {"z1", "z2"}},
+        existing_pods=[
+            (bound, {"kubernetes.io/hostname": "existing-0"}, "existing-0")
+        ],
+        excluded_pod_uids=["uid-1", "uid-2"],
+    )
+
+
+def sample_problem() -> dict:
+    catalog = fake_instance_types(4)
+    return dict(
+        nodepools=[make_nodepool(), make_nodepool(name="batch", weight=10)],
+        # the same IT objects serve both pools: identity must survive
+        instance_types={"default": catalog, "batch": catalog[:2]},
+        existing_nodes=[sample_sim_node()],
+        daemonset_pods=[make_pod(cpu=0.1, name="ds-0")],
+        pods=[make_pod(cpu=1.0, name=f"p-{i}") for i in range(3)],
+        topology=sample_topology(),
+        max_slots=128,
+        unavailable_offerings=frozenset(
+            {OfferingKey("fake-2x", "z1", "spot")}
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# pairing + coverage (introspected)
+# ---------------------------------------------------------------------------
+
+
+def _codec_functions():
+    return {
+        name: fn
+        for name, fn in vars(codec).items()
+        if inspect.isfunction(fn)
+    }
+
+
+def test_every_encoder_has_a_decoder_and_vice_versa():
+    fns = _codec_functions()
+    for name in fns:
+        if name.lstrip("_").startswith("encode_"):
+            twin = name.replace("encode_", "decode_", 1)
+            assert twin in fns, f"{name} has no {twin}"
+        if name.lstrip("_").startswith("decode_"):
+            twin = name.replace("decode_", "encode_", 1)
+            assert twin in fns, f"{name} has no {twin}"
+
+
+# every top-level encode entry must appear here; the test below fails the
+# moment codec grows one this battery doesn't exercise
+_ROUNDTRIPPED_ENTRIES = {
+    "encode_request",
+    "encode_response",
+    "encode_solve_request",
+    "encode_solve_results",
+    "encode_frontier_request",
+    "encode_frontier_response",
+}
+
+
+def test_roundtrip_battery_covers_every_top_level_entry():
+    fns = _codec_functions()
+    top = {n for n in fns if n.startswith("encode_")}
+    missing = top - _ROUNDTRIPPED_ENTRIES
+    assert not missing, (
+        f"new top-level codec entries without a round-trip test: {missing}"
+    )
+
+
+# wire dataclass field pins: adding a field to one of these types without
+# teaching the codec (and the samples above) trips the assertion
+_WIRE_FIELDS = {
+    SimNode: {
+        "name", "labels", "taints", "available", "capacity",
+        "daemon_requests", "initialized", "nodeclaim_name",
+        "nodepool_name", "volume_usage",
+    },
+    InstanceType: {"name", "requirements", "offerings", "capacity", "overhead"},
+    Offering: {"requirements", "price", "available"},
+    OfferingKey: {"instance_type", "zone", "capacity_type"},
+}
+
+
+def test_wire_dataclass_fields_are_covered():
+    for cls, covered in _WIRE_FIELDS.items():
+        if dataclasses.is_dataclass(cls):
+            actual = {
+                f.name
+                for f in dataclasses.fields(cls)
+                if not f.name.startswith("_")
+            }
+        else:  # NamedTuple
+            actual = set(cls._fields)
+        assert actual == covered, (
+            f"{cls.__name__} fields changed: {sorted(actual ^ covered)} —"
+            " update solver/codec.py AND this battery together"
+        )
+    assert set(Requirement.__slots__) == {
+        "key", "complement", "values", "greater_than", "less_than",
+        "min_values",
+    }, "Requirement grew a slot: update codec._encode_req/_decode_req too"
+
+
+# ---------------------------------------------------------------------------
+# round trips
+# ---------------------------------------------------------------------------
+
+
+def test_requirement_roundtrip():
+    r = sample_requirement()
+    assert_deep_eq(
+        r, codec._decode_req(codec._encode_req(r)), "Requirement"
+    )
+
+
+def test_instance_type_roundtrip():
+    it = fake_instance_types(3)[2]
+    back = codec._decode_instance_type(codec._encode_instance_type(it))
+    assert_deep_eq(it, back, "InstanceType")
+
+
+def test_sim_node_roundtrip():
+    n = sample_sim_node()
+    assert_deep_eq(n, codec._decode_sim_node(codec._encode_sim_node(n)), "SimNode")
+
+
+def test_volume_usage_roundtrip():
+    vu = sample_volume_usage()
+    back = codec._decode_volume_usage(codec._encode_volume_usage(vu))
+    assert_deep_eq(vu.limits, back.limits, "VolumeUsage.limits")
+    assert_deep_eq(vu.volumes, back.volumes, "VolumeUsage.volumes")
+    assert codec._decode_volume_usage(codec._encode_volume_usage(None)) is None
+
+
+def test_topology_roundtrip():
+    topo = sample_topology()
+    back = codec._decode_topology(codec._encode_topology(topo))
+    assert_deep_eq(topo.domains, back.domains, "Topology.domains")
+    assert_deep_eq(
+        topo.excluded_pods, back.excluded_pods, "Topology.excluded_pods"
+    )
+    assert len(back.existing_pods) == 1
+    pod, labels, name = back.existing_pods[0]
+    assert_deep_eq(topo.existing_pods[0][0], pod, "Topology existing pod")
+    assert labels == topo.existing_pods[0][1] and name == "existing-0"
+    assert codec._decode_topology(codec._encode_topology(None)) is None
+
+
+def test_solve_request_roundtrip_field_for_field():
+    """Every parameter of encode_solve_request must survive to the decoded
+    kwargs dict under the same name — introspected from the signature, so
+    a new parameter without a decode counterpart fails here."""
+    problem = sample_problem()
+    data = codec.encode_solve_request(**problem)
+    decoded = codec.decode_solve_request(data)
+    for param in inspect.signature(codec.encode_solve_request).parameters:
+        assert param in decoded, (
+            f"encode_solve_request param {param!r} missing from decode —"
+            " the field only landed on one side of the wire"
+        )
+        if param == "topology":
+            t, b = problem[param], decoded[param]
+            assert_deep_eq(t.domains, b.domains, "topology.domains")
+            assert_deep_eq(
+                t.excluded_pods, b.excluded_pods, "topology.excluded"
+            )
+            continue
+        got, want = decoded[param], problem[param]
+        if param in ("nodepools", "existing_nodes", "daemonset_pods"):
+            # these wire lists travel in canonical sorted order (they are
+            # hashed positionally by problem_fingerprint); their decode
+            # semantics are order-insensitive, so compare canonically
+            def _key(o):
+                return getattr(o, "name", "") or o.metadata.name
+
+            got = sorted(got, key=_key)
+            want = sorted(want, key=_key)
+        assert_deep_eq(want, got, f"solve.{param}")
+    assert decoded["fingerprint"] == codec.problem_fingerprint(
+        codec._json_header(data)
+    )
+    # instance-type object identity survives the table encoding
+    its = decoded["instance_types"]
+    assert its["batch"][0] is its["default"][0]
+    assert its["batch"][1] is its["default"][1]
+
+
+def test_solve_request_wire_bytes_are_canonical():
+    """Same logical problem, different host-side dict insertion order ->
+    byte-identical wire (and therefore an identical problem fingerprint):
+    the property the GL201 sweep of codec/vocab established."""
+    problem = sample_problem()
+    problem["existing_nodes"] = problem["existing_nodes"] + [
+        sample_sim_node("existing-1")
+    ]
+    flipped = dict(problem)
+    flipped["instance_types"] = dict(
+        reversed(list(problem["instance_types"].items()))
+    )
+    flipped["nodepools"] = list(reversed(problem["nodepools"]))
+    flipped["existing_nodes"] = list(reversed(problem["existing_nodes"]))
+    flipped["daemonset_pods"] = list(reversed(problem["daemonset_pods"]))
+    a = codec.encode_solve_request(**problem)
+    b = codec.encode_solve_request(**flipped)
+    assert codec.problem_fingerprint(
+        codec._json_header(a)
+    ) == codec.problem_fingerprint(codec._json_header(b))
+
+
+def test_solve_results_roundtrip():
+    from types import SimpleNamespace as NS
+
+    catalog = fake_instance_types(2)
+    results = NS(
+        new_node_claims=[
+            NS(
+                template=NS(nodepool_name="default"),
+                instance_type_options=catalog,
+                requirements={
+                    sample_requirement().key: sample_requirement(),
+                },
+                requests={"cpu": 2.0},
+                pods=[NS(uid="u-1"), NS(uid="u-2")],
+            )
+        ],
+        existing_nodes=[NS(name="existing-0", pods=[NS(uid="u-3")])],
+        pod_errors={"u-9": "unschedulable"},
+    )
+    decoded = codec.decode_solve_results(
+        codec.encode_solve_results(results, solve_seconds=0.25)
+    )
+    claim = decoded["claims"][0]
+    assert claim["nodepool"] == "default"
+    assert claim["instance_types"] == [it.name for it in catalog]
+    assert claim["pod_uids"] == ["u-1", "u-2"]
+    assert claim["requests"] == {"cpu": 2.0}
+    assert_deep_eq(
+        sample_requirement(),
+        claim["requirements"][sample_requirement().key],
+        "claim requirements",
+    )
+    assert decoded["existing"] == [
+        {"node": "existing-0", "pod_uids": ["u-3"]}
+    ]
+    assert decoded["errors"] == {"u-9": "unschedulable"}
+    assert decoded["solve_seconds"] == 0.25
+
+
+def test_frontier_request_roundtrip():
+    problem = sample_problem()
+    kwargs = dict(
+        nodepools=problem["nodepools"],
+        instance_types=problem["instance_types"],
+        cand_nodes=[sample_sim_node("cand-0")],
+        keep_nodes=[sample_sim_node("keep-0")],
+        daemonset_pods=problem["daemonset_pods"],
+        base_pods=problem["pods"][:1],
+        candidate_pods=[problem["pods"][1:]],
+        max_slots=64,
+    )
+    decoded = codec.decode_frontier_request(
+        codec.encode_frontier_request(**kwargs)
+    )
+    for param in inspect.signature(
+        codec.encode_frontier_request
+    ).parameters:
+        assert param in decoded
+        assert_deep_eq(kwargs[param], decoded[param], f"frontier.{param}")
+
+
+def test_frontier_response_roundtrip():
+    frontier = [(True, 0, 0.0), (False, 3, 12.5)]
+    assert codec.decode_frontier_response(
+        codec.encode_frontier_response(frontier)
+    ) == frontier
+    assert codec.decode_frontier_response(
+        codec.encode_frontier_response(None)
+    ) is None
+
+
+def test_snapshot_request_response_roundtrip():
+    from karpenter_core_tpu.solver.snapshot import encode_snapshot
+
+    pods = [make_pod(cpu=1.0, name=f"p-{i}") for i in range(4)]
+    snap, _extra, _taints = encode_snapshot(pods, fake_instance_types(3))
+    data = codec.encode_request(
+        snap.vocab,
+        snap.resource_names,
+        snap.class_masks,
+        snap.class_requests,
+        snap.class_counts,
+        snap.it_masks,
+        snap.it_allocatable,
+    )
+    vocab, names, cm, creq, ccnt, im, alloc = codec.decode_request(data)
+    assert names == snap.resource_names
+    assert vocab.fingerprint() == snap.vocab.fingerprint()
+    for got, want in (
+        (cm.mask, snap.class_masks.mask),
+        (cm.gt, snap.class_masks.gt),
+        (im.mask, snap.it_masks.mask),
+        (creq, snap.class_requests),
+        (ccnt, snap.class_counts),
+        (alloc, snap.it_allocatable),
+    ):
+        assert np.array_equal(got, want)
+
+    takes = np.arange(12, dtype=np.int32).reshape(3, 4)
+    unplaced = np.array([0, 1, 0], dtype=np.int32)
+    slot_template = np.array([0, -1, 2, 1], dtype=np.int32)
+    t2, u2, s2 = codec.decode_response(
+        codec.encode_response(takes, unplaced, slot_template)
+    )
+    assert np.array_equal(t2, takes)
+    assert np.array_equal(u2, unplaced)
+    assert np.array_equal(s2, slot_template)
+
+
+def test_version_skew_is_explicit_everywhere():
+    """Every decoder rejects a foreign wire version loudly (the GL401
+    finding this PR fixed on decode_request)."""
+    problem = sample_problem()
+    blob = codec.encode_solve_request(**problem)
+    hacked = codec._json_payload(
+        {**codec._json_header(blob), "version": 99}
+    )
+    with pytest.raises(ValueError, match="version"):
+        codec.decode_solve_request(hacked)
+
+    snap_blob = codec._json_payload({"version": 99})
+    with pytest.raises(ValueError, match="version"):
+        codec.decode_request(snap_blob)

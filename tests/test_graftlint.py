@@ -1,0 +1,176 @@
+"""Tier-1 enforcement + per-rule unit tests for tools/graftlint.
+
+Two jobs:
+
+1. ``test_tree_is_clean`` runs the full engine over ``karpenter_core_tpu/``
+   and fails on ANY unsuppressed finding — the invariants the rules encode
+   (canonical encode order, jit purity, lock discipline, wire/metric
+   parity) become CI properties of every future diff.
+2. The fixture battery proves each rule FIRES on its bad fixture and stays
+   quiet on the good one, so a refactor of the engine cannot silently turn
+   a rule into a no-op (a linter that never fires passes every tree).
+"""
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from tools.graftlint import RULES, run
+from tools.graftlint.engine import (
+    BASELINE_PATH,
+    LINT_BUDGET_SECONDS,
+    REPO_ROOT,
+    write_baseline,
+)
+
+FIXTURES = Path(__file__).parent / "graftlint_fixtures"
+
+
+def _fixture_pairs():
+    pairs = []
+    for bad in sorted(FIXTURES.rglob("*_bad*.py")):
+        rule = bad.name.split("_")[0].upper()
+        good_matches = [
+            g for g in FIXTURES.rglob(f"{rule.lower()}_good*.py")
+        ]
+        assert good_matches, f"no good fixture for {rule}"
+        pairs.append((rule, bad, good_matches[0]))
+    return pairs
+
+
+_PAIRS = _fixture_pairs()
+
+
+# -- tier-1 gate -----------------------------------------------------------
+
+
+def test_tree_is_clean():
+    t0 = time.perf_counter()
+    result = run(["karpenter_core_tpu"])
+    elapsed = time.perf_counter() - t0
+    rendered = "\n".join(f.render() for f, _src in result.new)
+    assert result.ok, (
+        f"graftlint found new violations:\n{rendered}\n"
+        "fix them, or add an inline '# graftlint: disable=RULE -- why'"
+    )
+    # the lint pass must stay cheap enough to run on every test invocation
+    assert elapsed < LINT_BUDGET_SECONDS, (
+        f"graftlint took {elapsed:.1f}s (budget {LINT_BUDGET_SECONDS}s)"
+    )
+
+
+def test_rule_inventory():
+    """At least 8 rules across the four invariant families."""
+    run([str(FIXTURES / "gl000_good.py")])  # force registration
+    ids = set(RULES)
+    assert len(ids) >= 8, f"only {len(ids)} rules registered: {sorted(ids)}"
+    families = {rid[:3] for rid in ids if rid != "GL000"}
+    assert {"GL1", "GL2", "GL3", "GL4"} <= families, (
+        "expected jax-purity (GL1xx), determinism (GL2xx), concurrency"
+        f" (GL3xx) and parity (GL4xx) families, got {sorted(families)}"
+    )
+
+
+def test_baseline_is_frozen_empty():
+    """Repo policy (ISSUE 4): no baselined debt for the shipped families —
+    violations are fixed or inline-justified, never parked."""
+    data = json.loads(BASELINE_PATH.read_text())
+    assert data == {"entries": {}}
+
+
+# -- per-rule fixtures -----------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "rule,bad,good", _PAIRS, ids=[p[0] for p in _PAIRS]
+)
+def test_rule_fires_on_bad_fixture(rule, bad, good):
+    result = run([str(bad)], use_baseline=False, rule_ids=[rule])
+    assert result.new, f"{rule} did not fire on {bad.name}"
+    assert all(f.rule == rule for f, _ in result.new)
+
+
+@pytest.mark.parametrize(
+    "rule,bad,good", _PAIRS, ids=[p[0] for p in _PAIRS]
+)
+def test_rule_quiet_on_good_fixture(rule, bad, good):
+    result = run([str(good)], use_baseline=False, rule_ids=[rule])
+    rendered = "\n".join(f.render() for f, _ in result.new)
+    assert not result.new, f"{rule} over-fired on {good.name}:\n{rendered}"
+
+
+def test_every_rule_has_a_failing_fixture():
+    covered = {rule for rule, _b, _g in _PAIRS}
+    run([str(FIXTURES / "gl000_good.py")])  # force registration
+    missing = set(RULES) - covered - {"GL000"}
+    assert not missing, (
+        f"rules without a bad fixture proving they fire: {sorted(missing)}"
+    )
+    assert "GL000" in covered  # the suppression-hygiene meta rule too
+
+
+# -- suppression + baseline mechanics --------------------------------------
+
+
+def test_inline_suppression_silences_and_is_counted():
+    result = run(
+        [str(FIXTURES / "gl000_good.py")],
+        use_baseline=False,
+        rule_ids=["GL201"],
+    )
+    assert not result.new
+    assert len(result.suppressed) == 1
+
+
+def test_suppression_without_justification_is_flagged():
+    result = run(
+        [str(FIXTURES / "gl000_bad.py")],
+        use_baseline=False,
+        rule_ids=["GL000", "GL201"],
+    )
+    assert [f.rule for f, _ in result.new] == ["GL000"]
+    # the (unjustified) disable still silences the underlying finding;
+    # GL000 is what forces the justification to appear
+    assert len(result.suppressed) == 1
+
+
+def test_baseline_roundtrip(tmp_path):
+    """--baseline freezes current findings; a rerun against that file is
+    clean; the baseline does NOT absorb findings on new lines."""
+    bad = FIXTURES / "gl201_bad.py"
+    fresh = run([str(bad)], use_baseline=False, rule_ids=["GL201"])
+    assert fresh.new
+    bl = tmp_path / "baseline.json"
+    write_baseline(fresh, bl)
+    again = run(
+        [str(bad)], use_baseline=True, rule_ids=["GL201"], baseline_path=bl
+    )
+    assert not again.new
+    assert len(again.baselined) == len(fresh.new)
+
+    # a NEW copy of the same violations in another file is not absorbed
+    # (the dir name keeps the clone inside GL201's fixture scope)
+    clone_dir = tmp_path / "graftlint_fixtures"
+    clone_dir.mkdir()
+    clone = clone_dir / "gl201_clone.py"
+    clone.write_text(bad.read_text())
+    grown = run(
+        [str(clone)], use_baseline=True, rule_ids=["GL201"], baseline_path=bl
+    )
+    assert grown.new, "baseline must not absorb violations in new files"
+
+
+def test_cli_exit_codes(tmp_path):
+    from tools.graftlint.engine import main
+
+    assert main([str(FIXTURES / "gl201_good.py"), "--rule", "GL201"]) == 0
+    assert main([str(FIXTURES / "gl201_bad.py"), "--rule", "GL201"]) == 1
+
+
+def test_repo_paths_resolve_relative_to_root():
+    """The default path works no matter the CWD (engine anchors on the
+    repo root, so CI and `python -m` from anywhere agree)."""
+    assert (REPO_ROOT / "karpenter_core_tpu").is_dir()

@@ -1,0 +1,83 @@
+"""AST-driven metrics audit: emitted == registered, no grep involved.
+
+graftlint's GL402 gates one direction on every run (nothing emits an
+unregistered instrument); this audit pins the full equality so dashboards
+never reference a phantom series AND the registry never carries dead
+instruments that a dashboard author would reasonably chart against:
+
+* every instrument emitted anywhere in ``karpenter_core_tpu/`` resolves
+  to a ``REGISTRY.counter/gauge/histogram`` definition;
+* every defined instrument is emitted somewhere (or sits on the explicit
+  exemption list below, with a reason);
+* metric string names are unique across all definitions.
+
+Built on the same collectors the lint rule uses
+(tools/graftlint/rules/parity.py), so the test and the gate can never
+drift apart on what counts as an emission site.
+"""
+from __future__ import annotations
+
+from collections import Counter
+
+from tools.graftlint.engine import _collect_files
+from tools.graftlint.rules.parity import (
+    collect_defined_instruments,
+    collect_used_instruments,
+)
+
+# instruments that are registered but legitimately never .inc()'d from
+# karpenter_core_tpu/ source; every entry needs a reason
+_DEFINED_NOT_EMITTED_OK: dict = {
+    # (none today — keep it that way)
+}
+
+
+def _files():
+    return _collect_files(["karpenter_core_tpu"])
+
+
+def test_every_emission_site_is_registered():
+    files = _files()
+    defined = collect_defined_instruments(files)
+    used = collect_used_instruments(files)
+    phantoms = {
+        name: [f"{f.path}:{f.line}" for f in sites]
+        for name, sites in used.items()
+        if name not in defined
+    }
+    assert not phantoms, f"emission sites with no registration: {phantoms}"
+
+
+def test_every_registered_instrument_is_emitted():
+    files = _files()
+    defined = collect_defined_instruments(files)
+    used = collect_used_instruments(files)
+    dead = set(defined) - set(used) - set(_DEFINED_NOT_EMITTED_OK)
+    assert not dead, (
+        f"registered instruments never emitted: {sorted(dead)} — emit"
+        " them, or move them to _DEFINED_NOT_EMITTED_OK with a reason"
+    )
+
+
+def test_metric_string_names_are_unique():
+    files = _files()
+    defined = collect_defined_instruments(files)
+    all_metrics = [m for metrics in defined.values() for m in metrics]
+    dupes = {
+        name: n for name, n in Counter(all_metrics).items() if n > 1 and name
+    }
+    assert not dupes, f"metric string registered twice: {dupes}"
+    # and no instrument VARIABLE is bound twice either — a second binding
+    # would shadow the first at the emission sites
+    rebound = {k: v for k, v in defined.items() if len(v) > 1}
+    assert not rebound, f"instrument name bound more than once: {rebound}"
+
+
+def test_audit_sees_a_realistic_surface():
+    """Sanity floor so a collector regression can't silently pass the
+    equality tests by seeing nothing at all."""
+    files = _files()
+    defined = collect_defined_instruments(files)
+    used = collect_used_instruments(files)
+    assert len(defined) >= 30, f"only {len(defined)} definitions found"
+    assert len(used) >= 30, f"only {len(used)} emission sites found"

@@ -1,0 +1,15 @@
+"""graftlint: project-native static analysis for karpenter-core-tpu.
+
+Run: ``python -m tools.graftlint [--baseline] [--timing] [paths...]``
+
+Public API: ``run``, ``Rule``, ``register``, ``Finding``, ``RULES``
+(tools/graftlint/engine.py documents the rule-author contract).
+"""
+from tools.graftlint.engine import (  # noqa: F401
+    Finding,
+    ParsedFile,
+    Rule,
+    RULES,
+    register,
+    run,
+)

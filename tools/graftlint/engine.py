@@ -1,0 +1,440 @@
+"""graftlint engine: rule registry, suppressions, baseline, runner.
+
+The solver's correctness rests on invariants pytest cannot see — canonical
+iteration order feeding fingerprints, host-sync-free jit regions, lock
+discipline around the threaded solverd, encode/decode field parity on the
+wire. graftlint machine-checks them on every diff. This module is the
+project-agnostic half: file loading, the rule-author API, inline
+suppressions, the frozen baseline, and the CLI runner. The invariants
+themselves live in ``tools/graftlint/rules/`` (one module per family).
+
+Rule-author API
+---------------
+Subclass :class:`Rule` and decorate with :func:`register`::
+
+    from tools.graftlint.engine import Rule, register
+
+    @register
+    class NoSleepInReconcile(Rule):
+        id = "GL501"
+        name = "reconcile-sleep"
+        rationale = "time.sleep in a reconciler stalls the whole pass"
+
+        def applies(self, pf):           # optional file filter
+            return "controllers/" in pf.relpath
+
+        def check(self, pf):             # per-file rule
+            for node in pf.walk(ast.Call):
+                if pf.call_name(node) == "time.sleep":
+                    yield self.finding(pf, node, "time.sleep in reconcile path")
+
+Project-scope rules (cross-file: parity checks) set ``scope = "project"``
+and implement ``check_project(files)`` instead. Import the module from
+``tools/graftlint/rules/__init__.py`` so registration runs.
+
+Suppressions
+------------
+``# graftlint: disable=GL201 -- <justification>`` on the flagged line (or a
+standalone comment on the line above) silences that rule there. The
+justification after ``--`` is mandatory: a bare disable is itself reported
+as GL000. ``disable=all`` silences every rule for the line.
+
+Baseline
+--------
+``tools/graftlint/baseline.json`` freezes reviewed pre-existing violations
+(fingerprinted by rule + path + source text, so unrelated edits don't shift
+them). ``--baseline`` rewrites it from the current findings; anything not
+in it fails the run. The repo policy (ISSUE 4) is an EMPTY baseline for the
+shipped rule families — real violations get fixed or inline-justified.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import time
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+# single source of truth for the tier-1 wall-time budget: the test gate
+# (tests/test_graftlint.py) and bench.py --lint both enforce this value
+LINT_BUDGET_SECONDS = 10.0
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+?)\s*(?:--\s*(\S.*))?$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+
+    def fingerprint(self, source_line: str) -> str:
+        """Line-number-independent identity for baseline entries."""
+        return f"{self.rule}|{self.path}|{source_line.strip()}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+class ParsedFile:
+    """One source file plus the per-file artifacts every rule shares."""
+
+    def __init__(self, path: Path, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child._gl_parent = parent  # type: ignore[attr-defined]
+        # line -> (rule ids | {"all"}, has_justification). Parsed from
+        # COMMENT tokens only — a string literal containing the disable
+        # syntax (docs, error messages) must neither suppress nor trip
+        # GL000.
+        self.suppressions: Dict[int, Tuple[set, bool]] = {}
+        self.comment_lines: set = set()
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type != tokenize.COMMENT:
+                    continue
+                lineno = tok.start[0]
+                if tok.start[1] == 0 or not self.lines[
+                    lineno - 1
+                ][: tok.start[1]].strip():
+                    self.comment_lines.add(lineno)  # standalone comment
+                m = _SUPPRESS_RE.search(tok.string)
+                if m:
+                    rules = {
+                        r.strip() for r in m.group(1).split(",") if r.strip()
+                    }
+                    self.suppressions[lineno] = (rules, m.group(2) is not None)
+        except tokenize.TokenError:
+            pass  # ast.parse above succeeded; treat the tail as comment-free
+
+    def walk(self, *types) -> Iterator[ast.AST]:
+        for node in ast.walk(self.tree):
+            if not types or isinstance(node, types):
+                yield node
+
+    def parents(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = getattr(node, "_gl_parent", None)
+        while cur is not None:
+            yield cur
+            cur = getattr(cur, "_gl_parent", None)
+
+    def enclosing_function(self, node: ast.AST):
+        for p in self.parents(node):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return p
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        for p in self.parents(node):
+            if isinstance(p, ast.ClassDef):
+                return p
+        return None
+
+    def call_name(self, node: ast.Call) -> str:
+        """Dotted name of a call target: ``time.sleep``, ``sorted`` — ''
+        when the callee is not a plain name/attribute chain."""
+        return dotted_name(node.func)
+
+    def source_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        """Same-line disable, or a disable anywhere in the contiguous
+        standalone-comment block immediately above the flagged line (so a
+        justification may wrap over several comment lines)."""
+        candidates = [finding.line]
+        lineno = finding.line - 1
+        while lineno >= 1 and lineno in self.comment_lines:
+            candidates.append(lineno)
+            lineno -= 1
+        for ln in candidates:
+            entry = self.suppressions.get(ln)
+            if entry is None:
+                continue
+            rules, _ = entry
+            if finding.rule in rules or "all" in rules:
+                return True
+        return False
+
+
+def dotted_name(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class Rule:
+    """Base class for graftlint rules; see the module docstring."""
+
+    id: str = ""
+    name: str = ""
+    rationale: str = ""
+    scope: str = "file"  # "file" | "project"
+
+    def applies(self, pf: ParsedFile) -> bool:
+        return True
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, files: List[ParsedFile]) -> Iterable[Finding]:
+        return ()
+
+    def finding(self, pf: ParsedFile, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=self.id,
+            path=pf.relpath,
+            line=getattr(node, "lineno", 1),
+            message=message,
+        )
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    inst = cls()
+    if not inst.id or not inst.name:
+        raise ValueError(f"rule {cls.__name__} needs id and name")
+    if inst.id in RULES:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    RULES[inst.id] = inst
+    return cls
+
+
+def _collect_files(paths: List[str]) -> List[ParsedFile]:
+    files: List[ParsedFile] = []
+    seen = set()
+    for raw in paths:
+        p = Path(raw)
+        if not p.is_absolute():
+            p = REPO_ROOT / p
+        if not p.exists():
+            # a typo'd path must fail the gate, not lint zero files green
+            raise SystemExit(f"graftlint: path not found: {raw}")
+        candidates = [p] if p.is_file() else sorted(p.rglob("*.py"))
+        for f in candidates:
+            if "__pycache__" in f.parts or f in seen:
+                continue
+            seen.add(f)
+            try:
+                rel = f.resolve().relative_to(REPO_ROOT).as_posix()
+            except ValueError:
+                rel = f.as_posix()
+            source = f.read_text()
+            try:
+                files.append(ParsedFile(f, rel, source))
+            except SyntaxError as e:
+                raise SystemExit(f"graftlint: cannot parse {rel}: {e}")
+    if not files:
+        raise SystemExit(
+            f"graftlint: no Python files found under {', '.join(paths)}"
+        )
+    return files
+
+
+@dataclass
+class RunResult:
+    new: List[Tuple[Finding, str]]  # (finding, source line)
+    baselined: List[Finding]
+    suppressed: List[Finding]
+    files: int
+    rule_seconds: Dict[str, float]
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def _load_baseline(path: Optional[Path] = None) -> Dict[str, int]:
+    path = path or BASELINE_PATH
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    return dict(data.get("entries", {}))
+
+
+def _bad_suppression_findings(pf: ParsedFile) -> List[Finding]:
+    out = []
+    for lineno, (rules, has_why) in sorted(pf.suppressions.items()):
+        unknown = {
+            r for r in rules if r != "all" and r not in RULES and r != "GL000"
+        }
+        if not has_why:
+            out.append(Finding(
+                "GL000", pf.relpath, lineno,
+                "suppression without justification: write"
+                " '# graftlint: disable=RULE -- why'",
+            ))
+        if unknown:
+            out.append(Finding(
+                "GL000", pf.relpath, lineno,
+                f"suppression names unknown rule(s): {', '.join(sorted(unknown))}",
+            ))
+    return out
+
+
+def run(
+    paths: List[str],
+    use_baseline: bool = True,
+    rule_ids: Optional[List[str]] = None,
+    baseline_path: Optional[Path] = None,
+) -> RunResult:
+    """Run every registered rule over ``paths``; returns the partitioned
+    findings. ``rule_ids`` restricts the pass (rule unit tests)."""
+    from tools.graftlint import rules as _rules  # noqa: F401 (registration)
+
+    files = _collect_files(paths)
+    if rule_ids is not None:
+        unknown = set(rule_ids) - set(RULES) - {"GL000"}
+        if unknown:
+            # same policy as a typo'd path: fail the gate, don't run zero
+            # rules green
+            raise SystemExit(
+                f"graftlint: unknown rule id(s): {', '.join(sorted(unknown))}"
+            )
+    active = [
+        r for rid, r in sorted(RULES.items())
+        if rule_ids is None or rid in rule_ids
+    ]
+    rule_seconds: Dict[str, float] = {}
+    raw: List[Tuple[Finding, ParsedFile]] = []
+    by_rel = {pf.relpath: pf for pf in files}
+
+    for rule in active:
+        t0 = time.perf_counter()
+        if rule.scope == "project":
+            for f in rule.check_project(files):
+                pf = by_rel.get(f.path)
+                if pf is not None:
+                    raw.append((f, pf))
+        else:
+            for pf in files:
+                if rule.applies(pf):
+                    for f in rule.check(pf):
+                        raw.append((f, pf))
+        rule_seconds[rule.id] = time.perf_counter() - t0
+
+    if rule_ids is None or "GL000" in rule_ids:
+        t0 = time.perf_counter()
+        for pf in files:
+            for f in _bad_suppression_findings(pf):
+                raw.append((f, pf))
+        rule_seconds["GL000"] = time.perf_counter() - t0
+
+    baseline = _load_baseline(baseline_path) if use_baseline else {}
+    budget = dict(baseline)
+    new: List[Tuple[Finding, str]] = []
+    baselined: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f, pf in sorted(raw, key=lambda t: (t[0].path, t[0].line, t[0].rule)):
+        if f.rule != "GL000" and pf.is_suppressed(f):
+            suppressed.append(f)
+            continue
+        src = pf.source_line(f.line)
+        fp = f.fingerprint(src)
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            baselined.append(f)
+            continue
+        new.append((f, src))
+    return RunResult(new, baselined, suppressed, len(files), rule_seconds)
+
+
+def write_baseline(result: RunResult, path: Optional[Path] = None) -> int:
+    """Freeze the current new findings into the baseline file. Callers run
+    with use_baseline=False first so every occurrence lands in ``new``."""
+    entries: Dict[str, int] = {}
+    for f, src in result.new:
+        fp = f.fingerprint(src)
+        entries[fp] = entries.get(fp, 0) + 1
+    (path or BASELINE_PATH).write_text(
+        json.dumps({"entries": entries}, indent=2, sort_keys=True) + "\n"
+    )
+    return len(entries)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="project-native static analysis for karpenter-core-tpu",
+    )
+    ap.add_argument(
+        "paths", nargs="*", default=[],
+        help="files/dirs to lint (default: karpenter_core_tpu)",
+    )
+    ap.add_argument(
+        "--baseline", action="store_true",
+        help="rewrite tools/graftlint/baseline.json from current findings",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument(
+        "--timing", action="store_true", help="per-rule wall time report"
+    )
+    ap.add_argument(
+        "--rule", action="append", default=None,
+        help="restrict to one rule id (repeatable)",
+    )
+    args = ap.parse_args(argv)
+
+    from tools.graftlint import rules as _rules  # noqa: F401
+
+    if args.list_rules:
+        for rid, r in sorted(RULES.items()):
+            print(f"{rid}  {r.name:24s} {r.rationale}")
+        return 0
+
+    if args.baseline and (args.rule or args.paths):
+        # a rule- or path-restricted regeneration would silently drop
+        # every other rule's/path's frozen entries from the file
+        raise SystemExit(
+            "graftlint: --baseline regenerates over the full default tree;"
+            " it cannot be combined with --rule or explicit paths"
+        )
+
+    paths = args.paths or ["karpenter_core_tpu"]
+    result = run(paths, use_baseline=not args.baseline, rule_ids=args.rule)
+
+    if args.baseline:
+        n = write_baseline(result)
+        print(f"graftlint: baseline rewritten with {n} entr{'y' if n == 1 else 'ies'}")
+        return 0
+
+    for f, _src in result.new:
+        print(f.render())
+    if args.timing:
+        for rid, dt in sorted(
+            result.rule_seconds.items(), key=lambda kv: -kv[1]
+        ):
+            print(f"# {rid}: {dt * 1000:.1f} ms")
+    print(
+        f"graftlint: {len(result.new)} finding(s)"
+        f" ({len(result.baselined)} baselined,"
+        f" {len(result.suppressed)} suppressed)"
+        f" across {result.files} file(s), {len(result.rule_seconds)} rule(s)"
+    )
+    return 0 if result.ok else 1

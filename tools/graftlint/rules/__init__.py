@@ -1,0 +1,12 @@
+"""Rule families. Importing this package registers every rule.
+
+One module per family; each rule documents the invariant it protects and
+names the code that established it. Add a new family by creating a module
+here and importing it below.
+"""
+from tools.graftlint.rules import (  # noqa: F401
+    concurrency,
+    determinism,
+    jaxpurity,
+    parity,
+)

@@ -1,0 +1,255 @@
+"""Family 3 — lock discipline in threaded modules.
+
+The solverd sidecar serves from a ThreadingHTTPServer: every handler runs
+on its own thread against one shared ``SolverDaemon``, and the supervisor's
+handshake reader runs beside the operator loop. In that world an unlocked
+``self.x += 1`` is a lost update and a field guarded in one method but
+bare in another is a torn read waiting for load. These rules only engage
+in modules that actually create threads (``threading.Thread`` /
+``ThreadingHTTPServer``), so single-threaded host code stays noise-free.
+
+GL301 thread-daemon-explicit — every threading.Thread must pass daemon=
+GL302 unlocked-rmw           — read-modify-write on self attributes
+                               outside the owning lock
+GL303 mixed-lock-discipline  — attribute written both under a lock and
+                               bare in the same class
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.graftlint.engine import ParsedFile, Rule, dotted_name, register
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "Lock", "RLock",
+    "threading.Condition", "Condition",
+}
+_MUTATOR_METHODS = {
+    "append", "extend", "insert", "pop", "remove", "clear", "update",
+    "setdefault", "popitem", "add", "discard",
+}
+
+
+def _creates_threads(pf: ParsedFile) -> bool:
+    for node in pf.walk(ast.Call):
+        name = dotted_name(node.func)
+        if name in ("threading.Thread", "Thread"):
+            return True
+        if name.endswith("ThreadingHTTPServer") or name.endswith(
+            "ThreadingTCPServer"
+        ):
+            return True
+    for node in pf.walk(ast.Name):
+        if node.id in ("ThreadingHTTPServer", "ThreadingTCPServer"):
+            return True
+    return False
+
+
+@register
+class ThreadDaemonExplicit(Rule):
+    id = "GL301"
+    name = "thread-daemon-explicit"
+    rationale = (
+        "a Thread without an explicit daemon= silently blocks interpreter"
+        " shutdown (or silently dies with it) depending on the default —"
+        " the operator's exit behavior must be a decision, not an accident"
+    )
+
+    def check(self, pf: ParsedFile):
+        for node in pf.walk(ast.Call):
+            if dotted_name(node.func) not in ("threading.Thread", "Thread"):
+                continue
+            if any(kw.arg == "daemon" for kw in node.keywords):
+                continue
+            yield self.finding(
+                pf, node,
+                "threading.Thread without explicit daemon= — decide whether"
+                " this thread may outlive the process teardown",
+            )
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """self attributes assigned a Lock/RLock/Condition anywhere in cls."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        if dotted_name(node.value.func) not in _LOCK_CTORS:
+            continue
+        for tgt in node.targets:
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                out.add(tgt.attr)
+    return out
+
+
+def _locks_held(
+    pf: ParsedFile, node: ast.AST, lock_attrs: Set[str]
+) -> frozenset:
+    """The owning-lock attributes held at node (``with self.<lock>:`` or a
+    lock-method acquire context up the parent chain). Empty = bare."""
+    held = set()
+    for p in pf.parents(node):
+        if not isinstance(p, (ast.With, ast.AsyncWith)):
+            continue
+        for item in p.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func  # self._lock.acquire()-style contexts
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and expr.attr in lock_attrs
+            ):
+                held.add(expr.attr)
+    return frozenset(held)
+
+
+def _method_of(pf: ParsedFile, node: ast.AST) -> Optional[str]:
+    fn = pf.enclosing_function(node)
+    return getattr(fn, "name", None) if fn is not None else None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _mentions_self_attr(expr: ast.AST, attr: str) -> bool:
+    for n in ast.walk(expr):
+        if _self_attr(n) == attr:
+            return True
+    return False
+
+
+@register
+class UnlockedReadModifyWrite(Rule):
+    id = "GL302"
+    name = "unlocked-rmw"
+    rationale = (
+        "self.x += 1 (or self.x = f(self.x)) outside the owning lock in a"
+        " threaded module is a lost update — two handler threads read the"
+        " same old value"
+    )
+
+    def check(self, pf: ParsedFile):
+        if not _creates_threads(pf):
+            return
+        for cls in pf.walk(ast.ClassDef):
+            locks = _lock_attrs(cls)
+            if not locks:
+                continue
+            for node in ast.walk(cls):
+                target_attr = None
+                if isinstance(node, ast.AugAssign):
+                    target_attr = _self_attr(node.target)
+                elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    attr = _self_attr(node.targets[0])
+                    if attr is not None and _mentions_self_attr(node.value, attr):
+                        target_attr = attr
+                if target_attr is None:
+                    continue
+                if _method_of(pf, node) == "__init__":
+                    continue  # construction happens-before publication
+                if _locks_held(pf, node, locks):
+                    # any owning lock counts here; GL303 catches the
+                    # same attribute guarded by DIFFERENT locks
+                    continue
+                yield self.finding(
+                    pf, node,
+                    f"read-modify-write of self.{target_attr} outside"
+                    f" lock(s) {sorted(locks)} in threaded class"
+                    f" {cls.name!r} — lost-update race",
+                )
+
+
+@register
+class MixedLockDiscipline(Rule):
+    id = "GL303"
+    name = "mixed-lock-discipline"
+    rationale = (
+        "an attribute written under the lock in one method and bare (or"
+        " under a DIFFERENT lock) in another has no consistent owner —"
+        " every reader must assume the weaker discipline"
+    )
+
+    def check(self, pf: ParsedFile):
+        if not _creates_threads(pf):
+            return
+        for cls in pf.walk(ast.ClassDef):
+            locks = _lock_attrs(cls)
+            if not locks:
+                continue
+            # attr -> guard signature (frozenset of held locks) -> sites
+            writes: Dict[str, Dict[frozenset, List[ast.AST]]] = {}
+            for node in ast.walk(cls):
+                attr = None
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for tgt in targets:
+                        a = _self_attr(tgt)
+                        if a is None and isinstance(
+                            tgt, ast.Subscript
+                        ):
+                            a = _self_attr(tgt.value)
+                        if a is not None:
+                            attr = a
+                elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ) and node.func.attr in _MUTATOR_METHODS:
+                    attr = _self_attr(node.func.value)
+                elif isinstance(node, ast.Delete):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Subscript):
+                            a = _self_attr(tgt.value)
+                            if a is not None:
+                                attr = a
+                if attr is None or attr in locks:
+                    continue
+                if _method_of(pf, node) == "__init__":
+                    continue
+                guard = _locks_held(pf, node, locks)
+                writes.setdefault(attr, {}).setdefault(guard, []).append(node)
+            for attr in sorted(writes):
+                guards = writes[attr]
+                if len(guards) < 2:
+                    continue
+                # flag every site not under the dominant guard (most
+                # sites; ties prefer a locked guard over bare)
+                dominant = max(
+                    guards, key=lambda g: (len(guards[g]), len(g))
+                )
+                for guard in sorted(guards, key=sorted):
+                    if guard == dominant:
+                        continue
+                    have = (
+                        f"lock(s) {sorted(guard)}" if guard else "no lock"
+                    )
+                    want = (
+                        f"lock(s) {sorted(dominant)}"
+                        if dominant
+                        else "no lock"
+                    )
+                    for node in guards[guard]:
+                        yield self.finding(
+                            pf, node,
+                            f"self.{attr} is written under {want}"
+                            f" elsewhere in {cls.name!r} but under"
+                            f" {have} here — pick one discipline",
+                        )

@@ -1,0 +1,168 @@
+"""Family 2 — fingerprint determinism.
+
+PR 3's prepared-state caches key on ``FrozenVocab.fingerprint()`` and the
+solverd scheduler cache keys on ``codec.problem_fingerprint`` — both are
+only stable if every id-assigning or wire-list-building iteration runs in
+canonical order. A ``set`` (or a dict whose insertion order tracks pod
+arrival) iterated into an encoder silently yields a different fingerprint
+for the same logical cluster: the cache misses forever at best, or two
+processes disagree about id assignment at worst. These rules police the
+encoding/fingerprint functions of the four modules that own that contract.
+
+GL201 unordered-encode-iter — set/dict-view iteration inside an encoding
+                              or fingerprint function without sorted(...)
+GL202 fingerprint-json-keys — json.dumps in a fingerprint/digest function
+                              must pass sort_keys=True
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional, Tuple
+
+from tools.graftlint.engine import ParsedFile, Rule, dotted_name, register
+
+# the modules whose encode paths feed tensor ids, wire bytes, or cache keys
+_SCOPED_FILES = (
+    "solver/vocab.py",
+    "solver/codec.py",
+    "solver/snapshot.py",
+    "models/provisioner.py",
+)
+
+_CONTEXT_FN = re.compile(
+    r"(encode|fingerprint|digest|signature|observe|vocab|_fp_)", re.I
+)
+
+_ORDER_SAFE_WRAPPERS = {"sorted"}
+_TRANSPARENT_WRAPPERS = {"enumerate", "list", "tuple", "reversed", "zip"}
+
+
+def _in_scope(pf: ParsedFile) -> bool:
+    return any(pf.relpath.endswith(s) for s in _SCOPED_FILES) or (
+        "graftlint_fixtures" in pf.relpath
+    )
+
+
+def _context_function(pf: ParsedFile, node: ast.AST):
+    """Nearest enclosing function whose name (or any enclosing function's
+    name) marks an encoding/fingerprint context."""
+    fn = pf.enclosing_function(node)
+    cur = fn
+    while cur is not None:
+        name = getattr(cur, "name", "")
+        if name and _CONTEXT_FN.search(name):
+            return cur
+        cur = pf.enclosing_function(cur)
+    return None
+
+
+def _is_order_safe(node: ast.AST) -> bool:
+    """True when the iterable is wrapped in sorted(...) (possibly under a
+    transparent wrapper like enumerate/list/zip)."""
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name in _ORDER_SAFE_WRAPPERS:
+            return True
+        if name in _TRANSPARENT_WRAPPERS:
+            return any(_is_order_safe(a) for a in node.args)
+    return False
+
+
+def _unordered_reason(node: ast.AST) -> Optional[str]:
+    """Why this iterable has no canonical order, or None when unknown/ok."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "set literal iteration order is undefined"
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name in ("set", "frozenset"):
+            return f"{name}() iteration order is undefined"
+        if isinstance(node.func, ast.Attribute) and node.func.attr in (
+            "items", "keys", "values",
+        ):
+            return (
+                f".{node.func.attr}() iterates in dict insertion order,"
+                " which tracks arrival order, not content"
+            )
+    if isinstance(node, ast.Attribute) and node.attr == "values":
+        # project knowledge: Requirement.values is a set
+        return ".values is a set attribute (Requirement.values)"
+    return None
+
+
+def _iteration_sites(pf: ParsedFile) -> Iterator[Tuple[ast.AST, ast.AST]]:
+    """(site node, iterable expr) for for-loops and comprehensions."""
+    for node in pf.walk(ast.For):
+        yield node, node.iter
+    for node in pf.walk(ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp):
+        for gen in node.generators:
+            yield node, gen.iter
+
+
+@register
+class UnorderedEncodeIteration(Rule):
+    id = "GL201"
+    name = "unordered-encode-iter"
+    rationale = (
+        "set/dict iteration feeding an encoder or fingerprint must be"
+        " wrapped in sorted(...): unordered iteration poisons the"
+        " prepared-state and solverd scheduler caches"
+    )
+
+    def applies(self, pf: ParsedFile) -> bool:
+        return _in_scope(pf)
+
+    def check(self, pf: ParsedFile):
+        for site, iterable in _iteration_sites(pf):
+            ctx = _context_function(pf, site)
+            if ctx is None:
+                continue
+            if _is_order_safe(iterable):
+                continue
+            reason = _unordered_reason(iterable)
+            if reason is None:
+                continue
+            yield self.finding(
+                pf, site,
+                f"unordered iteration in encoding/fingerprint function"
+                f" {ctx.name!r}: {reason}; wrap in sorted(...) or justify"
+                " order-insensitivity inline",
+            )
+
+
+_FP_FN = re.compile(r"(fingerprint|digest)", re.I)
+
+
+@register
+class FingerprintJsonSortKeys(Rule):
+    id = "GL202"
+    name = "fingerprint-json-keys"
+    rationale = (
+        "json.dumps inside a fingerprint/digest function must pass"
+        " sort_keys=True or dict insertion order leaks into the hash"
+    )
+
+    def applies(self, pf: ParsedFile) -> bool:
+        return _in_scope(pf) or pf.relpath.startswith("karpenter_core_tpu/")
+
+    def check(self, pf: ParsedFile):
+        for node in pf.walk(ast.Call):
+            if dotted_name(node.func) != "json.dumps":
+                continue
+            fn = pf.enclosing_function(node)
+            name = getattr(fn, "name", "") if fn is not None else ""
+            if not _FP_FN.search(name or ""):
+                continue
+            ok = any(
+                kw.arg == "sort_keys"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in node.keywords
+            )
+            if not ok:
+                yield self.finding(
+                    pf, node,
+                    f"json.dumps in fingerprint function {name!r} without"
+                    " sort_keys=True — dict insertion order leaks into"
+                    " the hash",
+                )

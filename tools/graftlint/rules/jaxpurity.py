@@ -1,0 +1,409 @@
+"""Family 1 — JAX purity/perf inside traced regions.
+
+The fused hot path (PR 3) is only fast because nothing inside the jit'd
+FFD scan touches the host: a stray ``.item()`` or ``np.asarray`` forces a
+device sync per scan step, and a Python ``if`` on a tracer either crashes
+at trace time or — worse — bakes one branch into the compiled program.
+These rules build the per-module traced-region call graph (jit roots +
+``lax.scan``/``fori_loop``/``while_loop``/``cond``/``vmap`` bodies, then
+everything reachable through plain-name calls) and police its interior.
+
+GL101 jit-host-sync        — host-sync calls inside a traced region
+GL102 jit-tracer-branch    — Python branching on (non-static) tracer values
+GL103 jit-state-no-donate  — jit entry points that carry slot-state
+                             without donate_argnums
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import ParsedFile, Rule, dotted_name, register
+
+_TRACED_HOFS = {
+    "jax.lax.scan": [0],
+    "lax.scan": [0],
+    "jax.lax.fori_loop": [2],
+    "lax.fori_loop": [2],
+    "jax.lax.while_loop": [0, 1],
+    "lax.while_loop": [0, 1],
+    "jax.lax.cond": [1, 2],
+    "lax.cond": [1, 2],
+    "jax.lax.switch": [1],
+    "lax.switch": [1],
+    "jax.vmap": [0],
+    "jax.checkpoint": [0],
+}
+
+_SYNC_ATTRS = {"item", "tolist"}
+_SYNC_CALLS = {"jax.device_get"}
+_NUMPY_SYNC_FUNCS = {"asarray", "array", "copy", "save", "savez"}
+_CAST_BUILTINS = {"float", "int", "bool"}
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return dotted_name(node) in ("jax.jit", "jit")
+
+
+def _partial_jit_kwargs(call: ast.Call) -> Optional[Dict[str, ast.AST]]:
+    """``partial(jax.jit, **kw)`` -> kw dict; None when not a jit partial."""
+    if dotted_name(call.func) not in ("partial", "functools.partial"):
+        return None
+    if not call.args or not _is_jax_jit(call.args[0]):
+        return None
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+
+def _static_names(kw: Dict[str, ast.AST]) -> Set[str]:
+    names: Set[str] = set()
+    v = kw.get("static_argnames")
+    if isinstance(v, ast.Constant) and isinstance(v.value, str):
+        names.add(v.value)
+    elif isinstance(v, (ast.Tuple, ast.List)):
+        for e in v.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                names.add(e.value)
+    return names
+
+
+class _ModuleIndex:
+    """Traced-region reachability for one module."""
+
+    def __init__(self, pf: ParsedFile):
+        self.pf = pf
+        # name -> EVERY def carrying it (module-level and nested): two
+        # same-named inner functions (the conventional `def body` of a
+        # lax.scan) must both be traced, not whichever parsed last — a
+        # conservative over-approximation that can only add coverage
+        self.defs: Dict[str, List[ast.AST]] = {}
+        for node in pf.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+            self.defs.setdefault(node.name, []).append(node)
+        self.jit_sites: List[Tuple[ast.AST, ast.AST, Dict[str, ast.AST]]] = []
+        roots: List[ast.AST] = []
+
+        for node in pf.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+            for dec in node.decorator_list:
+                if _is_jax_jit(dec):
+                    roots.append(node)
+                    self.jit_sites.append((dec, node, {}))
+                elif isinstance(dec, ast.Call):
+                    kw = _partial_jit_kwargs(dec)
+                    if kw is not None:
+                        roots.append(node)
+                        self.jit_sites.append((dec, node, kw))
+                    elif _is_jax_jit(dec.func):
+                        roots.append(node)
+                        kw2 = {k.arg: k.value for k in dec.keywords if k.arg}
+                        self.jit_sites.append((dec, node, kw2))
+
+        for call in pf.walk(ast.Call):
+            name = dotted_name(call.func)
+            # jax.jit(f, ...) / partial(jax.jit, ...)(f)
+            wrapped: Optional[ast.AST] = None
+            kw: Optional[Dict[str, ast.AST]] = None
+            if _is_jax_jit(call.func) and call.args:
+                wrapped = call.args[0]
+                kw = {k.arg: k.value for k in call.keywords if k.arg}
+            elif isinstance(call.func, ast.Call):
+                inner_kw = _partial_jit_kwargs(call.func)
+                if inner_kw is not None and call.args:
+                    wrapped = call.args[0]
+                    kw = inner_kw
+            if wrapped is not None:
+                for target in self._resolve(wrapped):
+                    roots.append(target)
+                    self.jit_sites.append((call, target, kw or {}))
+                continue
+            # traced higher-order functions: their body args are traced
+            argidx = _TRACED_HOFS.get(name)
+            if argidx:
+                for i in argidx:
+                    if i < len(call.args):
+                        roots.extend(self._resolve(call.args[i]))
+
+        # static names are tracked PER FUNCTION: a name marked static on
+        # one jit entry must not exempt a same-named non-static parameter
+        # of another traced function. Roots seed from their own
+        # static_argnames; a callee param becomes static when some call
+        # site feeds it a constant or a caller-static name (propagated to
+        # a fixpoint) — an under-approximation that favors missing a
+        # mixed-static param over false-flagging a genuinely static one.
+        self.static_by_fn: Dict[ast.AST, Set[str]] = {}
+        for _site, target, kw in self.jit_sites:
+            self.static_by_fn.setdefault(target, set()).update(
+                _static_names(kw)
+            )
+
+        self.traced: Set[ast.AST] = set()
+        frontier = list(roots)
+        while frontier:
+            fn = frontier.pop()
+            self.traced.add(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Lambda):
+                    self.traced.add(node)
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                ):
+                    continue
+                for callee in self.defs.get(node.func.id, ()):
+                    grew = self._propagate_statics(node, fn, callee)
+                    # re-enqueue on growth so statics reach transitive
+                    # callees; static sets only grow, so this terminates
+                    if callee not in self.traced or grew:
+                        frontier.append(callee)
+
+        self.numpy_aliases: Set[str] = set()
+        for node in pf.walk(ast.Import):
+            for alias in node.names:
+                if alias.name == "numpy":
+                    self.numpy_aliases.add(alias.asname or "numpy")
+
+    def _fn_statics(self, fn: Optional[ast.AST]) -> Set[str]:
+        """Static names visible inside fn — its own plus (for closures
+        like scan lambdas) every enclosing function's."""
+        out: Set[str] = set()
+        cur = fn
+        while cur is not None:
+            out |= self.static_by_fn.get(cur, set())
+            cur = self.pf.enclosing_function(cur)
+        return out
+
+    def _propagate_statics(self, call: ast.Call, caller, callee) -> bool:
+        """Mark callee params static when this call site feeds them a
+        constant or a caller-static name. Returns True when the set grew."""
+        caller_static = self._fn_statics(caller)
+
+        def is_static_arg(a: ast.AST) -> bool:
+            return isinstance(a, ast.Constant) or (
+                isinstance(a, ast.Name) and a.id in caller_static
+            )
+
+        params = _params(callee)
+        tgt = self.static_by_fn.setdefault(callee, set())
+        before = len(tgt)
+        for i, a in enumerate(call.args):
+            if i < len(params) and is_static_arg(a):
+                tgt.add(params[i])
+        for kwarg in call.keywords:
+            if kwarg.arg and kwarg.arg in params and is_static_arg(kwarg.value):
+                tgt.add(kwarg.arg)
+        return len(tgt) > before
+
+    def _resolve(self, node: ast.AST) -> List[ast.AST]:
+        """Defs a callable expression may denote (every same-named def)."""
+        if isinstance(node, ast.Name):
+            return list(self.defs.get(node.id, ()))
+        if isinstance(node, (ast.Lambda, ast.FunctionDef)):
+            return [node]
+        return []
+
+    def traced_body_nodes(self):
+        """(owner fn, node) pairs for every node inside a traced function,
+        skipping nodes that belong to a nested non-traced def."""
+        for fn in self.traced:
+            for node in ast.walk(fn):
+                owner = self._owner(node, fn)
+                if owner is fn:
+                    yield fn, node
+
+    def _owner(self, node: ast.AST, default):
+        """Innermost enclosing function of a node (default at module top)."""
+        cur = getattr(node, "_gl_parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return cur
+            cur = getattr(cur, "_gl_parent", None)
+        return default
+
+
+def _accel_file(pf: ParsedFile) -> bool:
+    return pf.relpath.endswith(".py") and (
+        "/ops/" in f"/{pf.relpath}" or "/models/" in f"/{pf.relpath}"
+    )
+
+
+_INDEX_CACHE: Dict[int, _ModuleIndex] = {}
+
+
+def _index(pf: ParsedFile) -> _ModuleIndex:
+    idx = _INDEX_CACHE.get(id(pf))
+    if idx is None:
+        idx = _INDEX_CACHE[id(pf)] = _ModuleIndex(pf)
+        if len(_INDEX_CACHE) > 512:
+            _INDEX_CACHE.clear()
+            _INDEX_CACHE[id(pf)] = idx
+    return idx
+
+
+def _params(fn) -> List[str]:
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        a = fn.args
+        return [
+            p.arg
+            for p in (a.posonlyargs + a.args + a.kwonlyargs)
+        ]
+    return []
+
+
+@register
+class JitHostSync(Rule):
+    id = "GL101"
+    name = "jit-host-sync"
+    rationale = (
+        "host syncs (.item/.tolist, numpy calls, jax.device_get, float/int"
+        " on tracers, print) inside a traced region serialize the device"
+        " pipeline per scan step"
+    )
+
+    def applies(self, pf: ParsedFile) -> bool:
+        return _accel_file(pf)
+
+    def check(self, pf: ParsedFile):
+        idx = _index(pf)
+        seen = set()
+        for fn, node in idx.traced_body_nodes():
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            name = dotted_name(node.func)
+            msg = None
+            if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_ATTRS:
+                msg = f".{node.func.attr}() forces a device->host sync"
+            elif name in _SYNC_CALLS:
+                msg = f"{name} forces a device->host transfer"
+            elif "." in name and name.split(".", 1)[0] in idx.numpy_aliases:
+                func = name.split(".", 1)[1]
+                if func in _NUMPY_SYNC_FUNCS:
+                    msg = f"{name} materializes the tracer on host"
+            elif name in _CAST_BUILTINS and node.args:
+                arg = node.args[0]
+                if not isinstance(arg, ast.Constant):
+                    msg = (
+                        f"{name}() on a traced value is a concretization"
+                        " (host sync / trace error)"
+                    )
+            elif name == "print":
+                msg = "print inside a traced region is a host callback"
+            if msg:
+                owner = getattr(fn, "name", "<lambda>")
+                yield self.finding(
+                    pf, node, f"{msg} (inside traced function {owner!r})"
+                )
+
+
+def _name_loads(node: ast.AST) -> Set[str]:
+    """Names loaded in an expression, excluding names that appear only as
+    the base of a static attribute (.shape/.ndim/.dtype/.size — those are
+    trace-time constants, branching on them is fine)."""
+    direct: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            parent = getattr(n, "_gl_parent", None)
+            if (
+                isinstance(parent, ast.Attribute)
+                and parent.attr in ("shape", "ndim", "dtype", "size")
+            ):
+                continue
+            direct.add(n.id)
+    return direct
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    if isinstance(test, ast.Compare) and len(test.ops) == 1:
+        if isinstance(test.ops[0], (ast.Is, ast.IsNot)):
+            return True
+    return False
+
+
+@register
+class JitTracerBranch(Rule):
+    id = "GL102"
+    name = "jit-tracer-branch"
+    rationale = (
+        "Python if/while/assert on tracer values inside a traced region"
+        " either crashes at trace time or silently bakes one branch into"
+        " the compiled program; use jnp.where/lax.cond"
+    )
+
+    def applies(self, pf: ParsedFile) -> bool:
+        return _accel_file(pf)
+
+    def check(self, pf: ParsedFile):
+        idx = _index(pf)
+        seen = set()
+        for fn, node in idx.traced_body_nodes():
+            if not isinstance(node, (ast.If, ast.While, ast.Assert, ast.IfExp)):
+                continue
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            test = node.test
+            if _is_none_check(test):
+                continue
+            params = set(_params(fn)) - idx._fn_statics(fn) - {"self"}
+            tainted = _name_loads(test) & params
+            if tainted:
+                kind = type(node).__name__.lower()
+                owner = getattr(fn, "name", "<lambda>")
+                yield self.finding(
+                    pf, node,
+                    f"python {kind} on parameter(s) {sorted(tainted)} of"
+                    f" traced function {owner!r} — branch on tracers with"
+                    " jnp.where/lax.cond, or mark the arg static",
+                )
+
+
+_STATEY_PARAMS = ("state",)
+_STATEY_ANNOTATIONS = ("SlotState",)
+
+
+def _carries_slot_state(fn) -> Optional[str]:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    for p in fn.args.posonlyargs + fn.args.args:
+        ann = ""
+        if p.annotation is not None:
+            ann = dotted_name(p.annotation) or (
+                p.annotation.value
+                if isinstance(p.annotation, ast.Constant)
+                and isinstance(p.annotation.value, str)
+                else ""
+            )
+        if p.arg in _STATEY_PARAMS or any(
+            a in str(ann) for a in _STATEY_ANNOTATIONS
+        ):
+            return p.arg
+    return None
+
+
+@register
+class JitStateNoDonate(Rule):
+    id = "GL103"
+    name = "jit-state-no-donate"
+    rationale = (
+        "a jit entry point that threads SlotState without donate_argnums"
+        " double-buffers the [N,K,V] requirement planes in HBM every call"
+        " (see ops/ffd.ffd_solve_donated)"
+    )
+
+    def applies(self, pf: ParsedFile) -> bool:
+        return _accel_file(pf)
+
+    def check(self, pf: ParsedFile):
+        idx = _index(pf)
+        for site, target, kw in idx.jit_sites:
+            if "donate_argnums" in kw or "donate_argnames" in kw:
+                continue
+            param = _carries_slot_state(target)
+            if param is None:
+                continue
+            tname = getattr(target, "name", "<fn>")
+            yield self.finding(
+                pf, site,
+                f"jax.jit of {tname!r} carries slot-state parameter"
+                f" {param!r} without donate_argnums — the carry planes"
+                " double-buffer in HBM; donate or justify why the caller"
+                " reuses the input state",
+            )

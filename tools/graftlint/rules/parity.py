@@ -1,0 +1,267 @@
+"""Family 4 — wire and registry parity.
+
+The solverd wire (solver/codec.py) is a pair of hand-written codecs; a
+field added on the encode side but not the decode side ships silently and
+drops on the floor (the ``unavailable_offerings`` near-miss PR 2 fixed by
+hand). Same shape for metrics: an instrument incremented at an emission
+site but never registered renders a phantom dashboard series. Both are
+exact set-equality properties over the AST — no heuristics.
+
+GL401 codec-field-parity — every encode_X/_encode_X in solver/codec.py
+                           has a decode twin, and the dict keys the
+                           encoder writes equal the keys the decoder reads
+GL402 metric-registered  — every ALL_CAPS instrument used via
+                           .inc/.observe/.set/.time resolves to a
+                           REGISTRY.counter/gauge/histogram definition
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.graftlint.engine import Finding, ParsedFile, Rule, dotted_name, register
+
+
+def _fn_defs(pf: ParsedFile) -> Dict[str, ast.FunctionDef]:
+    return {
+        node.name: node
+        for node in pf.walk(ast.FunctionDef)
+    }
+
+
+def _encode_keys(fn: ast.FunctionDef) -> Set[str]:
+    """String keys the encoder emits: dict-literal keys plus keyword args
+    of np.savez* calls (the npz member names)."""
+    keys: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+        elif isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name.endswith("savez") or name.endswith("savez_compressed"):
+                for kw in node.keywords:
+                    if kw.arg:
+                        keys.add(kw.arg)
+    return keys
+
+
+def _decode_keys(fn: ast.FunctionDef) -> Set[str]:
+    """String keys the decoder consumes: constant subscripts and .get()."""
+    keys: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Subscript):
+            s = node.slice
+            if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                keys.add(s.value)
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "get" and node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    keys.add(a.value)
+    return keys
+
+
+def _passthrough_names(fn: ast.FunctionDef) -> Set[str]:
+    """Names the decoder returns wholesale (``return h``) — every key of a
+    passthrough header counts as consumed downstream."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Name):
+            out.add(node.value.id)
+    return out
+
+
+def _header_names(fn: ast.FunctionDef) -> Set[str]:
+    """Local names bound from _json_header/json.loads — the decoded dict."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            name = dotted_name(node.value.func)
+            if name.endswith("_json_header") or name in ("json.loads",):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+    return out
+
+
+@register
+class CodecFieldParity(Rule):
+    id = "GL401"
+    name = "codec-field-parity"
+    rationale = (
+        "a wire field written by encode_X but never read by decode_X (or"
+        " vice versa) ships silently and drops on the floor — the"
+        " unavailable_offerings near-miss, machine-checked"
+    )
+    scope = "project"
+
+    def check_project(self, files: List[ParsedFile]):
+        for pf in files:
+            if not pf.relpath.endswith("solver/codec.py") and (
+                "graftlint_fixtures" not in pf.relpath
+                or "codec" not in pf.relpath
+            ):
+                continue
+            yield from self._check_codec(pf)
+
+    def _check_codec(self, pf: ParsedFile):
+        defs = _fn_defs(pf)
+        pairs = []
+        for name, fn in sorted(defs.items()):
+            stripped = name.lstrip("_")
+            if not stripped.startswith("encode_"):
+                continue
+            twin = name.replace("encode_", "decode_", 1)
+            if twin not in defs:
+                yield self.finding(
+                    pf, fn,
+                    f"{name} has no {twin} twin — a one-sided wire codec",
+                )
+                continue
+            pairs.append((fn, defs[twin]))
+        for name, fn in sorted(defs.items()):
+            stripped = name.lstrip("_")
+            if stripped.startswith("decode_"):
+                twin = name.replace("decode_", "encode_", 1)
+                if twin not in defs:
+                    yield self.finding(
+                        pf, fn,
+                        f"{name} has no {twin} twin — a one-sided wire codec",
+                    )
+        for enc, dec in pairs:
+            ekeys = _encode_keys(enc)
+            dkeys = _decode_keys(dec)
+            if not ekeys and not dkeys:
+                continue
+            passthrough = _passthrough_names(dec) & _header_names(dec)
+            missing_in_decode = sorted(ekeys - dkeys) if not passthrough else []
+            missing_in_encode = sorted(dkeys - ekeys)
+            if missing_in_decode:
+                yield self.finding(
+                    pf, dec,
+                    f"{dec.name} never reads wire field(s)"
+                    f" {missing_in_decode} that {enc.name} writes —"
+                    " the field drops on the floor",
+                )
+            if missing_in_encode:
+                yield self.finding(
+                    pf, enc,
+                    f"{enc.name} never writes wire field(s)"
+                    f" {missing_in_encode} that {dec.name} reads —"
+                    " decode sees an absent key",
+                )
+
+
+_EMIT_METHODS = {"inc", "observe", "set", "time"}
+_DEF_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+def collect_defined_instruments(
+    files: List[ParsedFile],
+) -> Dict[str, List[str]]:
+    """instrument variable name -> EVERY metric string bound to it, from
+    ``NAME = REGISTRY.counter|gauge|histogram("metric", ...)`` bindings.
+    All definitions are kept (no last-wins overwrite) so the metrics audit
+    can see a metric string registered twice under a shared variable name.
+    Known limitation: resolution is by bare variable name across the whole
+    scanned set, not per-module import graph."""
+    defined: Dict[str, List[str]] = {}
+    for pf in files:
+        for node in pf.walk(ast.Assign):
+            if not isinstance(node.value, ast.Call):
+                continue
+            func = node.value.func
+            if not (
+                isinstance(func, ast.Attribute)
+                and func.attr in _DEF_FACTORIES
+                and dotted_name(func.value).endswith("REGISTRY")
+            ):
+                continue
+            metric = ""
+            if node.value.args and isinstance(node.value.args[0], ast.Constant):
+                metric = str(node.value.args[0].value)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    defined.setdefault(tgt.id, []).append(metric)
+    return defined
+
+
+def collect_used_instruments(
+    files: List[ParsedFile],
+) -> Dict[str, List[Finding]]:
+    """instrument variable name -> usage sites (as GL402 findings)."""
+    used: Dict[str, List[Finding]] = {}
+    for pf in files:
+        if pf.relpath.endswith("metrics/registry.py"):
+            continue  # the instrument classes themselves
+        for node in pf.walk(ast.Call):
+            func = node.func
+            if not (
+                isinstance(func, ast.Attribute)
+                and func.attr in _EMIT_METHODS
+            ):
+                continue
+            base = func.value
+            name: Optional[str] = None
+            if isinstance(base, ast.Attribute) and base.attr.isupper():
+                name = base.attr
+            elif isinstance(base, ast.Name) and base.id.isupper():
+                name = base.id
+            if name is None:
+                continue
+            used.setdefault(name, []).append(Finding(
+                "GL402", pf.relpath, node.lineno,
+                f"instrument {name} emitted via .{func.attr}() but never"
+                " registered with REGISTRY.counter/gauge/histogram —"
+                " a phantom dashboard series",
+            ))
+    return used
+
+
+_PKG_DEFS: Optional[Dict[str, List[str]]] = None
+
+
+def _package_definitions() -> Dict[str, List[str]]:
+    """Tree-wide instrument definitions, parsed once per process — the
+    GL402 fallback for partial-path runs that don't scan wiring.py."""
+    global _PKG_DEFS
+    if _PKG_DEFS is None:
+        from tools.graftlint.engine import REPO_ROOT, _collect_files
+
+        pkg = REPO_ROOT / "karpenter_core_tpu"
+        _PKG_DEFS = (
+            collect_defined_instruments(_collect_files([str(pkg)]))
+            if pkg.is_dir()
+            else {}
+        )
+    return _PKG_DEFS
+
+
+@register
+class MetricRegistered(Rule):
+    id = "GL402"
+    name = "metric-registered"
+    rationale = (
+        "an instrument incremented in source but absent from the registry"
+        " renders a dashboard series that never exists"
+    )
+    scope = "project"
+
+    def check_project(self, files: List[ParsedFile]):
+        defined = collect_defined_instruments(files)
+        # partial-path runs (`python -m tools.graftlint karpenter_core_tpu/
+        # solver`) must still see definitions living outside the scanned
+        # subtree (metrics/wiring.py), or every emission site there reads
+        # as a phantom series
+        if not any(
+            f.relpath.endswith("metrics/wiring.py") for f in files
+        ):
+            for name, metrics in _package_definitions().items():
+                defined.setdefault(name, []).extend(metrics)
+        used = collect_used_instruments(files)
+        for name in sorted(used):
+            if name in defined:
+                continue
+            yield from used[name]
