@@ -10,6 +10,11 @@ Secondary lines (reported in `detail`):
   cfg4_consol     MultiNodeConsolidation sweep: 2k-node cluster, the
                   100-candidate cap evaluated as ONE vmapped device call
                   (vs log2(100) full host simulations upstream)
+  cfg7_fleet      8 tenants hammering ONE sidecar through the fleet
+                  gateway: per-tenant queue-wait p50/p99 solo vs
+                  concurrent, shed rate + greedy-fallback parity, cache
+                  evictions under a deliberately undersized bound, and
+                  aggregate pods/sec across the fleet
 
   cfg3_topology   the reference's diverse benchmark mix (1/6 each generic,
                   zonal, selector, zone-spread, hostname-spread, hostname
@@ -559,6 +564,175 @@ def _sidecar_bench(n_pods=5000, n_types=400, repeats=5):
     }
 
 
+def _fleet_bench(n_tenants=8, n_pods=1000, n_types=200, repeats=3):
+    """cfg7_fleet: N synthetic tenants hammering ONE sidecar through the
+    fleet gateway (solver/fleet.py). Every tenant owns a distinct problem
+    fingerprint (tenant-named pool; identical catalog shapes so the jit
+    cache is shared and only ONE compile cliff is paid) and the scheduler
+    cache is deliberately smaller than the tenant count, so the
+    heterogeneous mix churns it — the eviction counter must move.
+
+    Phases: (1) solo — each tenant alone, for its baseline queue-wait and
+    e2e percentiles; (2) concurrent — all tenants hammer at once through
+    their own RemoteSchedulers with a queue bound low enough that bursts
+    shed (the shed requests degrade to the client's greedy path, counted
+    as fallbacks); (3) a forced-shed parity probe — one solve against a
+    saturated gateway must produce node-for-node the greedy oracle's
+    placement.
+
+    ``fairness_ok`` is the no-starvation bound: no tenant's concurrent
+    p99 queue wait exceeds 3x its fair-share round latency (n_tenants x
+    the observed p50 device time) — a starved tenant blows that by an
+    order of magnitude, a fair queue sits under it."""
+    import copy
+    import threading
+
+    from karpenter_core_tpu.cloudprovider.kwok import bench_catalog
+    from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+        Scheduler,
+    )
+    from karpenter_core_tpu.metrics import wiring as m
+    from karpenter_core_tpu.solver import fleet, remote, service
+
+    catalog = bench_catalog(n_types)
+    tenants = [f"tenant{i}" for i in range(n_tenants)]
+    problems = {}
+    for i, tenant in enumerate(tenants):
+        # the pod mix drifts per tenant (pods are fingerprint-exempt, but
+        # the distinct pool name makes each tenant its own problem half)
+        problems[tenant] = {
+            "pools": [_pool(tenant)],
+            "its": {tenant: list(catalog)},
+            "pods": _plain_pods(n_pods, shapes=(8 + i % 3, 6)),
+        }
+
+    gateway = fleet.FleetGateway(max_depth=max(n_tenants - 2, 2))
+    cache = fleet.BoundedSchedulerCache(max_entries=max(n_tenants // 2, 2))
+    daemon = service.SolverDaemon(gateway=gateway, sched_cache=cache)
+    srv = service.serve(0, daemon=daemon)
+    try:
+        addr = f"127.0.0.1:{srv.server_address[1]}"
+
+        def scheduler_for(tenant):
+            p = problems[tenant]
+            client = remote.SolverClient(addr, timeout=600, tenant=tenant)
+            return remote.RemoteScheduler(
+                client, p["pools"], p["its"],
+                device_scheduler_opts={"max_slots": 1024},
+            )
+
+        # -- solo baselines (also the shared compile warm-up) -------------
+        solo = {}
+        for tenant in tenants:
+            rs = scheduler_for(tenant)
+            rs.solve(problems[tenant]["pods"])  # warm
+            times = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                res = rs.solve(problems[tenant]["pods"])
+                times.append(time.perf_counter() - t0)
+            assert res.all_pods_scheduled()
+            solo[tenant] = {
+                "e2e": _spread(times), "nodes": res.node_count(),
+            }
+        solo_waits = gateway.snapshot(reset=True)["tenants"]
+
+        # -- concurrent hammer --------------------------------------------
+        fallbacks_before = m.SOLVER_RPC_FALLBACKS.value(
+            {"endpoint": "solve"}
+        )
+        conc_times = {tenant: [] for tenant in tenants}
+        errors = []
+
+        def hammer(tenant):
+            try:
+                rs = scheduler_for(tenant)
+                for _ in range(repeats):
+                    t0 = time.perf_counter()
+                    res = rs.solve(problems[tenant]["pods"])
+                    conc_times[tenant].append(time.perf_counter() - t0)
+                    assert res.all_pods_scheduled()
+            except Exception as e:  # surfaced after join
+                errors.append((tenant, repr(e)))
+
+        threads = [
+            threading.Thread(target=hammer, args=(t,), daemon=True)
+            for t in tenants
+        ]
+        wall0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - wall0
+        assert not errors, errors
+        snap = gateway.snapshot()
+        shed_total = sum(snap["sheds"].values())
+        fallbacks = m.SOLVER_RPC_FALLBACKS.value(
+            {"endpoint": "solve"}
+        ) - fallbacks_before
+
+        # -- forced-shed parity probe -------------------------------------
+        parked = [
+            gateway.submit("parked", fleet.LANE_SOLVE)
+            for _ in range(gateway.max_depth - gateway.depth())
+        ]
+        probe = problems[tenants[0]]
+        rs = scheduler_for(tenants[0])
+        shed_res = rs.solve(probe["pods"])  # 429 -> client greedy path
+        for ticket in parked:
+            gateway.abandon(ticket)
+        greedy = Scheduler(
+            copy.deepcopy(probe["pools"]),
+            {tenants[0]: list(catalog)},
+        ).solve(copy.deepcopy(probe["pods"]))
+        parity_ok = (
+            shed_res.all_pods_scheduled()
+            and shed_res.node_count() == greedy.node_count()
+        )
+
+        fair_bound = 3.0 * n_tenants * snap["device_p50_s"]
+        per_tenant = {}
+        for tenant in tenants:
+            waits = snap["tenants"].get(tenant, {})
+            per_tenant[tenant] = {
+                "solo_wait_p99_s": solo_waits.get(tenant, {}).get(
+                    "wait_p99_s", 0.0
+                ),
+                "wait_p50_s": waits.get("wait_p50_s", 0.0),
+                "wait_p99_s": waits.get("wait_p99_s", 0.0),
+                "solo_p50_e2e_s": solo[tenant]["e2e"]["p50_solve_s"],
+                "p50_e2e_s": round(
+                    sorted(conc_times[tenant])[len(conc_times[tenant]) // 2],
+                    3,
+                ) if conc_times[tenant] else None,
+                "nodes": solo[tenant]["nodes"],
+            }
+        return {
+            "tenants": n_tenants,
+            "pods_per_tenant": n_pods,
+            "aggregate_pods_per_sec": round(
+                sum(len(ts) for ts in conc_times.values()) * n_pods / wall, 1
+            ),
+            "device_p50_s": snap["device_p50_s"],
+            "shed_total": shed_total,
+            "sheds_by_reason": snap["sheds"],
+            "greedy_fallbacks": fallbacks,
+            "cache_evictions": dict(cache.evictions),
+            "cache_entries": len(cache),
+            "cache_entry_bound": cache.max_entries,
+            "shed_parity_ok": parity_ok,
+            "fair_bound_s": round(fair_bound, 3),
+            "fairness_ok": all(
+                pt["wait_p99_s"] <= fair_bound for pt in per_tenant.values()
+            ),
+            "per_tenant": per_tenant,
+        }
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
 def _restart_probe() -> None:
     """Child mode: a FRESH process (persistent compile cache on disk warm
     from the parent's solves) boots a DeviceScheduler, pre-warms the shape
@@ -672,6 +846,7 @@ def main():
         detail["cfg4_consol"] = _consolidation_bench()
         detail["cfg5_sidecar"] = _sidecar_bench()
         detail["cfg6_ice_storm"] = _ice_storm_bench()
+        detail["cfg7_fleet"] = _fleet_bench()
         detail["restart"] = _run_restart_probe()
 
     pods_per_sec = primary["pods_per_sec"]

@@ -175,7 +175,9 @@ SOLVER_RPC_PHASE_DURATION = REGISTRY.histogram(
 SOLVER_RPC_FAILURES = REGISTRY.counter(
     "solver_rpc_failures_total",
     "Sidecar RPCs abandoned after retries, by cause "
-    "(timeout|error|circuit_open|injected|decode)",
+    "(timeout|error|circuit_open|injected|decode|shed — shed is the"
+    " gateway's 429 admission rejection, degraded without retries once"
+    " Retry-After exceeds the solve budget)",
 )
 SOLVER_RPC_RETRIES = REGISTRY.counter(
     "solver_rpc_retries_total",
@@ -188,7 +190,9 @@ SOLVER_RPC_FALLBACKS = REGISTRY.counter(
 )
 SOLVER_CIRCUIT_STATE = REGISTRY.gauge(
     "solver_circuit_breaker_state",
-    "Sidecar circuit breaker: 0 closed, 1 half-open, 2 open",
+    "Sidecar circuit breaker: 0 closed, 1 half-open, 2 open — labeled by"
+    " tenant so fleet dashboards see WHICH operators are degraded to"
+    " greedy, not just that someone is",
 )
 SOLVERD_SCHED_CACHE = REGISTRY.counter(
     "solverd_scheduler_cache_total",
@@ -198,4 +202,44 @@ SOLVERD_SCHED_CACHE = REGISTRY.counter(
 SOLVER_SIDECAR_RESTARTS = REGISTRY.counter(
     "solver_sidecar_restarts_total",
     "Sidecar processes respawned by the supervisor",
+)
+
+# -- fleetd: the multi-tenant solve gateway (solver/fleet.py) --------------
+
+SOLVERD_QUEUE_DEPTH = REGISTRY.gauge(
+    "solverd_admission_queue_depth",
+    "Requests admitted and not yet finished (queued + host phase + on"
+    " device); at the configured bound the gateway sheds with 429 and"
+    " /healthz flips ready:false (overloaded, NOT dead)",
+)
+SOLVERD_QUEUE_WAIT = REGISTRY.histogram(
+    "solverd_queue_wait_seconds",
+    "Per-request wait from host-phase ready to device grant, by tenant —"
+    " the cross-tenant contention signal the fair queue bounds",
+)
+SOLVERD_SHED = REGISTRY.counter(
+    "solverd_admission_shed_total",
+    "Requests rejected by admission control, by tenant and reason"
+    " (capacity|deadline|expired); every shed degrades that solve to the"
+    " client's host greedy path, never to a stall",
+)
+SOLVERD_TENANT_SOLVES = REGISTRY.counter(
+    "solverd_tenant_solves_total",
+    "Requests served to completion, by tenant and endpoint"
+    " (solve|consolidate) — the fleet's per-operator traffic ledger",
+)
+SOLVERD_SCHED_CACHE_EVICTIONS = REGISTRY.counter(
+    "solverd_scheduler_cache_evictions_total",
+    "DeviceScheduler cache entries dropped at the LRU bound, by reason"
+    " (entries|bytes) — sustained evictions mean the fleet's problem mix"
+    " outgrew the cache budget (expect re-prepare cost on every solve)",
+)
+SOLVERD_SCHED_CACHE_ENTRIES = REGISTRY.gauge(
+    "solverd_scheduler_cache_entries",
+    "DeviceScheduler cache entries currently resident",
+)
+SOLVERD_SCHED_CACHE_BYTES = REGISTRY.gauge(
+    "solverd_scheduler_cache_bytes",
+    "Approximate bytes pinned by cached DeviceSchedulers (encoded-request"
+    " size proxy per entry, never exceeds the configured bound)",
 )

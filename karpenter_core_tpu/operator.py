@@ -78,6 +78,15 @@ class Options:
     solver_mode: str = "inproc"  # inproc | sidecar
     solver_addr: str = ""
     solver_timeout: float = 30.0  # per-RPC deadline, seconds
+    # fleet tenancy (solver/fleet.py): this operator's identity at a SHARED
+    # sidecar — rides every RPC (wire field + X-Solver-Tenant header) for
+    # fair queueing / per-tenant accounting, and labels the circuit gauge
+    solver_tenant: str = "default"
+    # gateway sizing, passed through to a SPAWNED sidecar (an external
+    # --solver-addr sidecar configures its own): admission bound before
+    # 429 sheds, and 'tenant=weight,...' fair-share weights
+    solver_queue_depth: int = 16
+    solver_tenant_weights: str = ""
     batch_max_duration: float = 10.0
     batch_idle_duration: float = 1.0
     log_level: str = "info"
@@ -101,6 +110,17 @@ class Options:
         "solver_addr": ("--solver-addr", "KARPENTER_SOLVER_ADDR", str),
         "solver_timeout": (
             "--solver-timeout", "KARPENTER_SOLVER_TIMEOUT", float,
+        ),
+        "solver_tenant": (
+            "--solver-tenant", "KARPENTER_SOLVER_TENANT", str,
+        ),
+        "solver_queue_depth": (
+            "--solver-queue-depth", "KARPENTER_SOLVER_QUEUE_DEPTH", int,
+        ),
+        "solver_tenant_weights": (
+            "--solver-tenant-weights",
+            "KARPENTER_SOLVER_TENANT_WEIGHTS",
+            str,
         ),
         "batch_max_duration": (
             "--batch-max-duration", "KARPENTER_BATCH_MAX_DURATION", float,
@@ -156,13 +176,21 @@ class Options:
         # non-positive durations silently wedge the loop (a zero RPC
         # deadline fails every solve; a zero poll interval busy-spins) —
         # reject them at the flag surface, not deep in a controller
-        for attr in ("solver_timeout", "batch_max_duration", "poll_interval"):
+        for attr in ("solver_timeout", "batch_max_duration", "poll_interval",
+                     "solver_queue_depth"):
             value = getattr(opts, attr)
             if value <= 0:
                 flag = cls._FLAGS[attr][0]
                 raise ValueError(
                     f"{flag} must be positive, got {value}"
                 )
+        if not opts.solver_tenant:
+            raise ValueError("--solver-tenant must be non-empty")
+        # malformed weights must fail at the flag surface, not inside a
+        # respawned sidecar's argparse three failures deep
+        from karpenter_core_tpu.solver.fleet import parse_tenant_weights
+
+        parse_tenant_weights(opts.solver_tenant_weights)
         if opts.solver not in ("greedy", "tpu"):
             raise ValueError(f"unknown solver {opts.solver!r}")
         if opts.solver_mode not in ("inproc", "sidecar"):
@@ -245,12 +273,18 @@ class Operator:
                     # through: TPU-side traces become grabbable from the
                     # running child without a redeploy
                     profile_dir=self.options.profile_dir,
+                    # fleet-gateway sizing for the child (an external
+                    # --solver-addr sidecar configures its own)
+                    queue_depth=self.options.solver_queue_depth,
+                    tenant_weights=self.options.solver_tenant_weights,
                 )
                 addr = self.solver_supervisor.start()
             self.solver_client = SolverClient(
                 addr,
                 timeout=self.options.solver_timeout,
                 on_state_change=self._publish_circuit_event,
+                # this operator's identity at a (possibly shared) sidecar
+                tenant=self.options.solver_tenant,
             )
         self.provisioner = Provisioner(
             self.kube,

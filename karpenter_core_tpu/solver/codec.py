@@ -383,11 +383,17 @@ def encode_solve_request(
     topology=None,
     max_slots: int = 256,
     unavailable_offerings=(),
+    tenant: str = "default",
 ) -> bytes:
     """Serialize a full scheduler input for the solverd sidecar.
     ``unavailable_offerings`` is the control plane's ICE-cache snapshot
     (instance-type×zone×capacity-type triples); it rides the wire so the
-    sidecar's DeviceScheduler masks the same offerings the client would."""
+    sidecar's DeviceScheduler masks the same offerings the client would.
+    ``tenant`` identifies the sending operator to the fleet gateway
+    (solver/fleet.py) for fair queueing and per-tenant accounting; it
+    defaults to the single-tenant id so a pre-fleet client stays valid on
+    the same wire version (an old sidecar ignoring it loses only
+    accounting, never placements — unlike the load-bearing ICE mask)."""
     from karpenter_core_tpu.kube import serial
 
     table, pools = _encode_it_table(instance_types)
@@ -422,6 +428,7 @@ def encode_solve_request(
         "unavailable_offerings": sorted(
             list(k) for k in unavailable_offerings
         ),
+        "tenant": tenant,
     }
     return _json_payload(header)
 
@@ -440,7 +447,12 @@ def problem_fingerprint(header: dict) -> str:
     # graftlint: disable=GL201 -- json.dumps(sort_keys=True) below
     # canonicalizes every dict key recursively; build order never reaches
     # the hash (only LIST order would, and no list is built here)
-    probe = {k: v for k, v in header.items() if k != "pods"}
+    #
+    # the tenant is routing metadata, not problem content: two operators
+    # watching identical clusters (an HA pair, a blue/green pair) describe
+    # the same problem and may share one cached DeviceScheduler — the
+    # cache is content-addressed, isolation is the gateway's job
+    probe = {k: v for k, v in header.items() if k not in ("pods", "tenant")}
     # the topology context's excluded-uid list is derived from the PENDING
     # pods (provisioner excludes them from existing counts), so it belongs
     # to the pod half: hashing it would churn the scheduler cache on every
@@ -477,6 +489,8 @@ def decode_solve_request(data: bytes) -> dict:
         "unavailable_offerings": frozenset(
             OfferingKey(*k) for k in h.get("unavailable_offerings", [])
         ),
+        # absent from a pre-fleet encoder -> the single-tenant id
+        "tenant": h.get("tenant", "default"),
     }
 
 
@@ -531,9 +545,12 @@ def encode_frontier_request(
     base_pods,
     candidate_pods,
     max_slots: int = 1024,
+    tenant: str = "default",
 ) -> bytes:
     """Serialize a consolidation-frontier sweep (models/consolidation.py)
-    for the sidecar: candidate nodes FIRST (prefix p masks slots [0, p))."""
+    for the sidecar: candidate nodes FIRST (prefix p masks slots [0, p)).
+    ``tenant`` as in encode_solve_request — gateway accounting only; the
+    sweep rides the gateway's NORMAL lane, behind provisioning solves."""
     from karpenter_core_tpu.kube import serial
 
     table, pools = _encode_it_table(instance_types)
@@ -550,6 +567,7 @@ def encode_frontier_request(
             [serial.encode(p) for p in pods] for pods in candidate_pods
         ],
         "max_slots": max_slots,
+        "tenant": tenant,
     }
     return _json_payload(header)
 
@@ -571,6 +589,7 @@ def decode_frontier_request(data: bytes) -> dict:
             [serial.decode(d) for d in pods] for pods in h["candidate_pods"]
         ],
         "max_slots": h["max_slots"],
+        "tenant": h.get("tenant", "default"),
     }
 
 

@@ -1,0 +1,529 @@
+"""fleetd: the multi-tenant solve gateway inside solverd.
+
+One solverd used to serve exactly one operator: every request serialized
+on a single FIFO lock, with no admission control and an unbounded
+per-fingerprint scheduler cache. This module is the gateway that turns
+the sidecar into a shared service for N operators (CvxCluster's "one fast
+centralized allocator, many granular problems"; Tesserae's placement
+serving that stays fair under many concurrent tenants):
+
+* ``FleetGateway`` — a bounded admission queue with deadline-aware
+  shedding (a request whose remaining client deadline cannot cover the
+  observed p50 device time is rejected immediately, and the HTTP layer
+  turns that into ``429 + Retry-After`` so solver/remote.py degrades the
+  solve to the host greedy path), weighted fair scheduling across
+  tenants, and a priority lane (provisioning solves dispatch ahead of
+  consolidation sweeps) so one chatty or hung tenant cannot starve the
+  rest;
+* the host/device pipeline split — a request owns the device only
+  between ``await_grant`` and ``release``; its host phases (codec
+  decode before, codec encode after) run on its own handler thread, so
+  the encode/decode of request B overlaps the device phase of request A;
+* ``BoundedSchedulerCache`` — an LRU bound (entries + approximate
+  bytes) with eviction metrics on the per-fingerprint DeviceScheduler
+  cache, so a fleet of heterogeneous clusters cannot OOM the sidecar.
+
+The gateway never creates threads: it sequences the caller's own handler
+threads (ThreadingHTTPServer hands every request its own thread) with one
+re-entrant lock and per-ticket events. All shared state is mutated under
+``self._lock`` — including inside the ``_locked``-suffixed helpers, which
+re-enter the RLock so the discipline is syntactically visible to
+graftlint's GL302/GL303 and not an unstated caller contract.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional
+
+DEFAULT_TENANT = "default"
+
+# the priority lane: provisioning solves ahead of consolidation sweeps —
+# pending pods are unschedulable RIGHT NOW, a consolidation sweep is an
+# optimization that can wait one grant
+LANE_SOLVE = "solve"
+LANE_SWEEP = "sweep"
+_LANES = (LANE_SOLVE, LANE_SWEEP)
+
+# admission defaults (service flags / operator passthrough override)
+DEFAULT_QUEUE_DEPTH = 16
+DEFAULT_CACHE_ENTRIES = 4
+DEFAULT_CACHE_BYTES = 256 << 20
+# distinct tenants the gateway keeps state for (vtime, wait samples): the
+# id is client-supplied, so on a long-lived shared sidecar a client that
+# varies it (a template interpolating a run id) must hit a bound, not a
+# slow leak — idle tenants past the cap are forgotten and simply rejoin
+# at the virtual clock like any idle tenant
+TENANT_STATE_CAP = 1024
+# device-time prior before any observation exists (a fresh sidecar must
+# not shed its very first requests on a made-up estimate of infinity)
+DEVICE_P50_BOOT = 0.5
+
+
+class ShedError(Exception):
+    """A request rejected by admission control (never by a fault).
+
+    ``reason``: ``capacity`` (queue full), ``deadline`` (the remaining
+    client deadline cannot cover the estimated queue wait + p50 device
+    time), ``expired`` (the deadline lapsed while queued). ``retry_after``
+    is the server's estimate, in seconds, of when a retry would be
+    admitted — the HTTP layer ships it as the ``Retry-After`` header.
+    """
+
+    def __init__(self, reason: str, retry_after: float, message: str = ""):
+        super().__init__(message or f"shed ({reason})")
+        self.reason = reason
+        self.retry_after = retry_after
+
+
+def parse_tenant_weights(spec: str) -> Dict[str, float]:
+    """``"a=3,b=1.5"`` -> ``{"a": 3.0, "b": 1.5}`` (the --tenant-weights
+    flag format). Unlisted tenants get the gateway's default weight."""
+    out: Dict[str, float] = {}
+    for part in filter(None, (p.strip() for p in (spec or "").split(","))):
+        name, _, value = part.partition("=")
+        if not name or not value:
+            raise ValueError(f"malformed tenant weight {part!r}")
+        weight = float(value)
+        if weight <= 0:
+            raise ValueError(f"tenant weight must be positive: {part!r}")
+        out[name] = weight
+    return out
+
+
+class Ticket:
+    """One admitted request's pass through the gateway."""
+
+    __slots__ = (
+        "tenant", "lane", "submitted_at", "deadline_at",
+        "ready_at", "granted_at", "event", "state",
+    )
+
+    def __init__(self, tenant: str, lane: str, submitted_at: float,
+                 deadline_at: Optional[float]):
+        self.tenant = tenant
+        self.lane = lane
+        self.submitted_at = submitted_at
+        self.deadline_at = deadline_at
+        self.ready_at: Optional[float] = None
+        self.granted_at: Optional[float] = None
+        self.event = threading.Event()
+        self.state = "pending"  # pending | queued | granted | shed | done
+
+
+class FleetGateway:
+    """Admission control + weighted fair device scheduling for N tenants.
+
+    Life of a request (one handler thread end to end)::
+
+        ticket = gateway.submit(tenant, lane, deadline)   # may shed
+        problem = decode(body)            # host phase, device NOT held
+        gateway.await_grant(ticket)       # fair-queued; may shed (expired)
+        ...device solve...                # the ONLY exclusive section
+        gateway.release(ticket, device_seconds)
+        response = encode(results)        # host phase, device NOT held
+
+    Fairness is virtual-time weighted fair queueing: each tenant
+    accumulates ``device_seconds / weight`` per grant, and the dispatcher
+    always grants the backlogged tenant with the smallest virtual time —
+    so a tenant hammering the gateway advances its own clock and cannot
+    starve a quiet one, while a weight-3 tenant gets ~3x the device share
+    of a weight-1 tenant under contention. A tenant returning from idle
+    is bumped to the current virtual clock so it cannot claim the device
+    for its entire idle period retroactively.
+    """
+
+    def __init__(
+        self,
+        max_depth: int = DEFAULT_QUEUE_DEPTH,
+        weights: Optional[Dict[str, float]] = None,
+        default_weight: float = 1.0,
+        p50_boot: float = DEVICE_P50_BOOT,
+        window: int = 64,
+        time_fn=time.monotonic,
+    ):
+        if max_depth <= 0:
+            raise ValueError(f"max_depth must be positive, got {max_depth}")
+        self.max_depth = max_depth
+        self.weights = dict(weights or {})
+        self.default_weight = default_weight
+        self.time_fn = time_fn
+        # RLock on purpose: the _locked helpers re-acquire it so every
+        # shared-state write is syntactically inside a `with self._lock`
+        self._lock = threading.RLock()
+        self._device_times: deque = deque(maxlen=window)
+        self._p50_boot = p50_boot
+        # submitted and not yet finished (queued + decoding + on device)
+        self._pending = 0
+        # tenant -> lane -> FIFO of ready tickets
+        self._queued: Dict[str, Dict[str, deque]] = {}
+        self._vtime: Dict[str, float] = {}
+        self._vclock = 0.0
+        self._active: Optional[Ticket] = None
+        # bench/test observability (the REGISTRY instruments aggregate
+        # process-wide; these are per-gateway and resettable)
+        self._wait_samples: Dict[str, deque] = {}
+        self._shed_counts: Dict[str, int] = {}
+        self._grant_count = 0
+
+    # -- admission ---------------------------------------------------------
+
+    def device_p50(self) -> float:
+        with self._lock:
+            return self._device_p50_locked()
+
+    def _device_p50_locked(self) -> float:
+        if not self._device_times:
+            return self._p50_boot
+        ts = sorted(self._device_times)
+        return ts[len(ts) // 2]
+
+    def submit(
+        self,
+        tenant: str = DEFAULT_TENANT,
+        lane: str = LANE_SOLVE,
+        deadline: Optional[float] = None,
+    ) -> Ticket:
+        """Admission decision, made BEFORE the request body is decoded (a
+        shed must cost the sidecar nothing). Raises ShedError, or returns
+        a Ticket the caller must resolve via await_grant+release (or
+        abandon on a pre-grant failure)."""
+        if lane not in _LANES:
+            raise ValueError(f"unknown lane {lane!r}")
+        with self._lock:
+            now = self.time_fn()
+            p50 = self._device_p50_locked()
+            if self._pending >= self.max_depth:
+                # one slot frees roughly every p50 device seconds; the
+                # whole backlog must drain before a retry is admitted
+                retry_after = max(self._pending * p50, p50)
+                self._count_shed_locked(tenant, "capacity")
+                raise ShedError(
+                    "capacity", retry_after,
+                    f"admission queue full ({self._pending}/{self.max_depth})",
+                )
+            if deadline is not None:
+                # everyone already admitted holds the device ~p50 each,
+                # then this request needs its own p50 on device
+                estimate = (self._pending + 1) * p50
+                if deadline < estimate:
+                    retry_after = max(estimate - deadline, p50)
+                    self._count_shed_locked(tenant, "deadline")
+                    raise ShedError(
+                        "deadline", retry_after,
+                        f"deadline {deadline:.3f}s cannot cover estimated"
+                        f" {estimate:.3f}s (p50 device {p50:.3f}s,"
+                        f" {self._pending} ahead)",
+                    )
+            self._pending += 1
+            ticket = Ticket(
+                tenant, lane, now,
+                None if deadline is None else now + deadline,
+            )
+            self._export_depth_locked()
+            return ticket
+
+    def _count_shed_locked(self, tenant: str, reason: str) -> None:
+        from karpenter_core_tpu.metrics import wiring as m
+
+        with self._lock:
+            self._shed_counts[reason] = self._shed_counts.get(reason, 0) + 1
+        m.SOLVERD_SHED.inc({"tenant": tenant, "reason": reason})
+
+    # -- fair queueing -----------------------------------------------------
+
+    def await_grant(self, ticket: Ticket) -> None:
+        """Block the calling handler thread until the fair scheduler hands
+        this ticket the device. Raises ShedError if the ticket's deadline
+        expired while it queued (the client has already degraded to
+        greedy; running the solve anyway would burn device time on an
+        answer nobody reads)."""
+        with self._lock:
+            ticket.ready_at = self.time_fn()
+            ticket.state = "queued"
+            lanes = self._queued.get(ticket.tenant)
+            if lanes is None:
+                lanes = self._queued[ticket.tenant] = {
+                    lane: deque() for lane in _LANES
+                }
+            if not any(lanes[lane] for lane in _LANES):
+                # returning from idle: jump to the current virtual clock —
+                # an idle period is not a credit voucher
+                self._vtime[ticket.tenant] = max(
+                    self._vtime.get(ticket.tenant, 0.0), self._vclock
+                )
+            lanes[ticket.lane].append(ticket)
+            self._dispatch_locked()
+        ticket.event.wait()
+        if ticket.state == "shed":
+            raise ShedError(
+                "expired", self.device_p50(),
+                "deadline expired while queued",
+            )
+
+    def _dispatch_locked(self) -> None:
+        with self._lock:
+            if self._active is not None:
+                return
+            from karpenter_core_tpu.metrics import wiring as m
+
+            now = self.time_fn()
+            while True:
+                ticket = self._pick_locked()
+                if ticket is None:
+                    return
+                if (
+                    ticket.deadline_at is not None
+                    and now > ticket.deadline_at
+                ):
+                    ticket.state = "shed"
+                    self._pending -= 1
+                    self._count_shed_locked(ticket.tenant, "expired")
+                    self._export_depth_locked()
+                    ticket.event.set()
+                    continue
+                break
+            ticket.state = "granted"
+            ticket.granted_at = now
+            self._active = ticket
+            # monotone: a stale-vtime grant (a sweep held back behind the
+            # solve lane) must not roll the clock backwards, or the
+            # idle-rejoin bump would re-open the retroactive-credit hole
+            self._vclock = max(
+                self._vclock, self._vtime.get(ticket.tenant, 0.0)
+            )
+            self._grant_count += 1
+            wait = now - (ticket.ready_at or now)
+            m.SOLVERD_QUEUE_WAIT.observe(wait, {"tenant": ticket.tenant})
+            samples = self._wait_samples.get(ticket.tenant)
+            if samples is None:
+                samples = self._wait_samples[ticket.tenant] = deque(
+                    maxlen=512
+                )
+            samples.append(wait)
+            ticket.event.set()
+
+    def _pick_locked(self) -> Optional[Ticket]:
+        """Smallest-virtual-time backlogged tenant; the solve lane drains
+        before any sweep is considered (provisioning ahead of
+        consolidation). Ties break on tenant name for determinism."""
+        with self._lock:
+            for lane in _LANES:
+                candidates = [
+                    (self._vtime.get(tenant, 0.0), tenant)
+                    for tenant, lanes in self._queued.items()
+                    if lanes[lane]
+                ]
+                if candidates:
+                    _, tenant = min(candidates)
+                    return self._queued[tenant][lane].popleft()
+            return None
+
+    def release(self, ticket: Ticket, device_seconds: float) -> None:
+        """Device phase over: record the observation, charge the tenant's
+        virtual time, and grant the next ticket."""
+        with self._lock:
+            self._device_times.append(max(device_seconds, 0.0))
+            weight = max(
+                self.weights.get(ticket.tenant, self.default_weight), 1e-9
+            )
+            self._vtime[ticket.tenant] = (
+                self._vtime.get(ticket.tenant, 0.0)
+                + max(device_seconds, 0.0) / weight
+            )
+            ticket.state = "done"
+            self._active = None
+            self._pending -= 1
+            self._export_depth_locked()
+            self._dispatch_locked()
+            self._prune_locked()
+
+    def _prune_locked(self) -> None:
+        """Bound the per-tenant maps. Tenant ids arrive from the client,
+        so without pruning every distinct id leaks a vtime float, a lane
+        dict, and a wait deque for the sidecar's lifetime."""
+        with self._lock:
+            # empty lane dicts are pure bookkeeping — recreated on demand
+            for tenant in [
+                t for t, lanes in self._queued.items()
+                if not any(lanes[lane] for lane in _LANES)
+            ]:
+                del self._queued[tenant]
+            if len(self._vtime) > TENANT_STATE_CAP:
+                # an idle tenant at-or-behind the clock carries no
+                # information: rejoining would bump it to the clock anyway
+                for tenant in [
+                    t for t, v in self._vtime.items()
+                    if t not in self._queued and v <= self._vclock
+                ]:
+                    del self._vtime[tenant]
+            if len(self._vtime) > TENANT_STATE_CAP:
+                # still over (many ahead-of-clock idles): trim smallest
+                # vtime first — forgetting forgives at most their lead
+                idle = sorted(
+                    (v, t) for t, v in self._vtime.items()
+                    if t not in self._queued
+                )
+                for _v, tenant in idle[: len(self._vtime) - TENANT_STATE_CAP]:
+                    del self._vtime[tenant]
+            if len(self._wait_samples) > TENANT_STATE_CAP:
+                for tenant in [
+                    t for t in self._wait_samples if t not in self._queued
+                ][: len(self._wait_samples) - TENANT_STATE_CAP]:
+                    del self._wait_samples[tenant]
+
+    def abandon(self, ticket: Ticket) -> None:
+        """A request failed between submit and grant (decode error, client
+        gone): return its admission slot. Safe on granted tickets too (a
+        device-phase exception path), where it behaves like a zero-cost
+        release."""
+        with self._lock:
+            if ticket.state == "queued":
+                lanes = self._queued.get(ticket.tenant)
+                if lanes is not None:
+                    for lane in _LANES:
+                        try:
+                            lanes[lane].remove(ticket)
+                        except ValueError:
+                            pass
+            if ticket.state == "granted" and self._active is ticket:
+                self._active = None
+            if ticket.state in ("pending", "queued", "granted"):
+                ticket.state = "done"
+                self._pending -= 1
+                self._export_depth_locked()
+            self._dispatch_locked()
+
+    # -- observability -----------------------------------------------------
+
+    def depth(self) -> int:
+        with self._lock:
+            return self._pending
+
+    def saturated(self) -> bool:
+        with self._lock:
+            return self._pending >= self.max_depth
+
+    def _export_depth_locked(self) -> None:
+        from karpenter_core_tpu.metrics import wiring as m
+
+        with self._lock:
+            m.SOLVERD_QUEUE_DEPTH.set(float(self._pending))
+
+    def snapshot(self, reset: bool = False) -> dict:
+        """Per-gateway stats for the bench/tests (the REGISTRY instruments
+        are process-global and never reset): per-tenant queue-wait
+        percentiles over the recent sample window, shed counts by reason,
+        grant count, current depth."""
+        with self._lock:
+            def q(samples: List[float], p: float) -> float:
+                if not samples:
+                    return 0.0
+                ts = sorted(samples)
+                return ts[min(int(round(p * (len(ts) - 1))), len(ts) - 1)]
+
+            out = {
+                "tenants": {
+                    tenant: {
+                        "n": len(samples),
+                        "wait_p50_s": round(q(list(samples), 0.50), 6),
+                        "wait_p99_s": round(q(list(samples), 0.99), 6),
+                    }
+                    for tenant, samples in sorted(self._wait_samples.items())
+                },
+                "sheds": dict(sorted(self._shed_counts.items())),
+                "grants": self._grant_count,
+                "depth": self._pending,
+                "device_p50_s": round(self._device_p50_locked(), 6),
+            }
+            if reset:
+                self._wait_samples = {}
+                self._shed_counts = {}
+                self._grant_count = 0
+            return out
+
+
+class BoundedSchedulerCache:
+    """LRU over fingerprint -> DeviceScheduler with an entry AND an
+    approximate-byte bound, so a fleet of heterogeneous clusters (every
+    distinct problem half is its own entry) cannot grow the sidecar's
+    memory without bound. ``approx_bytes`` is the caller's proxy for the
+    entry's weight — solverd passes the encoded request size, which
+    tracks catalog/node-count scale without walking device buffers.
+    Evictions are observable (`solverd_scheduler_cache_evictions_total`
+    by reason, entry/byte gauges) so a fleet dashboard can tell "cache
+    too small for this tenant mix" from "cold tenant"."""
+
+    def __init__(
+        self,
+        max_entries: int = DEFAULT_CACHE_ENTRIES,
+        max_bytes: int = DEFAULT_CACHE_BYTES,
+    ):
+        if max_entries <= 0:
+            raise ValueError(
+                f"max_entries must be positive, got {max_entries}"
+            )
+        self.max_entries = max_entries
+        self.max_bytes = max_bytes
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[str, tuple]" = OrderedDict()
+        self._bytes = 0
+        self.evictions: Dict[str, int] = {}
+
+    def get(self, fingerprint: str):
+        with self._lock:
+            hit = self._entries.get(fingerprint)
+            if hit is None:
+                return None
+            self._entries.move_to_end(fingerprint)
+            return hit[0]
+
+    def put(self, fingerprint: str, scheduler, approx_bytes: int) -> None:
+        with self._lock:
+            old = self._entries.pop(fingerprint, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[fingerprint] = (scheduler, int(approx_bytes))
+            self._bytes += int(approx_bytes)
+            while len(self._entries) > self.max_entries:
+                self._evict_locked("entries")
+            # strict bound — even a single oversized problem may not pin
+            # more than the budget (it still SERVES, just uncached)
+            while self._bytes > self.max_bytes and self._entries:
+                self._evict_locked("bytes")
+            self._export_locked()
+
+    def _evict_locked(self, reason: str) -> None:
+        from karpenter_core_tpu.metrics import wiring as m
+
+        with self._lock:
+            _fp, (_sched, nbytes) = self._entries.popitem(last=False)
+            self._bytes -= nbytes
+            self.evictions[reason] = self.evictions.get(reason, 0) + 1
+        m.SOLVERD_SCHED_CACHE_EVICTIONS.inc({"reason": reason})
+
+    def _export_locked(self) -> None:
+        from karpenter_core_tpu.metrics import wiring as m
+
+        with self._lock:
+            m.SOLVERD_SCHED_CACHE_ENTRIES.set(float(len(self._entries)))
+            m.SOLVERD_SCHED_CACHE_BYTES.set(float(self._bytes))
+
+    # dict-like views the solverd tests/ops surface read
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, fingerprint: str) -> bool:
+        with self._lock:
+            return fingerprint in self._entries
+
+    def values(self) -> list:
+        with self._lock:
+            return [sched for sched, _bytes in self._entries.values()]
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return self._bytes

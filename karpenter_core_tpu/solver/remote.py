@@ -10,11 +10,22 @@ tolerance is the point of the seam:
 * bounded retry with exponential backoff;
 * a circuit breaker that trips after consecutive failures and half-opens
   after a cooldown, so a dead sidecar costs one fast-failed call per solve
-  instead of retries×timeout;
+  instead of retries×timeout (exported per tenant on the
+  ``solver_circuit_breaker_state`` gauge, so a fleet dashboard sees WHICH
+  operators are degraded);
+* overload cooperation — the fleet gateway's 429 sheds carry a
+  ``Retry-After`` estimate, which replaces the fixed exponential backoff
+  for the next attempt; a Retry-After past the solve budget degrades
+  immediately, and a shed never charges the breaker (the sidecar answered
+  — it is regulating, not dead);
 * graceful degradation — any RPC failure falls back to the host greedy
   Scheduler over the SAME inputs, so the cluster degrades to greedy parity
   instead of stalling provisioning (the in-solver twin of the device
   solver's own ``_host_fallback_add`` repair path).
+
+Every request ships the client's tenant id (``X-Solver-Tenant`` + the wire
+field) and its remaining deadline (``X-Solver-Deadline``), which is what
+lets the gateway shed hopeless work instead of timing it out.
 
 ``FaultInjector`` scripts deterministic timeout/error/slow schedules into
 the client (the cloudprovider/fake.py error-injection pattern) so every
@@ -39,9 +50,16 @@ _STATE_NAMES = {0: "closed", 1: "half-open", 2: "open"}
 class RemoteSolverError(Exception):
     """An RPC abandoned after retries (or short-circuited)."""
 
-    def __init__(self, cause: str, message: str = ""):
+    def __init__(
+        self, cause: str, message: str = "",
+        retry_after: Optional[float] = None,
+    ):
         super().__init__(message or cause)
-        self.cause = cause  # timeout | error | circuit_open | injected
+        self.cause = cause  # timeout | error | circuit_open | injected | shed
+        # server-estimated seconds until a retry would be admitted (429
+        # sheds only); honored by call()'s backoff in place of the fixed
+        # exponential schedule
+        self.retry_after = retry_after
 
 
 class FaultInjector:
@@ -72,11 +90,13 @@ class CircuitBreaker:
         cooldown: float = 15.0,
         time_fn=time.monotonic,
         on_state_change=None,
+        tenant: str = "default",
     ):
         self.failure_threshold = failure_threshold
         self.cooldown = cooldown
         self.time_fn = time_fn
         self.on_state_change = on_state_change
+        self.tenant = tenant
         self.state = STATE_CLOSED
         self.failures = 0
         self.opened_at = 0.0
@@ -85,7 +105,11 @@ class CircuitBreaker:
     def _export(self) -> None:
         from karpenter_core_tpu.metrics import wiring as m
 
-        m.SOLVER_CIRCUIT_STATE.set(float(self.state))
+        # tenant-labeled: each operator in the fleet owns its own breaker
+        # series, so "tenant-b is on greedy" is one dashboard cell
+        m.SOLVER_CIRCUIT_STATE.set(
+            float(self.state), {"tenant": self.tenant}
+        )
 
     def _transition(self, state: int) -> None:
         if state == self.state:
@@ -136,6 +160,7 @@ class SolverClient:
         fault_injector: Optional[FaultInjector] = None,
         sleep=time.sleep,
         on_state_change=None,
+        tenant: str = "default",
     ):
         host, _, port = addr.rpartition(":")
         self.host = host or "127.0.0.1"
@@ -143,8 +168,9 @@ class SolverClient:
         self.timeout = timeout
         self.max_retries = max_retries
         self.backoff = backoff
+        self.tenant = tenant
         self.breaker = breaker or CircuitBreaker(
-            on_state_change=on_state_change
+            on_state_change=on_state_change, tenant=tenant
         )
         if on_state_change is not None and breaker is not None:
             breaker.on_state_change = on_state_change
@@ -191,10 +217,29 @@ class SolverClient:
         try:
             conn.request(
                 "POST", path, body,
-                headers={"Content-Type": "application/octet-stream"},
+                headers={
+                    "Content-Type": "application/octet-stream",
+                    # fleet-gateway identity: who is asking, and how much
+                    # budget remains — what admission sheds against
+                    "X-Solver-Tenant": self.tenant,
+                    "X-Solver-Deadline": f"{self.timeout:.3f}",
+                },
             )
             resp = conn.getresponse()
             data = resp.read()
+            if resp.status == 429:
+                # admission shed: the gateway answered with its estimate
+                # of when a retry would be admitted
+                raw = resp.getheader("Retry-After", "") or ""
+                try:
+                    retry_after = max(float(raw), 0.0)
+                except ValueError:
+                    retry_after = self.backoff
+                raise RemoteSolverError(
+                    "shed",
+                    f"sidecar {path} shed the request: {data[:200]!r}",
+                    retry_after=retry_after,
+                )
             if resp.status != 200:
                 raise RemoteSolverError(
                     "error",
@@ -214,14 +259,31 @@ class SolverClient:
             m.SOLVER_RPC_FAILURES.inc({"cause": "circuit_open"})
             raise RemoteSolverError("circuit_open", "circuit breaker open")
         cause, detail = "error", ""
+        retry_after: Optional[float] = None
         for attempt in range(self.max_retries + 1):
             if attempt:
                 m.SOLVER_RPC_RETRIES.inc()
-                self.sleep(self.backoff * (2 ** (attempt - 1)))
+                # a server-sent Retry-After replaces the fixed exponential
+                # schedule — the gateway knows its own drain rate
+                self.sleep(
+                    retry_after
+                    if retry_after is not None
+                    else self.backoff * (2 ** (attempt - 1))
+                )
+            retry_after = None
             try:
                 data, kernel = self._once(path, body)
             except RemoteSolverError as e:
-                cause, detail = e.cause, str(e)
+                cause, detail, retry_after = e.cause, str(e), e.retry_after
+                if e.cause == "shed":
+                    # the sidecar ANSWERED — alive and regulating: reset
+                    # the breaker's failure streak, and if waiting out the
+                    # Retry-After would blow this solve's budget anyway,
+                    # stop burning attempts and degrade to greedy now
+                    self.breaker.record_success()
+                    if retry_after is not None and retry_after >= self.timeout:
+                        break
+                    continue
                 if self.breaker.state == STATE_HALF_OPEN:
                     break  # one probe only — don't burn retries while open
                 continue
@@ -237,9 +299,13 @@ class SolverClient:
                 continue
             self.breaker.record_success()
             return data, kernel
-        self.breaker.record_failure()
+        if cause != "shed":
+            # a shed is an admission decision, not a fault — it must never
+            # push the breaker toward open (that would turn a load spike
+            # into a blanket greedy degradation past the spike's end)
+            self.breaker.record_failure()
         m.SOLVER_RPC_FAILURES.inc({"cause": cause})
-        raise RemoteSolverError(cause, detail)
+        raise RemoteSolverError(cause, detail, retry_after=retry_after)
 
 
 class RemoteScheduler:
@@ -286,6 +352,7 @@ class RemoteScheduler:
                     topology=self.topology,
                     max_slots=self.max_slots,
                     unavailable_offerings=self.unavailable_offerings,
+                    tenant=self.client.tenant,
                 )
             t0 = time.perf_counter()
             data, kernel = self.client.call("/solve", body)
@@ -425,6 +492,7 @@ def remote_frontier(
                 base_pods,
                 candidate_pods,
                 max_slots=max_slots,
+                tenant=client.tenant,
             )
         t0 = time.perf_counter()
         data, kernel = client.call("/consolidate", body)

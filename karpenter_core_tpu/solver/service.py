@@ -12,10 +12,21 @@ gRPC+proto — same split, stdlib transport (the kube/httpserver.py pattern):
                            repeat solves against an unchanged cluster reuse
                            the prepared-state caches across RPC calls)
 * ``POST /consolidate``  — consolidation prefix sweep (frontier_core)
-* ``GET  /healthz``      — liveness + readiness (warm-up finished)
+* ``GET  /healthz``      — liveness + readiness + admission-queue depth
+                           (``ready: false`` while the queue is saturated,
+                           so probes tell "overloaded" from "dead")
 * ``GET  /metrics``      — the sidecar's own registry, exposition format
 * ``POST /profile``      — toggle jax.profiler trace capture around solves
                            (requires ``--profile-dir``); GET reports state
+
+Since the fleet gateway (solver/fleet.py) landed, one sidecar serves N
+operators: every request carries a tenant (wire field + ``X-Solver-Tenant``
+header) and a remaining deadline (``X-Solver-Deadline``), admission sheds
+hopeless requests with ``429 + Retry-After`` (the client degrades that
+solve to its host greedy path), tenants share the device under weighted
+fair queueing with provisioning prioritized over consolidation sweeps, and
+only the device phase of a request is exclusive — request B's codec
+decode/encode overlaps request A's device time.
 
 Responses carry ``X-Solver-Seconds`` (device solve wall time) so the client
 can split its RPC histogram into transit vs kernel. Boot enables the
@@ -27,12 +38,13 @@ Run: ``python -m karpenter_core_tpu.solver.service --port 0``
 """
 from __future__ import annotations
 
+import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from karpenter_core_tpu.kube.httpserver import read_body, send_body
-from karpenter_core_tpu.solver import codec
+from karpenter_core_tpu.solver import codec, fleet
 
 _OCTET = "application/octet-stream"
 
@@ -41,37 +53,77 @@ class SolverDaemon:
     """Request execution, transport-free (tests drive it directly).
 
     Schedulers are cached per problem fingerprint (everything in the solve
-    request EXCEPT the pending pods — see codec.problem_fingerprint): a
-    control plane re-solving against an unchanged cluster reuses the same
-    DeviceScheduler across RPC calls, which carries the prepared-state
-    caches (vocab-keyed catalog tensors, per-class rows, device-resident
-    class steps) across the wire boundary. Any change to the problem half
-    changes the fingerprint and builds a fresh scheduler, so cached and
-    uncached solves are packing-identical by construction (conformance
-    battery in tests/test_solverd.py). Solves serialize on a lock — the
-    sidecar owns one device, and a cached DeviceScheduler is not
-    reentrant."""
+    request EXCEPT the pending pods and the tenant — see
+    codec.problem_fingerprint): a control plane re-solving against an
+    unchanged cluster reuses the same DeviceScheduler across RPC calls,
+    which carries the prepared-state caches (vocab-keyed catalog tensors,
+    per-class rows, device-resident class steps) across the wire boundary.
+    Any change to the problem half changes the fingerprint and builds a
+    fresh scheduler, so cached and uncached solves are packing-identical
+    by construction (conformance battery in tests/test_solverd.py). The
+    cache is LRU-bounded in entries AND approximate bytes
+    (fleet.BoundedSchedulerCache) so a fleet of heterogeneous tenants
+    cannot OOM the sidecar.
 
-    _SCHED_CACHE_CAP = 4
+    The fleet gateway sequences the device: a request holds exclusivity
+    only between ``await_grant`` and ``release`` — its codec decode runs
+    before the grant and its result encode after the release, both on the
+    request's own handler thread, so host work pipelines under the device
+    phase of whichever request currently owns the chip. A cached
+    DeviceScheduler is not reentrant; the single-grant gateway is what
+    makes that safe."""
 
-    def __init__(self, profile_dir: str = None):
+    def __init__(
+        self,
+        profile_dir: str = None,
+        gateway: fleet.FleetGateway = None,
+        sched_cache: fleet.BoundedSchedulerCache = None,
+    ):
         self.ready = False
         self.solves = 0
         self.profile_dir = profile_dir
         self.profiling = False
-        self._sched_cache = {}
-        self._lock = threading.Lock()
+        self.gateway = gateway if gateway is not None else fleet.FleetGateway()
+        # `is None`, not truthiness: an EMPTY BoundedSchedulerCache is
+        # falsy (len 0) but must still be adopted, or the caller's bounds
+        # would silently be replaced with the defaults
+        self._sched_cache = (
+            sched_cache
+            if sched_cache is not None
+            else fleet.BoundedSchedulerCache()
+        )
         self._state_lock = threading.Lock()
 
     # -- endpoints ---------------------------------------------------------
 
-    def solve(self, body: bytes):
-        """bytes -> (response bytes, solve seconds)."""
+    def solve(self, body: bytes, tenant: str = None, deadline: float = None):
+        """bytes -> (response bytes, solve seconds). Raises fleet.ShedError
+        when admission rejects the request (the HTTP layer answers 429 +
+        Retry-After; solver/remote.py degrades that solve to greedy).
+
+        ``tenant`` is the transport-level identity (the X-Solver-Tenant
+        header) and wins when present; a direct-drive caller that passes
+        none is accounted to the tenant on the wire."""
         from karpenter_core_tpu.metrics import wiring as m
         from karpenter_core_tpu.models.provisioner import DeviceScheduler
 
-        problem = codec.decode_solve_request(body)
-        with self._lock:
+        ticket = self.gateway.submit(
+            tenant or fleet.DEFAULT_TENANT, fleet.LANE_SOLVE, deadline
+        )
+        try:
+            # host phase: decode runs on this handler thread with the
+            # device NOT held — request B decodes under request A's kernel
+            problem = self._decode_solve(body)
+            if tenant is None:
+                ticket.tenant = problem["tenant"]
+        except BaseException:
+            self.gateway.abandon(ticket)
+            raise
+        self.gateway.await_grant(ticket)  # may raise ShedError (expired)
+        dt = 0.0
+        grant_t0 = time.perf_counter()
+        try:
+            # device phase: the only exclusive section
             scheduler = self._sched_cache.get(problem["fingerprint"])
             if scheduler is None:
                 m.SOLVERD_SCHED_CACHE.inc({"outcome": "miss"})
@@ -84,9 +136,11 @@ class SolverDaemon:
                     topology=problem["topology"],
                     unavailable_offerings=problem["unavailable_offerings"],
                 )
-                if len(self._sched_cache) >= self._SCHED_CACHE_CAP:
-                    del self._sched_cache[next(iter(self._sched_cache))]
-                self._sched_cache[problem["fingerprint"]] = scheduler
+                # the encoded request size is the entry's weight proxy: it
+                # tracks catalog/node scale without walking device buffers
+                self._sched_cache.put(
+                    problem["fingerprint"], scheduler, len(body)
+                )
             else:
                 m.SOLVERD_SCHED_CACHE.inc({"outcome": "hit"})
                 # the fingerprint ignores the pod-derived excluded-uid
@@ -97,10 +151,31 @@ class SolverDaemon:
             with self._maybe_profile():
                 results = scheduler.solve(problem["pods"])
             dt = time.perf_counter() - t0
-            # counter increment stays under the solve lock: handler threads
-            # run concurrently and a bare += is a lost update
-            self.solves += 1
+            # handler threads run concurrently; a bare += is a lost update
+            with self._state_lock:
+                self.solves += 1
+        finally:
+            # charge the FULL exclusive occupancy — cache-miss scheduler
+            # construction/prepare included, and the elapsed time even
+            # when the solve raised. Fairness and the admission p50 must
+            # see what the device actually lost; charging only the kernel
+            # would let cache-churning tenants under-pay and a raising
+            # solve would drag the p50 estimator toward zero. The kernel
+            # time alone (dt) still rides X-Solver-Seconds so the client's
+            # transit/kernel histogram split stays honest.
+            self.gateway.release(ticket, time.perf_counter() - grant_t0)
+        m.SOLVERD_TENANT_SOLVES.inc(
+            {"tenant": ticket.tenant, "endpoint": "solve"}
+        )
+        # host phase again: encode outside the grant, the next tenant's
+        # device phase is already running
         return codec.encode_solve_results(results, dt), dt
+
+    def _decode_solve(self, body: bytes) -> dict:
+        """The solve request's host-phase decode — a named seam so chaos
+        tests can wedge ONE tenant's host phase and prove the device keeps
+        serving everyone else."""
+        return codec.decode_solve_request(body)
 
     def _maybe_profile(self):
         """jax.profiler trace context when profiling is toggled on and a
@@ -118,8 +193,8 @@ class SolverDaemon:
     def toggle_profile(self, enable: bool = None) -> dict:
         # read-modify-write (enable=None flips the current state) under its
         # own small lock: two concurrent POST /profile toggles must not both
-        # read the same old value. Deliberately NOT self._lock — a toggle
-        # must not queue behind a multi-second solve.
+        # read the same old value. Deliberately NOT a gateway ticket — a
+        # toggle must not queue behind a multi-second solve.
         with self._state_lock:
             if enable is None:
                 enable = not self.profiling
@@ -130,23 +205,62 @@ class SolverDaemon:
                 "configured": self.profile_dir is not None,
             }
 
-    def consolidate(self, body: bytes):
+    def consolidate(
+        self, body: bytes, tenant: str = None, deadline: float = None
+    ):
+        """Consolidation sweeps ride the gateway's NORMAL lane: under
+        contention every pending provisioning solve dispatches first."""
+        from karpenter_core_tpu.metrics import wiring as m
         from karpenter_core_tpu.models.consolidation import frontier_core
 
-        req = codec.decode_frontier_request(body)
-        t0 = time.perf_counter()
-        frontier = frontier_core(
-            req["nodepools"],
-            req["instance_types"],
-            req["cand_nodes"],
-            req["keep_nodes"],
-            req["daemonset_pods"],
-            req["base_pods"],
-            req["candidate_pods"],
-            max_slots=req["max_slots"],
+        ticket = self.gateway.submit(
+            tenant or fleet.DEFAULT_TENANT, fleet.LANE_SWEEP, deadline
         )
-        dt = time.perf_counter() - t0
+        try:
+            req = codec.decode_frontier_request(body)
+            if tenant is None:
+                ticket.tenant = req["tenant"]
+        except BaseException:
+            self.gateway.abandon(ticket)
+            raise
+        self.gateway.await_grant(ticket)
+        dt = 0.0
+        grant_t0 = time.perf_counter()
+        try:
+            t0 = time.perf_counter()
+            frontier = frontier_core(
+                req["nodepools"],
+                req["instance_types"],
+                req["cand_nodes"],
+                req["keep_nodes"],
+                req["daemonset_pods"],
+                req["base_pods"],
+                req["candidate_pods"],
+                max_slots=req["max_slots"],
+            )
+            dt = time.perf_counter() - t0
+        finally:
+            # full-occupancy charge, as in solve()
+            self.gateway.release(ticket, time.perf_counter() - grant_t0)
+        m.SOLVERD_TENANT_SOLVES.inc(
+            {"tenant": ticket.tenant, "endpoint": "consolidate"}
+        )
         return codec.encode_frontier_response(frontier), dt
+
+    def health(self) -> dict:
+        """The /healthz body: liveness (warm-up finished) + readiness
+        (liveness AND the admission queue below its bound). An overloaded
+        sidecar is alive-but-unready — the supervisor must not respawn it
+        into a load spike (a restart storm turns overload into outage)."""
+        depth = self.gateway.depth()
+        saturated = self.gateway.saturated()
+        return {
+            "ok": self.ready,
+            "ready": bool(self.ready and not saturated),
+            "overloaded": saturated,
+            "queue_depth": depth,
+            "queue_capacity": self.gateway.max_depth,
+        }
 
     # -- boot warm-up ------------------------------------------------------
 
@@ -185,11 +299,11 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:
         path = self.path.split("?")[0]
         if path == "/healthz":
-            ok = self.daemon.ready
+            health = self.daemon.health()
             send_body(
                 self,
-                200 if ok else 503,
-                (b'{"ok": true}' if ok else b'{"ok": false}'),
+                200 if health["ok"] else 503,
+                json.dumps(health).encode(),
             )
         elif path == "/metrics":
             from karpenter_core_tpu.metrics.registry import REGISTRY
@@ -199,27 +313,46 @@ class _Handler(BaseHTTPRequestHandler):
                 "text/plain; version=0.0.4; charset=utf-8",
             )
         elif path == "/profile":
-            import json as _json
-
             send_body(
                 self, 200,
-                _json.dumps(self.daemon.toggle_profile(
+                json.dumps(self.daemon.toggle_profile(
                     self.daemon.profiling  # GET reports, never toggles
                 )).encode(),
             )
         else:
             send_body(self, 404, b'{"error": "not found"}')
 
+    def _request_identity(self):
+        """(tenant, deadline) from transport headers. The header is the
+        gateway's pre-decode identity; the wire's tenant field backs it up
+        for header-less clients. A malformed deadline means no deadline
+        (shedding on garbage would turn a client bug into an outage)."""
+        tenant = self.headers.get("X-Solver-Tenant") or None
+        deadline = None
+        raw = self.headers.get("X-Solver-Deadline")
+        if raw:
+            try:
+                deadline = float(raw)
+            except ValueError:
+                deadline = None
+        if deadline is not None and deadline <= 0:
+            deadline = None
+        return tenant, deadline
+
     def do_POST(self) -> None:
         path, _, query = self.path.partition("?")
         body = read_body(self)
+        tenant, deadline = self._request_identity()
         try:
             if path == "/solve":
-                out, dt = self.daemon.solve(body)
+                out, dt = self.daemon.solve(
+                    body, tenant=tenant, deadline=deadline
+                )
             elif path == "/consolidate":
-                out, dt = self.daemon.consolidate(body)
+                out, dt = self.daemon.consolidate(
+                    body, tenant=tenant, deadline=deadline
+                )
             elif path == "/profile":
-                import json as _json
                 from urllib.parse import parse_qs
 
                 q = parse_qs(query)
@@ -227,9 +360,19 @@ class _Handler(BaseHTTPRequestHandler):
                 if "enable" in q:
                     enable = q["enable"][0] not in ("0", "false", "off")
                 state = self.daemon.toggle_profile(enable)
-                return send_body(self, 200, _json.dumps(state).encode())
+                return send_body(self, 200, json.dumps(state).encode())
             else:
                 return send_body(self, 404, b'{"error": "not found"}')
+        except fleet.ShedError as e:
+            # overload is a CONTRACT, not an error: 429 + the gateway's
+            # retry estimate; the client degrades this solve to greedy
+            return send_body(
+                self, 429,
+                json.dumps(
+                    {"error": "overloaded", "reason": e.reason}
+                ).encode(),
+                headers={"Retry-After": f"{e.retry_after:.3f}"},
+            )
         except Exception as e:
             return send_body(
                 self, 500, repr(e).encode(), ctype="text/plain"
@@ -276,9 +419,40 @@ def main() -> int:
         " (off by default), so TPU-side traces can be grabbed from a"
         " running sidecar without redeploying",
     )
+    ap.add_argument(
+        "--queue-depth", type=int, default=fleet.DEFAULT_QUEUE_DEPTH,
+        help="admission bound: requests in flight (queued + host phase +"
+        " device) before the gateway sheds with 429 + Retry-After",
+    )
+    ap.add_argument(
+        "--tenant-weights", default="",
+        help="fair-share weights as 'tenant=weight,...' (default weight 1:"
+        " a weight-3 tenant gets ~3x the device share under contention)",
+    )
+    ap.add_argument(
+        "--cache-entries", type=int, default=fleet.DEFAULT_CACHE_ENTRIES,
+        help="DeviceScheduler cache entry bound (one entry per distinct"
+        " problem fingerprint across all tenants)",
+    )
+    ap.add_argument(
+        "--cache-mib", type=int,
+        default=fleet.DEFAULT_CACHE_BYTES >> 20,
+        help="DeviceScheduler cache approximate-byte bound, in MiB"
+        " (encoded-request-size proxy per entry)",
+    )
     args = ap.parse_args()
 
-    daemon = SolverDaemon(profile_dir=args.profile_dir)
+    daemon = SolverDaemon(
+        profile_dir=args.profile_dir,
+        gateway=fleet.FleetGateway(
+            max_depth=args.queue_depth,
+            weights=fleet.parse_tenant_weights(args.tenant_weights),
+        ),
+        sched_cache=fleet.BoundedSchedulerCache(
+            max_entries=args.cache_entries,
+            max_bytes=args.cache_mib << 20,
+        ),
+    )
     httpd = serve(args.port, host=args.host, daemon=daemon, ready=False)
     # the supervisor (solver/supervisor.py) reads this line to learn the
     # bound address — same handshake as kube/httpserver.py

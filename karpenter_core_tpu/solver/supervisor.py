@@ -24,7 +24,13 @@ from typing import Callable, List, Optional
 
 
 def default_command(
-    port: int, prewarm: bool = False, profile_dir: Optional[str] = None
+    port: int,
+    prewarm: bool = False,
+    profile_dir: Optional[str] = None,
+    queue_depth: Optional[int] = None,
+    tenant_weights: str = "",
+    cache_entries: Optional[int] = None,
+    cache_mib: Optional[int] = None,
 ) -> List[str]:
     cmd = [
         sys.executable,
@@ -39,6 +45,17 @@ def default_command(
         # the sidecar arms jax.profiler capture lazily (POST /profile), so
         # passing the directory at spawn time costs nothing until toggled
         cmd.extend(["--profile-dir", profile_dir])
+    # fleet-gateway sizing (solver/fleet.py): only non-defaults ride the
+    # command line, so a respawned child always re-reads the operator's
+    # configuration rather than a stale frozen argv default
+    if queue_depth is not None:
+        cmd.extend(["--queue-depth", str(queue_depth)])
+    if tenant_weights:
+        cmd.extend(["--tenant-weights", tenant_weights])
+    if cache_entries is not None:
+        cmd.extend(["--cache-entries", str(cache_entries)])
+    if cache_mib is not None:
+        cmd.extend(["--cache-mib", str(cache_mib)])
     return cmd
 
 
@@ -49,6 +66,10 @@ class SolverSupervisor:
         port: int = 0,
         prewarm: bool = False,
         profile_dir: Optional[str] = None,
+        queue_depth: Optional[int] = None,
+        tenant_weights: str = "",
+        cache_entries: Optional[int] = None,
+        cache_mib: Optional[int] = None,
         backoff_initial: float = 1.0,
         backoff_max: float = 30.0,
         stable_window: float = 60.0,
@@ -56,7 +77,13 @@ class SolverSupervisor:
         time_fn=time.monotonic,
         on_event: Optional[Callable[[str, str], None]] = None,
     ):
-        self.command = command or default_command(port, prewarm, profile_dir)
+        self.command = command or default_command(
+            port, prewarm, profile_dir,
+            queue_depth=queue_depth,
+            tenant_weights=tenant_weights,
+            cache_entries=cache_entries,
+            cache_mib=cache_mib,
+        )
         self.backoff_initial = backoff_initial
         self.backoff_max = backoff_max
         # deadline on the handshake line: a child that wedges before

@@ -1,0 +1,29 @@
+"""GL302 bad, fair-queue shape: a gateway class (per-tenant queues, a
+virtual clock, an admission counter) whose handler-thread entry points bump
+shared counters OUTSIDE the owning lock — the exact class shape
+solver/fleet.py ships, with the discipline broken."""
+import threading
+from collections import deque
+
+
+class FairQueueGateway:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._vclock = 0.0
+        self._queued = {}
+
+    def submit(self, tenant):
+        with self._lock:
+            self._queued.setdefault(tenant, deque()).append(object())
+        self._pending += 1  # two handler threads read the same old value
+
+    def release(self, tenant, seconds):
+        with self._lock:
+            self._queued[tenant].popleft()
+        self._vclock = self._vclock + seconds  # same lost-update shape
+
+    def serve(self, tenant):
+        threading.Thread(
+            target=self.submit, args=(tenant,), daemon=True
+        ).start()

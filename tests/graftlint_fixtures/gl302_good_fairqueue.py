@@ -1,0 +1,29 @@
+"""GL302 good, fair-queue shape: every read-modify-write on the gateway's
+shared state (admission counter, virtual clock, tenant queues) holds the
+owning lock — the discipline solver/fleet.py's FleetGateway ships."""
+import threading
+from collections import deque
+
+
+class FairQueueGateway:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._vclock = 0.0
+        self._queued = {}
+
+    def submit(self, tenant):
+        with self._lock:
+            self._queued.setdefault(tenant, deque()).append(object())
+            self._pending += 1
+
+    def release(self, tenant, seconds):
+        with self._lock:
+            self._queued[tenant].popleft()
+            self._vclock = self._vclock + seconds
+            self._pending -= 1
+
+    def serve(self, tenant):
+        threading.Thread(
+            target=self.submit, args=(tenant,), daemon=True
+        ).start()

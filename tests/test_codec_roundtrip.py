@@ -184,6 +184,9 @@ def sample_problem() -> dict:
         unavailable_offerings=frozenset(
             {OfferingKey("fake-2x", "z1", "spot")}
         ),
+        # a non-default tenant so the fleet-gateway identity provably
+        # survives the wire (the default would also pass a dropped field)
+        tenant="tenant-a",
     )
 
 
@@ -422,6 +425,7 @@ def test_frontier_request_roundtrip():
         base_pods=problem["pods"][:1],
         candidate_pods=[problem["pods"][1:]],
         max_slots=64,
+        tenant="tenant-a",
     )
     decoded = codec.decode_frontier_request(
         codec.encode_frontier_request(**kwargs)

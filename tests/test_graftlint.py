@@ -33,11 +33,16 @@ def _fixture_pairs():
     pairs = []
     for bad in sorted(FIXTURES.rglob("*_bad*.py")):
         rule = bad.name.split("_")[0].upper()
-        good_matches = [
-            g for g in FIXTURES.rglob(f"{rule.lower()}_good*.py")
-        ]
+        good_matches = sorted(
+            FIXTURES.rglob(f"{rule.lower()}_good*.py")
+        )
         assert good_matches, f"no good fixture for {rule}"
-        pairs.append((rule, bad, good_matches[0]))
+        # a rule may ship several bad/good pairs (e.g. the GL302 base pair
+        # plus the fair-queue-shaped pair): prefer the good twin with the
+        # matching suffix so every good fixture is actually exercised
+        twin = bad.with_name(bad.name.replace("_bad", "_good"))
+        good = twin if twin in good_matches else good_matches[0]
+        pairs.append((rule, bad, good))
     return pairs
 
 

@@ -67,6 +67,33 @@ class TestOptionsParse:
         with pytest.raises(ValueError):
             Options.parse(["--solver-mode", "sidecar"], env={})
 
+    def test_fleet_tenancy_flags(self):
+        o = Options.parse([], env={})
+        assert o.solver_tenant == "default"
+        assert o.solver_queue_depth == 16
+        assert o.solver_tenant_weights == ""
+        o = Options.parse(
+            ["--solver-tenant", "blue", "--solver-queue-depth=8",
+             "--solver-tenant-weights", "blue=3,green=1"],
+            env={},
+        )
+        assert o.solver_tenant == "blue"
+        assert o.solver_queue_depth == 8
+        assert o.solver_tenant_weights == "blue=3,green=1"
+        assert Options.parse(
+            [], env={"KARPENTER_SOLVER_TENANT": "green"}
+        ).solver_tenant == "green"
+        # gateway sizing/identity errors surface at the flag boundary, not
+        # inside a respawned sidecar's argparse
+        with pytest.raises(ValueError, match="must be positive"):
+            Options.parse(["--solver-queue-depth", "0"], env={})
+        with pytest.raises(ValueError, match="non-empty"):
+            Options.parse(["--solver-tenant", ""], env={})
+        with pytest.raises(ValueError):
+            Options.parse(["--solver-tenant-weights", "blue=-1"], env={})
+        with pytest.raises(ValueError):
+            Options.parse(["--solver-tenant-weights", "blue"], env={})
+
     def test_unknown_flag_rejected(self):
         # a typo'd flag must error, not silently swallow the next flag
         with pytest.raises(ValueError):

@@ -407,7 +407,10 @@ class TestDegradation:
         assert breaker.state == STATE_CLOSED and breaker.failures == 1
         assert rs.solve(pods).all_pods_scheduled()
         assert breaker.state == STATE_OPEN
-        assert m.SOLVER_CIRCUIT_STATE.value() == float(STATE_OPEN)
+        # the gauge is tenant-labeled since the fleet gateway landed
+        assert m.SOLVER_CIRCUIT_STATE.value(
+            {"tenant": "default"}
+        ) == float(STATE_OPEN)
 
         # solve 3: circuit open -> fast-fail, no transport, injector unused
         calls_before = injector.calls
