@@ -33,6 +33,7 @@ from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
 )
 from karpenter_core_tpu.models.provisioner import DeviceScheduler, _SlotOverflow
 from karpenter_core_tpu.ops.ffd import ClassStep, SlotState, ffd_step
+from karpenter_core_tpu.parallel import mesh as pmesh
 from karpenter_core_tpu.solver.snapshot import _spec_signature
 
 
@@ -170,6 +171,10 @@ def schedulability_frontier(
             candidate_pods,
             max_slots=max_slots,
         )
+    # in-proc sweeps follow the solve path's device-count choice (the
+    # operator threads --solver-devices through device_scheduler_opts);
+    # a sidecar owns its own device count (solverd --devices)
+    dev_opts = getattr(provisioner, "device_scheduler_opts", None) or {}
     return frontier_core(
         nodepools,
         instance_types,
@@ -179,6 +184,7 @@ def schedulability_frontier(
         base_pods,
         candidate_pods,
         max_slots=max_slots,
+        devices=dev_opts.get("devices", 1),
     )
 
 
@@ -191,14 +197,26 @@ def frontier_core(
     base_pods: List,
     candidate_pods: List[List],
     max_slots: int = 1024,
+    devices: int = 1,
 ) -> Optional[List[Tuple[bool, int, float]]]:
     """The device sweep proper, over already-gathered inputs — runnable
     in-process or behind the solverd sidecar (solver/service.py decodes a
-    frontier request straight into this signature)."""
+    frontier request straight into this signature).
+
+    With ``devices > 1`` the INDEPENDENT prefix axis shards over the mesh
+    (batch_sharding): each device evaluates its prefix subset against a
+    replicated SlotState with zero cross-device traffic inside the scan —
+    the prefix count (~100 candidates) dwarfs the device count, so
+    prefix-parallel beats slot-parallel for the sweep."""
     all_pods = list(base_pods)
     for pods in candidate_pods:
         all_pods.extend(pods)
 
+    # the sweep shards the PREFIX axis, so its scheduler must NOT
+    # pre-shard the slot axis (devices=1 here): the state/class planes
+    # land once, get replicated across the prefix mesh in one placement
+    # below, and never pay a shard-then-regather round trip
+    n_dev = pmesh.resolve_devices(devices)
     # candidate slots first so prefix p masks slots [0, p)
     sched = DeviceScheduler(
         nodepools,
@@ -206,6 +224,7 @@ def frontier_core(
         existing_nodes=cand_nodes + keep_nodes,
         daemonset_pods=daemonset_pods,
         max_slots=max_slots,
+        devices=1,
     )
     # DeviceScheduler sorts existing nodes; force candidate-first order back
     sched.existing_nodes = cand_nodes + keep_nodes
@@ -215,6 +234,8 @@ def frontier_core(
         return None  # cluster wider than the slot array: binary search
 
     P = len(candidate_pods)
+    if P == 0:
+        return []
     E = len(sched.existing_nodes)
     kind_batch, count_batch = prefix_batches(prep, base_pods, candidate_pods)
 
@@ -224,19 +245,48 @@ def frontier_core(
         count_batch = np.pad(
             count_batch, ((0, 0), (0, Jp - count_batch.shape[1]))
         )
+    if n_dev > 1:
+        # shard the prefix axis over the mesh; the (single-device,
+        # uncommitted) state/class/static planes commit replicated in ONE
+        # placement each. Pad P to a device multiple with copies of the
+        # last prefix and slice the verdicts back below.
+        mesh = pmesh.slot_mesh(n_dev)
+        repl = pmesh.replicated(mesh)
+        pad_p = pmesh.pad_to_devices(P, n_dev) - P
+        if pad_p:
+            kind_batch = np.concatenate(
+                [kind_batch, np.repeat(kind_batch[-1:], pad_p, axis=0)]
+            )
+            count_batch = np.concatenate(
+                [count_batch, np.repeat(count_batch[-1:], pad_p, axis=0)]
+            )
+        psh = pmesh.batch_sharding(mesh, 2)
+        state = jax.device_put(
+            prep.init_state, jax.tree.map(lambda _: repl, prep.init_state)
+        )
+        cls = jax.device_put(classes, jax.tree.map(lambda _: repl, classes))
+        statics = jax.device_put(
+            prep.statics, jax.tree.map(lambda _: repl, prep.statics)
+        )
+        kind_d = jax.device_put(kind_batch, psh)
+        count_d = jax.device_put(count_batch, psh)
+    else:
+        state, cls, statics = prep.init_state, classes, prep.statics
+        kind_d = jnp.asarray(kind_batch)
+        count_d = jnp.asarray(count_batch)
     next_free, unplaced, overflow, price_lb = _prefix_scan(
-        prep.init_state,
-        classes,
-        prep.statics,
-        jnp.asarray(kind_batch),
-        jnp.asarray(count_batch),
+        state,
+        cls,
+        statics,
+        kind_d,
+        count_d,
         jnp.asarray(_it_price_vector(prep)),
         jnp.int32(E),
     )
-    next_free = np.asarray(next_free)
-    unplaced = np.asarray(unplaced)
-    overflow = np.asarray(overflow)
-    price_lb = np.asarray(price_lb)
+    next_free = np.asarray(next_free)[:P]
+    unplaced = np.asarray(unplaced)[:P]
+    overflow = np.asarray(overflow)[:P]
+    price_lb = np.asarray(price_lb)[:P]
     # an overflowed prefix silently counted spilled pods as placed — it is
     # NOT schedulable evidence
     return [

@@ -72,6 +72,7 @@ from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
 )
 from karpenter_core_tpu.ops import masks as mops
 from karpenter_core_tpu.ops import topoplan
+from karpenter_core_tpu.parallel import mesh as pmesh
 from karpenter_core_tpu.ops.ffd import (
     BIG,
     RANK_NONE,
@@ -233,6 +234,7 @@ class DeviceScheduler:
         max_slots: int = 256,
         topology: Optional[Topology] = None,
         unavailable_offerings: "frozenset | set" = frozenset(),
+        devices: int = 1,
     ):
         # ICE'd offerings project onto the catalog exactly like the greedy
         # path (apply_unavailable), so the host-side machinery — template
@@ -244,6 +246,22 @@ class DeviceScheduler:
 
         instance_types = apply_unavailable(instance_types, unavailable_offerings)
         self.unavailable_offerings = frozenset(unavailable_offerings)
+        # multi-device solve (the pjit-over-ICI production path): with
+        # devices > 1 every device array is committed to a 1-D slot mesh —
+        # SlotState (and the per-step exist_taint_ok planes) land
+        # PRE-SHARDED over the slot axis via _dev_slots, everything else
+        # replicated via _dev — so the jit'd kernels (ops/ffd, ops/masks)
+        # compile SPMD from the argument shardings and XLA collectives
+        # carry the first-fit prefix sum and the class scan. devices<=0
+        # means "all local devices"; requests clamp to what exists, so the
+        # same config degrades to the single-device path on a 1-chip box.
+        self.devices = pmesh.resolve_devices(devices)
+        if self.devices > 1:
+            self._mesh = pmesh.slot_mesh(self.devices)
+            self._repl = pmesh.replicated(self._mesh)
+        else:
+            self._mesh = None
+            self._repl = None
         # a supplied Topology carries cluster context (existing pods,
         # exclusions); its groups are rebuilt fresh each solve round, so only
         # the constructor inputs are kept
@@ -320,6 +338,7 @@ class DeviceScheduler:
         # from the previous solve's observed usage instead of max_slots
         self._slots_hint: Optional[int] = None
         self._h2d_bytes = 0
+        self._h2d_dev_bytes = 0
         self.last_phase_stats: Dict[str, float] = {}
 
     _FP_CACHE_CAP = 4
@@ -341,9 +360,29 @@ class DeviceScheduler:
         self._topology_context = topology
 
     def _dev(self, a: np.ndarray):
-        """Host->device put with byte accounting for the phase breakdown."""
+        """Host->device put with byte accounting for the phase breakdown.
+        Multi-device schedulers commit the copy replicated across the mesh
+        (every device pays the full bytes)."""
         self._h2d_bytes += a.nbytes
-        return jnp.asarray(a)
+        self._h2d_dev_bytes += a.nbytes
+        if self._mesh is None:
+            return jnp.asarray(a)
+        return jax.device_put(a, self._repl)
+
+    def _dev_slots(self, a: np.ndarray, dim: int = 0):
+        """Host->device put for slot-axis arrays: lands PRE-SHARDED over
+        the mesh, so the fingerprint-keyed prepared-state caches hold
+        sharded device copies and a steady-state re-solve stays
+        hit-for-hit with zero re-placement. Per-device h2d bytes scale
+        1/devices for these planes — the whole point of the slot mesh."""
+        self._h2d_bytes += a.nbytes
+        if self._mesh is None:
+            self._h2d_dev_bytes += a.nbytes
+            return jnp.asarray(a)
+        self._h2d_dev_bytes += -(-a.nbytes // self.devices)
+        return jax.device_put(
+            a, pmesh.axis_sharding(self._mesh, a.ndim, dim)
+        )
 
     # ------------------------------------------------------------------
 
@@ -414,6 +453,11 @@ class DeviceScheduler:
             "decode_s": 0.0, "fetch_bytes": 0, "h2d_bytes": 0,
             "rounds": 0, "slots": max_slots, "used_slots": 0,
             "prep_cache_hits": 0, "prep_cache_misses": 0,
+            # multi-device accounting: per-device h2d/fetch bytes (sharded
+            # planes divide across the mesh, replicated ones don't), so
+            # single- vs multi-device runs compare like for like
+            "n_devices": self.devices,
+            "h2d_dev_bytes": 0, "fetch_dev_bytes": 0,
         }
 
         from karpenter_core_tpu.metrics import wiring as m
@@ -482,6 +526,7 @@ class DeviceScheduler:
 
         stats = self.last_phase_stats
         self._h2d_bytes = 0
+        self._h2d_dev_bytes = 0
         t0 = time.perf_counter()
         # one Topology per solve round; every pod's groups are (re)built so
         # relaxed specs take effect (topology.go NewTopology:60-86)
@@ -521,6 +566,7 @@ class DeviceScheduler:
             return None
         stats["prepare_s"] += time.perf_counter() - t0
         stats["h2d_bytes"] += self._h2d_bytes
+        stats["h2d_dev_bytes"] += self._h2d_dev_bytes
 
         t0 = time.perf_counter()
         kernel_timer = m.SOLVER_KERNEL_DURATION.time()
@@ -578,11 +624,22 @@ class DeviceScheduler:
             # only the topology-free decode reads class_it host-side
             # (_decode_composition); it rides the single post-scan fetch
             fetch["class_it"] = prep.class_it
+        # per-device fetch share BEFORE the gather: a slot-sharded plane
+        # costs each device ~1/devices of its bytes, a replicated one the
+        # full bytes (`.nbytes`/`.sharding` are metadata — no transfer)
+        fetched_dev = 16
+        for v in fetch.values():
+            n = int(getattr(v, "nbytes", 0))
+            sh = getattr(v, "sharding", None)
+            if sh is not None and not sh.is_fully_replicated:
+                n = -(-n // self.devices)
+            fetched_dev += n
         out = jax.device_get(fetch)
         kernel_timer.__exit__(None, None, None)
         stats["kernel_s"] += time.perf_counter() - t0
         fetched = sum(np.asarray(v).nbytes for v in out.values()) + 16
         stats["fetch_bytes"] += fetched  # + the head scalars
+        stats["fetch_dev_bytes"] += fetched_dev
         m.SOLVER_FETCH_BYTES.inc(by=fetched)
         # slice bucketed device shapes back to the natural sizes decode
         # (and the topoplan arrays) index with
@@ -1407,22 +1464,25 @@ class DeviceScheduler:
             _pad(valmask, {2: Vp}, False)[:, :K],
             valmask_p[:, :K],
         )
+        # slot-axis planes land pre-sharded over the mesh (_dev_slots,
+        # matching parallel.mesh.SLOT_STATE_SPECS); zcount and the head
+        # scalars replicate — the same classification slot_shardings pins
         return SlotState(
-            valmask=self._dev(valmask_p),
-            defines=self._dev(defines_p),
-            complement=self._dev(_pad(complement, {1: Kp}, True)),
-            negative=self._dev(_pad(negative, {1: Kp}, True)),
-            gt=self._dev(_pad(gt, {1: Kp}, GT_NONE)),
-            lt=self._dev(_pad(lt, {1: Kp}, LT_NONE)),
-            itmask=self._dev(np.zeros((N, Tp), dtype=bool)),
-            requests=self._dev(_pad(requests, {1: Rp}, 0.0)),
-            capacity=self._dev(_pad(capacity, {1: Rp}, np.float32(BIG))),
-            kind=self._dev(kind),
-            template=self._dev(template_arr),
-            podcount=jnp.zeros((N,), dtype=jnp.int32),
+            valmask=self._dev_slots(valmask_p),
+            defines=self._dev_slots(defines_p),
+            complement=self._dev_slots(_pad(complement, {1: Kp}, True)),
+            negative=self._dev_slots(_pad(negative, {1: Kp}, True)),
+            gt=self._dev_slots(_pad(gt, {1: Kp}, GT_NONE)),
+            lt=self._dev_slots(_pad(lt, {1: Kp}, LT_NONE)),
+            itmask=self._dev_slots(np.zeros((N, Tp), dtype=bool)),
+            requests=self._dev_slots(_pad(requests, {1: Rp}, 0.0)),
+            capacity=self._dev_slots(_pad(capacity, {1: Rp}, np.float32(BIG))),
+            kind=self._dev_slots(kind),
+            template=self._dev_slots(template_arr),
+            podcount=self._dev_slots(np.zeros((N,), dtype=np.int32)),
             next_free=jnp.int32(E),
             overflow=jnp.asarray(False),
-            hcount=self._dev(_pad(hcount0, {1: Ghp}, 0)),
+            hcount=self._dev_slots(_pad(hcount0, {1: Ghp}, 0)),
             zcount=self._dev(_pad(plan.zcount0, {0: Gzp, 1: Vp}, 0)),
             carry=jnp.int32(0),
         )
@@ -1448,7 +1508,10 @@ class DeviceScheduler:
         classes = plan.device_classes
         catalog = self._catalog_union()
         E = len(self.existing_nodes)
-        N = max_slots
+        # the sharded slot axis must divide evenly across the mesh
+        # (device_put rejects uneven shards); padded slots are inert by
+        # construction, so the packing is invariant (parity-tested)
+        N = pmesh.pad_to_devices(max_slots, self.devices)
         if E > N:
             raise _SlotOverflow()
 
@@ -1653,8 +1716,10 @@ class DeviceScheduler:
             ),
             class_it=jnp.where(valid_j[:, None], class_it_g, False),
             tmpl_ok=jnp.where(valid_j[:, None], tmpl_ok_g, False),
-            exist_taint_ok=self._dev(
-                _pad(prep.exist_taint_ok[cis], {0: Jp}, False)
+            # [Jp, N]: the one scanned input with a slot axis (dim 1) —
+            # each scan step slices a slot-sharded [N] row
+            exist_taint_ok=self._dev_slots(
+                _pad(prep.exist_taint_ok[cis], {0: Jp}, False), dim=1
             ),
             new_template=jnp.where(valid_j, prep.new_template[ci_j], -1),
             kstar=jnp.where(valid_j, prep.kstar[ci_j], 0),

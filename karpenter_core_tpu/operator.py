@@ -78,6 +78,13 @@ class Options:
     solver_mode: str = "inproc"  # inproc | sidecar
     solver_addr: str = ""
     solver_timeout: float = 30.0  # per-RPC deadline, seconds
+    # shard the solve over the first N local devices (parallel/mesh.py
+    # slot mesh; 0 = all local devices, 1 = single-device). In-proc this
+    # threads into the DeviceScheduler; in sidecar mode it rides the
+    # spawned child's command line (solverd --devices) — an external
+    # --solver-addr sidecar configures its own. Requests clamp to what
+    # exists, so a slice config degrades to single-device on a 1-chip box.
+    solver_devices: int = 1
     # fleet tenancy (solver/fleet.py): this operator's identity at a SHARED
     # sidecar — rides every RPC (wire field + X-Solver-Tenant header) for
     # fair queueing / per-tenant accounting, and labels the circuit gauge
@@ -113,6 +120,9 @@ class Options:
         ),
         "solver_tenant": (
             "--solver-tenant", "KARPENTER_SOLVER_TENANT", str,
+        ),
+        "solver_devices": (
+            "--solver-devices", "KARPENTER_SOLVER_DEVICES", int,
         ),
         "solver_queue_depth": (
             "--solver-queue-depth", "KARPENTER_SOLVER_QUEUE_DEPTH", int,
@@ -186,6 +196,13 @@ class Options:
                 )
         if not opts.solver_tenant:
             raise ValueError("--solver-tenant must be non-empty")
+        # 0 = all local devices is the only non-positive request that
+        # means anything; a negative count is a typo, not a mesh
+        if opts.solver_devices < 0:
+            raise ValueError(
+                "--solver-devices must be >= 0 (0 = all local devices),"
+                f" got {opts.solver_devices}"
+            )
         # malformed weights must fail at the flag surface, not inside a
         # respawned sidecar's argparse three failures deep
         from karpenter_core_tpu.solver.fleet import parse_tenant_weights
@@ -277,6 +294,13 @@ class Operator:
                     # --solver-addr sidecar configures its own)
                     queue_depth=self.options.solver_queue_depth,
                     tenant_weights=self.options.solver_tenant_weights,
+                    # only a non-default device count rides the argv, so a
+                    # respawned child re-reads the operator's choice
+                    devices=(
+                        self.options.solver_devices
+                        if self.options.solver_devices != 1
+                        else None
+                    ),
                 )
                 addr = self.solver_supervisor.start()
             self.solver_client = SolverClient(
@@ -286,13 +310,19 @@ class Operator:
                 # this operator's identity at a (possibly shared) sidecar
                 tenant=self.options.solver_tenant,
             )
+        # in-proc TPU solves follow --solver-devices (sidecar mode leaves
+        # the device choice to the child, which owns the chips); an
+        # explicit device_scheduler_opts["devices"] wins over the flag
+        device_opts = dict(self.options.device_scheduler_opts)
+        if self.options.solver == "tpu" and self.solver_client is None:
+            device_opts.setdefault("devices", self.options.solver_devices)
         self.provisioner = Provisioner(
             self.kube,
             self.cluster,
             self.cloud_provider,
             self.clock,
             solver=self.options.solver,
-            device_scheduler_opts=self.options.device_scheduler_opts,
+            device_scheduler_opts=device_opts,
             recorder=self.recorder,
             solver_client=self.solver_client,
             unavailable_offerings=self.unavailable_offerings,

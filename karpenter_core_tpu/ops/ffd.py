@@ -673,7 +673,12 @@ ffd_solve = partial(jax.jit, static_argnames=("level_iters",))(
 # cutting HBM churn per solve. Callers MUST pass a freshly device-put
 # state — models/provisioner rebuilds init_state per round — which is why
 # ffd_solve (tests, sharded harness, consolidation) keeps the non-donating
-# signature. Donation is a no-op on CPU; the CPU path aliases ffd_solve so
+# signature. Donation SURVIVES sharding: a multi-device caller
+# (DeviceScheduler(devices=N)) commits the state pre-sharded over the slot
+# mesh (parallel/mesh.py), the jit infers matching in/out shardings from
+# the arguments (the scan carries them through unchanged), and XLA aliases
+# the per-device buffers shard-for-shard — no donation-dropped warnings.
+# Donation is a no-op on CPU; the CPU path aliases ffd_solve so
 # the test mesh doesn't warn on every compile. The backend probe happens
 # lazily at first CALL (we're about to dispatch anyway), never at import —
 # importing this module must not initialize the XLA runtime.

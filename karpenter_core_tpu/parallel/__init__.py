@@ -7,12 +7,30 @@ the first-fit prefix sum (an int32 cumsum — exact under any reduction
 order) and the class scan are handled by XLA collectives. Consolidation's
 prefix sweep adds a second, fully independent batch axis (the candidate
 prefix), sharded the same way.
+
+This is the PRODUCTION scale axis, not a dry-run helper: a
+``DeviceScheduler(devices=N)`` places SlotState pre-sharded over the mesh
+(``slot_shardings`` — explicit field-name annotation, see mesh.py) and the
+jit'd kernels compile SPMD from the argument shardings.
 """
 from karpenter_core_tpu.parallel.mesh import (
+    SLOT_STATE_SPECS,
+    axis_sharding,
     batch_sharding,
+    pad_to_devices,
     replicated,
+    resolve_devices,
     slot_mesh,
     slot_shardings,
 )
 
-__all__ = ["batch_sharding", "replicated", "slot_mesh", "slot_shardings"]
+__all__ = [
+    "SLOT_STATE_SPECS",
+    "axis_sharding",
+    "batch_sharding",
+    "pad_to_devices",
+    "replicated",
+    "resolve_devices",
+    "slot_mesh",
+    "slot_shardings",
+]

@@ -1,14 +1,45 @@
 """Mesh construction and sharding specs for the FFD solve.
 
-One copy of the "leading axis == n_slots -> shard over 'slots', else
-replicate" rule, shared by the driver entry (__graft_entry__.py), the
-sharded-parity tests, and any multi-chip deployment of the solver.
+One copy of the slot-axis sharding rules, shared by the production solve
+path (models/provisioner.DeviceScheduler with ``devices > 1``), the driver
+entry (__graft_entry__.py), the sharded-parity tests, and the solverd
+sidecar (``--devices``).
+
+The SlotState sharding is matched BY FIELD NAME (``SLOT_STATE_SPECS``),
+not by a "leading dim == n_slots" shape heuristic: a non-slot array whose
+leading dimension coincidentally equals n_slots (e.g. a [Gz, V] zcount on
+a solve with Gz == n_slots) must replicate, and a new SlotState field must
+be classified here explicitly — ``slot_shardings`` refuses to guess.
 """
 from __future__ import annotations
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Explicit slot-axis annotation for ops/ffd.SlotState: field -> the dim
+# carrying the slot axis (sharded over the mesh), or None (replicated).
+# zcount is [Gz, V] label-group count state and the head scalars ride the
+# scan carry on every device; everything else leads with [N, ...].
+SLOT_STATE_SPECS = {
+    "valmask": 0,
+    "defines": 0,
+    "complement": 0,
+    "negative": 0,
+    "gt": 0,
+    "lt": 0,
+    "itmask": 0,
+    "requests": 0,
+    "capacity": 0,
+    "kind": 0,
+    "template": 0,
+    "podcount": 0,
+    "hcount": 0,
+    "zcount": None,
+    "next_free": None,
+    "overflow": None,
+    "carry": None,
+}
 
 
 def slot_mesh(n_devices: int, axis: str = "slots") -> Mesh:
@@ -25,14 +56,52 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def axis_sharding(
+    mesh: Mesh, ndim: int, dim: int = 0, axis: str = "slots"
+) -> NamedSharding:
+    """Shard one dimension of an ndim-array over the mesh axis."""
+    spec = [None] * ndim
+    spec[dim] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
 def slot_shardings(mesh: Mesh, state, n_slots: int, axis: str = "slots"):
-    """Shardings pytree for a SlotState: leaves leading with the slot axis
-    (dim 0 == n_slots) shard over the mesh; scalars/others replicate."""
+    """Shardings pytree for a SlotState: slot-axis leaves (annotated in
+    SLOT_STATE_SPECS) shard over the mesh; everything else replicates.
+
+    For a NamedTuple state every field must be classified — an unlisted
+    field raises rather than falling back to a shape guess, so adding a
+    SlotState field forces a sharding decision here. Slot-axis fields are
+    additionally validated against ``n_slots`` (a mis-sized state is a
+    caller bug, not something to shard anyway). Non-NamedTuple pytrees
+    (ad-hoc test trees) keep the legacy leading-dim heuristic.
+    """
+    if hasattr(state, "_fields"):
+        unknown = [f for f in state._fields if f not in SLOT_STATE_SPECS]
+        if unknown:
+            raise ValueError(
+                f"slot_shardings: unclassified SlotState field(s) {unknown};"
+                " annotate them in parallel.mesh.SLOT_STATE_SPECS"
+            )
+        specs = {}
+        for f in state._fields:
+            leaf = getattr(state, f)
+            dim = SLOT_STATE_SPECS[f]
+            if dim is None:
+                specs[f] = replicated(mesh)
+            else:
+                if leaf.shape[dim] != n_slots:
+                    raise ValueError(
+                        f"slot_shardings: {f} has shape {leaf.shape}, "
+                        f"expected dim {dim} == n_slots ({n_slots})"
+                    )
+                specs[f] = axis_sharding(mesh, leaf.ndim, dim, axis)
+        return type(state)(**specs)
 
     def spec(leaf):
         if hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] == n_slots:
-            return NamedSharding(mesh, P(axis, *([None] * (leaf.ndim - 1))))
-        return NamedSharding(mesh, P())
+            return axis_sharding(mesh, leaf.ndim, 0, axis)
+        return replicated(mesh)
 
     return jax.tree.map(spec, state)
 
@@ -40,3 +109,30 @@ def slot_shardings(mesh: Mesh, state, n_slots: int, axis: str = "slots"):
 def batch_sharding(mesh: Mesh, ndim: int, axis: str = "slots") -> NamedSharding:
     """Shard a batch-leading array (e.g. the consolidation prefix axis)."""
     return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def resolve_devices(requested) -> int:
+    """Resolve a device-count request against the local platform.
+
+    ``1`` (the default everywhere) short-circuits without touching the
+    backend — constructing a single-device scheduler must not initialize
+    XLA early. ``0``/None means "all local devices"; any other request
+    clamps to what exists, so an 8-device config degrades to the
+    single-device path on a 1-chip box instead of crashing.
+    """
+    requested = int(requested or 0)
+    if requested == 1:
+        return 1
+    available = len(jax.devices())
+    if requested <= 0:
+        return available
+    return max(1, min(requested, available))
+
+
+def pad_to_devices(n: int, n_devices: int) -> int:
+    """Round n up to a multiple of n_devices: ``device_put`` over the slot
+    axis needs even division, and padded slots are inert by construction
+    (kind=0 never takes — the slot-axis-invariance parity property)."""
+    if n_devices <= 1:
+        return n
+    return -(-n // n_devices) * n_devices

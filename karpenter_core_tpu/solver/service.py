@@ -78,10 +78,17 @@ class SolverDaemon:
         profile_dir: str = None,
         gateway: fleet.FleetGateway = None,
         sched_cache: fleet.BoundedSchedulerCache = None,
+        devices: int = 1,
     ):
         self.ready = False
         self.solves = 0
         self.profile_dir = profile_dir
+        # shard every solve/sweep over the first N local devices (0 = all;
+        # requests clamp to what exists, so a multi-device config degrades
+        # to the single-device path on a 1-chip box). Resolved lazily per
+        # scheduler construction — the daemon must stay importable without
+        # initializing the XLA backend.
+        self.devices = devices
         self.profiling = False
         self.gateway = gateway if gateway is not None else fleet.FleetGateway()
         # `is None`, not truthiness: an EMPTY BoundedSchedulerCache is
@@ -135,6 +142,7 @@ class SolverDaemon:
                     max_slots=problem["max_slots"],
                     topology=problem["topology"],
                     unavailable_offerings=problem["unavailable_offerings"],
+                    devices=self.devices,
                 )
                 # the encoded request size is the entry's weight proxy: it
                 # tracks catalog/node scale without walking device buffers
@@ -237,6 +245,7 @@ class SolverDaemon:
                 req["base_pods"],
                 req["candidate_pods"],
                 max_slots=req["max_slots"],
+                devices=self.devices,
             )
             dt = time.perf_counter() - t0
         finally:
@@ -284,7 +293,8 @@ class SolverDaemon:
             pool.spec = NodePoolSpec()
             catalog = build_catalog(cpu_grid=[1, 2, 4, 8], mem_factors=[2, 4])
             DeviceScheduler(
-                [pool], {"prewarm": catalog}, max_slots=256
+                [pool], {"prewarm": catalog}, max_slots=256,
+                devices=self.devices,
             ).prewarm()
         self.ready = True
 
@@ -440,7 +450,16 @@ def main() -> int:
         help="DeviceScheduler cache approximate-byte bound, in MiB"
         " (encoded-request-size proxy per entry)",
     )
+    ap.add_argument(
+        "--devices", type=int, default=1,
+        help="shard every solve/sweep over the first N local devices"
+        " (pjit over the slot axis; 0 = all local devices, 1 ="
+        " single-device). Requests clamp to what exists, so a slice"
+        " config degrades to single-device on a 1-chip box",
+    )
     args = ap.parse_args()
+    if args.devices < 0:
+        ap.error("--devices must be >= 0 (0 = all local devices)")
 
     daemon = SolverDaemon(
         profile_dir=args.profile_dir,
@@ -452,6 +471,7 @@ def main() -> int:
             max_entries=args.cache_entries,
             max_bytes=args.cache_mib << 20,
         ),
+        devices=args.devices,
     )
     httpd = serve(args.port, host=args.host, daemon=daemon, ready=False)
     # the supervisor (solver/supervisor.py) reads this line to learn the

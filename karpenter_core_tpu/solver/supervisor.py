@@ -31,6 +31,7 @@ def default_command(
     tenant_weights: str = "",
     cache_entries: Optional[int] = None,
     cache_mib: Optional[int] = None,
+    devices: Optional[int] = None,
 ) -> List[str]:
     cmd = [
         sys.executable,
@@ -56,6 +57,10 @@ def default_command(
         cmd.extend(["--cache-entries", str(cache_entries)])
     if cache_mib is not None:
         cmd.extend(["--cache-mib", str(cache_mib)])
+    # the child owns the chips: the operator's --solver-devices rides the
+    # spawn command so a respawned sidecar re-shards over the same slice
+    if devices is not None:
+        cmd.extend(["--devices", str(devices)])
     return cmd
 
 
@@ -70,6 +75,7 @@ class SolverSupervisor:
         tenant_weights: str = "",
         cache_entries: Optional[int] = None,
         cache_mib: Optional[int] = None,
+        devices: Optional[int] = None,
         backoff_initial: float = 1.0,
         backoff_max: float = 30.0,
         stable_window: float = 60.0,
@@ -83,6 +89,7 @@ class SolverSupervisor:
             tenant_weights=tenant_weights,
             cache_entries=cache_entries,
             cache_mib=cache_mib,
+            devices=devices,
         )
         self.backoff_initial = backoff_initial
         self.backoff_max = backoff_max

@@ -1,0 +1,12 @@
+# GL104 bad: drives the SlotState jit entry with a bare single-arg
+# jax.device_put — the placement bypasses parallel.mesh.slot_shardings,
+# so on a multi-device mesh the state lands unannotated and every
+# dispatch pays a reshard. Lint corpus only — never imported.
+import jax
+
+from karpenter_core_tpu.ops.ffd import ffd_solve
+
+
+def run_solve(state_np, classes, statics):
+    state = jax.device_put(state_np)  # no sharding: GL104
+    return ffd_solve(state, classes, statics)

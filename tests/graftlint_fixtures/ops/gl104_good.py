@@ -1,0 +1,14 @@
+# GL104 good: the call site routes slot-state placement through
+# parallel.mesh.slot_shardings before the SlotState jit entry runs, so
+# the multi-device copy lands pre-sharded. Lint corpus only — never
+# imported.
+import jax
+
+from karpenter_core_tpu.ops.ffd import ffd_solve
+from karpenter_core_tpu.parallel import slot_mesh, slot_shardings
+
+
+def run_solve(state_np, classes, statics, n_slots):
+    mesh = slot_mesh(8)
+    state = jax.device_put(state_np, slot_shardings(mesh, state_np, n_slots))
+    return ffd_solve(state, classes, statics)

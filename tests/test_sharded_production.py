@@ -1,0 +1,401 @@
+"""The multi-device solve as the PRODUCTION path (ISSUE 6).
+
+Two layers over the existing ops-level sharded tests
+(tests/test_sharded_solver.py, which hand-shard a raw ffd_solve call):
+
+1. ``parallel/mesh.py`` hardening — slot_shardings matches SlotState
+   leaves BY FIELD NAME (SLOT_STATE_SPECS), so a non-slot array whose
+   leading dim coincidentally equals n_slots replicates, an unclassified
+   field refuses to guess, and a mis-sized slot plane fails loudly.
+
+2. ``DeviceScheduler(devices=N)`` end-to-end parity on the conftest-forced
+   8-device virtual CPU mesh: identical node counts, identical takes
+   (per-claim pod sets), and identical result WIRE BYTES vs the
+   single-device path — including a slot axis that is not divisible by the
+   device count (padding case), a 3-device mesh, the device topology
+   kernel, and the consolidation prefix sweep.
+
+Sizes stay small: these are correctness gates, not benchmarks (throughput
+on a virtual CPU mesh is meaningless — bench.py cfg8_multidev owns that).
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tests.helpers import GIB, make_nodepool, make_pod
+
+from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+    Topology,
+)
+from karpenter_core_tpu.models.provisioner import DeviceScheduler
+from karpenter_core_tpu.ops.ffd import SlotState
+from karpenter_core_tpu.parallel import (
+    SLOT_STATE_SPECS,
+    pad_to_devices,
+    resolve_devices,
+    slot_mesh,
+    slot_shardings,
+)
+from karpenter_core_tpu.solver import codec
+
+N_DEVICES = 8
+
+
+def _catalog():
+    return build_catalog()[:16]
+
+
+def _plain_pods(n):
+    return [
+        make_pod(
+            cpu=0.25 * (1 + i % 5),
+            memory_gib=1.0 * (1 + i % 3),
+            name=f"shard-{i}",
+        )
+        for i in range(n)
+    ]
+
+
+def _topo_pods(n):
+    pods = []
+    for i in range(n):
+        kind = i % 4
+        if kind == 0:
+            pods.append(
+                make_pod(cpu=0.25, name=f"tsp-{i}", spread_zone=True,
+                         labels={"app": "zspread"})
+            )
+        elif kind == 1:
+            pods.append(
+                make_pod(cpu=0.25, name=f"tsp-{i}", spread_hostname=True,
+                         labels={"app": "hspread"})
+            )
+        else:
+            pods.append(
+                make_pod(cpu=0.25 * (1 + i % 3), name=f"tsp-{i}")
+            )
+    return pods
+
+
+def _solve(pods, max_slots, devices, existing_nodes=None):
+    sched = DeviceScheduler(
+        [make_nodepool()],
+        {"default": _catalog()},
+        existing_nodes=existing_nodes,
+        max_slots=max_slots,
+        devices=devices,
+    )
+    return sched, sched.solve(pods)
+
+
+def _assert_full_parity(res_sharded, res_single):
+    """Node counts, per-claim takes (pod-uid sets), and wire bytes."""
+    assert res_sharded.all_pods_scheduled(), res_sharded.pod_errors
+    assert res_single.all_pods_scheduled(), res_single.pod_errors
+    assert res_sharded.node_count() == res_single.node_count()
+    takes_sharded = sorted(
+        tuple(sorted(p.uid for p in c.pods))
+        for c in res_sharded.new_node_claims
+    )
+    takes_single = sorted(
+        tuple(sorted(p.uid for p in c.pods))
+        for c in res_single.new_node_claims
+    )
+    assert takes_sharded == takes_single
+    assert codec.encode_solve_results(
+        res_sharded, 0.0
+    ) == codec.encode_solve_results(res_single, 0.0)
+
+
+# -- parallel/mesh.py hardening (satellite 1) ------------------------------
+
+
+class TestSlotShardings:
+    def _tiny_state(self, n_slots=8, gz=8, k=2, v=3):
+        """SlotState with Gz == n_slots: the old leading-dim heuristic
+        would misclassify zcount as a slot plane."""
+        z = np.zeros
+        return SlotState(
+            valmask=z((n_slots, k, v), bool),
+            defines=z((n_slots, k), bool),
+            complement=z((n_slots, k), bool),
+            negative=z((n_slots, k), bool),
+            gt=z((n_slots, k), np.int32),
+            lt=z((n_slots, k), np.int32),
+            itmask=z((n_slots, 4), bool),
+            requests=z((n_slots, 2), np.float32),
+            capacity=z((n_slots, 2), np.float32),
+            kind=z((n_slots,), np.int8),
+            template=z((n_slots,), np.int32),
+            podcount=z((n_slots,), np.int32),
+            next_free=np.int32(0),
+            overflow=np.asarray(False),
+            hcount=z((n_slots, 1), np.int32),
+            zcount=z((gz, v), np.int32),  # leading dim == n_slots!
+            carry=np.int32(0),
+        )
+
+    def test_every_slotstate_field_is_classified(self):
+        assert set(SlotState._fields) == set(SLOT_STATE_SPECS), (
+            "SlotState and parallel.mesh.SLOT_STATE_SPECS drifted apart"
+        )
+
+    def test_zcount_with_coincident_leading_dim_replicates(self):
+        mesh = slot_mesh(N_DEVICES)
+        sh = slot_shardings(mesh, self._tiny_state(), 8)
+        assert sh.zcount.is_fully_replicated
+        assert not sh.kind.is_fully_replicated
+        assert sh.kind.is_equivalent_to(NamedSharding(mesh, P("slots")), 1)
+        assert sh.hcount.is_equivalent_to(
+            NamedSharding(mesh, P("slots", None)), 2
+        )
+
+    def test_unclassified_field_refuses_to_guess(self):
+        mesh = slot_mesh(N_DEVICES)
+        Fake = namedtuple("Fake", ("kind", "mystery"))
+        fake = Fake(kind=np.zeros((8,), np.int8), mystery=np.zeros((8,)))
+        with pytest.raises(ValueError, match="mystery"):
+            slot_shardings(mesh, fake, 8)
+
+    def test_missized_slot_plane_fails_loudly(self):
+        mesh = slot_mesh(N_DEVICES)
+        state = self._tiny_state()._replace(kind=np.zeros((4,), np.int8))
+        with pytest.raises(ValueError, match="kind"):
+            slot_shardings(mesh, state, 8)
+
+    def test_generic_pytree_keeps_heuristic(self):
+        mesh = slot_mesh(N_DEVICES)
+        sh = slot_shardings(
+            mesh, {"a": np.zeros((8, 2)), "b": np.zeros((3,))}, 8
+        )
+        assert not sh["a"].is_fully_replicated
+        assert sh["b"].is_fully_replicated
+
+    def test_pad_to_devices(self):
+        assert pad_to_devices(100, 8) == 104
+        assert pad_to_devices(64, 8) == 64
+        assert pad_to_devices(64, 3) == 66
+        assert pad_to_devices(7, 1) == 7
+
+    def test_resolve_devices(self):
+        assert resolve_devices(1) == 1
+        assert resolve_devices(0) == len(jax.devices())
+        assert resolve_devices(None) == len(jax.devices())
+        # over-asking clamps to the box instead of crashing
+        assert resolve_devices(10_000) == len(jax.devices())
+
+
+# -- production-path parity (tentpole) -------------------------------------
+
+
+class TestShardedProductionSolve:
+    def test_init_state_lands_pre_sharded(self):
+        sched = DeviceScheduler(
+            [make_nodepool()], {"default": _catalog()},
+            max_slots=64, devices=N_DEVICES,
+        )
+        prep = sched._prepare(_plain_pods(16), 64, Topology())
+        mesh = sched._mesh
+        expect = slot_shardings(mesh, prep.init_state, prep.n_slots)
+        for field in SlotState._fields:
+            leaf = getattr(prep.init_state, field)
+            want = getattr(expect, field)
+            if not hasattr(leaf, "sharding"):
+                continue
+            if want.is_fully_replicated:
+                # head scalars may stay uncommitted; committed ones must
+                # not be slot-sharded
+                assert leaf.sharding.is_fully_replicated or (
+                    len(leaf.sharding.device_set) == 1
+                ), field
+            else:
+                assert leaf.sharding.is_equivalent_to(want, leaf.ndim), field
+        # the scanned exist_taint_ok plane shards its SLOT axis (dim 1)
+        steps = sched._class_steps(prep)
+        assert steps.exist_taint_ok.sharding.is_equivalent_to(
+            NamedSharding(mesh, P(None, "slots")), 2
+        )
+
+    def test_plain_parity_and_wire_bytes(self):
+        pods = _plain_pods(120)
+        s1, r1 = _solve(pods, 64, 1)
+        s8, r8 = _solve(pods, 64, N_DEVICES)
+        _assert_full_parity(r8, r1)
+        assert s8.last_phase_stats["n_devices"] == N_DEVICES
+        assert s1.last_phase_stats["n_devices"] == 1
+        # per-device traffic must undercut the single-device bytes: the
+        # slot planes divide across the mesh
+        assert (
+            s8.last_phase_stats["h2d_dev_bytes"]
+            < s1.last_phase_stats["h2d_dev_bytes"]
+        )
+        assert (
+            s8.last_phase_stats["fetch_dev_bytes"]
+            < s1.last_phase_stats["fetch_dev_bytes"]
+        )
+
+    def test_padded_slot_axis_parity(self):
+        """n_slots not divisible by n_devices: 100 -> 104 on the mesh."""
+        pods = _plain_pods(120)
+        _, r1 = _solve(pods, 100, 1)
+        s8, r8 = _solve(pods, 100, N_DEVICES)
+        assert s8.devices == N_DEVICES
+        _assert_full_parity(r8, r1)
+
+    def test_three_device_mesh_parity(self):
+        pods = _plain_pods(120)
+        _, r1 = _solve(pods, 64, 1)
+        s3, r3 = _solve(pods, 64, 3)
+        assert s3.devices == 3
+        _assert_full_parity(r3, r1)
+
+    def test_device_request_clamps_to_available(self):
+        pods = _plain_pods(40)
+        _, r1 = _solve(pods, 64, 1)
+        s, r = _solve(pods, 64, 10_000)
+        assert s.devices == len(jax.devices())
+        _assert_full_parity(r, r1)
+
+    def test_topology_kernel_parity(self):
+        pods = _topo_pods(96)
+        _, r1 = _solve(pods, 64, 1)
+        _, r8 = _solve(pods, 64, N_DEVICES)
+        _assert_full_parity(r8, r1)
+
+    def test_existing_nodes_parity(self):
+        from karpenter_core_tpu.api import labels as L
+        from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import (
+            SimNode,
+        )
+
+        nodes = [
+            SimNode(
+                name=f"exist-{i}",
+                labels={
+                    L.LABEL_ARCH: "amd64",
+                    L.LABEL_OS: "linux",
+                    L.LABEL_TOPOLOGY_ZONE: "zone-a",
+                    L.NODEPOOL_LABEL_KEY: "default",
+                    L.LABEL_INSTANCE_TYPE: _catalog()[5].name,
+                },
+                taints=[],
+                available={"cpu": 7.0, "memory": 14 * GIB, "pods": 200.0},
+                capacity={"cpu": 8.0, "memory": 16 * GIB, "pods": 210.0},
+            )
+            for i in range(6)
+        ]
+        pods = _plain_pods(60)
+        _, r1 = _solve(pods, 64, 1, existing_nodes=list(nodes))
+        _, r8 = _solve(pods, 64, N_DEVICES, existing_nodes=list(nodes))
+        assert r1.all_pods_scheduled() and r8.all_pods_scheduled()
+        assert r8.node_count() == r1.node_count()
+        # existing-node placements (by node name) must match too
+        by_node = lambda res: sorted(  # noqa: E731
+            (sim.name, tuple(sorted(p.uid for p in sim.pods)))
+            for sim in res.existing_nodes
+        )
+        assert by_node(r8) == by_node(r1)
+
+
+class TestDeviceCountPlumbing:
+    """--solver-devices threads operator -> in-proc opts / supervisor argv
+    -> solverd; the sidecar owns its own count via ``--devices``."""
+
+    def test_operator_flag_parses_and_validates(self):
+        from karpenter_core_tpu.operator import Options
+
+        assert Options.parse([]).solver_devices == 1
+        assert Options.parse(["--solver-devices", "8"]).solver_devices == 8
+        assert Options.parse(["--solver-devices=0"]).solver_devices == 0
+        assert (
+            Options.parse(
+                [], env={"KARPENTER_SOLVER_DEVICES": "4"}
+            ).solver_devices
+            == 4
+        )
+        with pytest.raises(ValueError, match="solver-devices"):
+            Options.parse(["--solver-devices", "-1"])
+
+    def test_operator_threads_devices_into_inproc_opts(self):
+        from karpenter_core_tpu.operator import Operator, Options
+
+        op = Operator(
+            options=Options.parse(
+                ["--solver", "tpu", "--solver-devices", "2"]
+            )
+        )
+        assert op.provisioner.device_scheduler_opts.get("devices") == 2
+        # an explicit device_scheduler_opts entry wins over the flag
+        opts = Options.parse(["--solver", "tpu", "--solver-devices", "2"])
+        opts.device_scheduler_opts = {"devices": 3}
+        op2 = Operator(options=opts)
+        assert op2.provisioner.device_scheduler_opts.get("devices") == 3
+
+    def test_supervisor_command_carries_devices(self):
+        from karpenter_core_tpu.solver.supervisor import default_command
+
+        cmd = default_command(0, devices=8)
+        assert cmd[cmd.index("--devices") + 1] == "8"
+        assert "--devices" not in default_command(0)
+
+    def test_daemon_constructs_sharded_schedulers(self):
+        """A devices=N daemon builds devices=N DeviceSchedulers for both
+        /solve and the prewarm path (driven directly, no HTTP)."""
+        from karpenter_core_tpu.solver import codec, service
+
+        daemon = service.SolverDaemon(devices=N_DEVICES)
+        pods = _plain_pods(24)
+        body = codec.encode_solve_request(
+            [make_nodepool()], {"default": _catalog()}, [], [], pods,
+            Topology(), max_slots=64,
+        )
+        out, _dt = daemon.solve(body)
+        decoded = codec.decode_solve_results(out)
+        assert not decoded["errors"]
+        cached = next(iter(daemon._sched_cache._entries.values()))[0]
+        assert cached.devices == N_DEVICES
+
+
+class TestShardedConsolidationFrontier:
+    def test_frontier_parity_with_prefix_padding(self):
+        """P=5 prefixes on an 8-device mesh: the prefix axis pads to a
+        device multiple and the verdicts slice back."""
+        from karpenter_core_tpu.api import labels as L
+        from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import (
+            SimNode,
+        )
+        from karpenter_core_tpu.models.consolidation import frontier_core
+
+        catalog = _catalog()
+        nodes = [
+            SimNode(
+                name=f"n{i}",
+                labels={
+                    L.LABEL_ARCH: "amd64",
+                    L.LABEL_OS: "linux",
+                    L.LABEL_TOPOLOGY_ZONE: "zone-a",
+                    L.NODEPOOL_LABEL_KEY: "default",
+                    L.LABEL_INSTANCE_TYPE: catalog[5].name,
+                },
+                taints=[],
+                available={"cpu": 7.0, "memory": 14 * GIB, "pods": 200.0},
+                capacity={"cpu": 8.0, "memory": 16 * GIB, "pods": 210.0},
+            )
+            for i in range(12)
+        ]
+        cand, keep = nodes[:5], nodes[5:]
+        cand_pods = [
+            [make_pod(cpu=0.25, name=f"c{i}-{j}") for j in range(2)]
+            for i in range(5)
+        ]
+        args = ([make_nodepool()], {"default": catalog}, cand, keep, [], [])
+        f1 = frontier_core(*args, cand_pods, max_slots=64, devices=1)
+        f8 = frontier_core(*args, cand_pods, max_slots=64, devices=N_DEVICES)
+        assert f1 is not None and len(f1) == 5
+        assert f1 == f8

@@ -12,6 +12,12 @@ GL101 jit-host-sync        — host-sync calls inside a traced region
 GL102 jit-tracer-branch    — Python branching on (non-static) tracer values
 GL103 jit-state-no-donate  — jit entry points that carry slot-state
                              without donate_argnums
+GL104 slotstate-unsharded  — sharding-unaware device placement
+                             (single-arg jax.device_put) in a module that
+                             drives a SlotState jit entry: placement must
+                             route through parallel.mesh.slot_shardings /
+                             an explicit sharding, or the multi-device
+                             path silently degrades to replicated copies
 """
 from __future__ import annotations
 
@@ -376,6 +382,67 @@ def _carries_slot_state(fn) -> Optional[str]:
         ):
             return p.arg
     return None
+
+
+# SlotState jit entries: defined in ops/ffd.py, called from models/ and
+# the bench/test harnesses. A module both (a) reaching one of these and
+# (b) device_put-ting without a sharding is a call site that bypasses
+# parallel.mesh.slot_shardings — on a multi-device mesh the un-annotated
+# copy lands single-device/replicated and every kernel input must be
+# resharded per dispatch.
+_SLOTSTATE_JIT_ENTRIES = {
+    "ffd_solve",
+    "ffd_solve_donated",
+    "_prefix_scan",
+}
+
+
+def _reaches_slotstate_entry(pf: ParsedFile, idx: _ModuleIndex) -> bool:
+    """Module calls a known SlotState jit entry, or defines a jit entry
+    carrying SlotState itself (ops/ffd.py-shaped modules)."""
+    for call in pf.walk(ast.Call):
+        name = dotted_name(call.func)
+        if name.rsplit(".", 1)[-1] in _SLOTSTATE_JIT_ENTRIES:
+            return True
+    for _site, target, _kw in idx.jit_sites:
+        if _carries_slot_state(target) is not None:
+            return True
+    return False
+
+
+@register
+class SlotStateUnshardedPut(Rule):
+    id = "GL104"
+    name = "slotstate-unsharded-deviceput"
+    rationale = (
+        "a bare jax.device_put(x) (no sharding argument) in a module that"
+        " drives a SlotState jit entry bypasses parallel.mesh"
+        ".slot_shardings — on a multi-device mesh the copy lands"
+        " unannotated and the kernel pays a reshard per dispatch"
+    )
+
+    def applies(self, pf: ParsedFile) -> bool:
+        return _accel_file(pf)
+
+    def check(self, pf: ParsedFile):
+        idx = _index(pf)
+        if not _reaches_slotstate_entry(pf, idx):
+            return
+        for node in pf.walk(ast.Call):
+            name = dotted_name(node.func)
+            if name not in ("jax.device_put", "device_put"):
+                continue
+            # a second positional arg or a device=/... keyword carries the
+            # placement decision; a bare single-arg put does not
+            if len(node.args) >= 2 or node.keywords:
+                continue
+            yield self.finding(
+                pf, node,
+                "jax.device_put without a sharding in a SlotState solve"
+                " module — place slot-axis arrays via parallel.mesh"
+                ".slot_shardings (or an explicit NamedSharding) so the"
+                " multi-device path stays pre-sharded",
+            )
 
 
 @register
