@@ -84,6 +84,10 @@ def prefix_batches(
     P = len(candidate_pods)
     C = len(prep.classes)
 
+    # graftlint: disable=GL503 -- the sweep's scheduler is constructed
+    # devices=1 (frontier_core shards the PREFIX axis, never the slot
+    # axis), so this is a single-device fetch of one [N] int8 plane per
+    # sweep — not a cross-device gather
     base_kind = np.asarray(prep.init_state.kind)
     kind_batch = np.tile(base_kind, (P, 1))
     for p in range(P):

@@ -374,7 +374,10 @@ class DeviceScheduler:
         the mesh, so the fingerprint-keyed prepared-state caches hold
         sharded device copies and a steady-state re-solve stays
         hit-for-hit with zero re-placement. Per-device h2d bytes scale
-        1/devices for these planes — the whole point of the slot mesh."""
+        1/devices for these planes — the whole point of the slot mesh.
+        graftlint GL501 resolves SlotState placement through this helper
+        interprocedurally (and GL503 flags host gathers of what it
+        placed), so state that bypasses it fails the lint at edit time."""
         self._h2d_bytes += a.nbytes
         if self._mesh is None:
             self._h2d_dev_bytes += a.nbytes

@@ -66,6 +66,9 @@ RANK_NONE = 1 << 30
 
 
 class SlotState(NamedTuple):
+    # adding a field? classify its slot-axis placement in
+    # parallel/mesh.SLOT_STATE_SPECS — graftlint GL502 holds the two
+    # field sets in lockstep at edit time
     valmask: jax.Array  # [N, K, V] bool — intersected allowed values
     defines: jax.Array  # [N, K] bool
     complement: jax.Array  # [N, K] bool (AND of contributors)

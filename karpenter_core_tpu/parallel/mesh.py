@@ -21,6 +21,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # carrying the slot axis (sharded over the mesh), or None (replicated).
 # zcount is [Gz, V] label-group count state and the head scalars ride the
 # scan carry on every device; everything else leads with [N, ...].
+# Field-set parity with the SlotState definition is machine-checked at
+# edit time (graftlint GL502) on top of the runtime raise below.
 SLOT_STATE_SPECS = {
     "valmask": 0,
     "defines": 0,

@@ -10,6 +10,13 @@ arrays ride npz, the vocab/metadata ride JSON. A Go (or any) client can
 produce the same layout; the in-process path simply skips the codec.
 The solverd section below extends the same container to the FULL
 scheduler input/output (solve problems, results, consolidation sweeps).
+
+The field set of every encoder here is FROZEN per wire version in
+tools/graftlint/wire_schema.lock.json (graftlint GL403): changing a
+payload's fields without bumping the governing version constant fails
+the lint. Codec-PR workflow: edit, bump SNAPSHOT_WIRE_VERSION /
+SOLVE_WIRE_VERSION, run `python -m tools.graftlint --update-wire-lock`,
+commit the regenerated lock alongside.
 """
 from __future__ import annotations
 

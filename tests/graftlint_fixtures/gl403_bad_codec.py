@@ -1,0 +1,32 @@
+# GL403 bad: the encoder grew a `priority` wire field (and its decode
+# twin, so GL401 is satisfied) but SOLVE_WIRE_VERSION stayed at 2 — the
+# sidecar lock (gl403_bad_codec.lock.json) still records the v2 field
+# set without `priority`, so an old peer on the SAME version number
+# silently drops the field. GL403 requires the bump. Lint corpus only —
+# never imported.
+import json
+
+SOLVE_WIRE_VERSION = 2
+
+
+def encode_solve_request(pods, max_slots, tenant, priority):
+    header = {
+        "version": SOLVE_WIRE_VERSION,
+        "pods": pods,
+        "max_slots": max_slots,
+        "tenant": tenant,
+        "priority": priority,  # new wire field, no version bump: GL403
+    }
+    return json.dumps(header).encode()
+
+
+def decode_solve_request(data):
+    h = json.loads(data.decode())
+    if h["version"] != SOLVE_WIRE_VERSION:
+        raise ValueError("unsupported solve wire version")
+    return {
+        "pods": h["pods"],
+        "max_slots": h["max_slots"],
+        "tenant": h["tenant"],
+        "priority": h["priority"],
+    }

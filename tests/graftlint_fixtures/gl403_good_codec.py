@@ -1,0 +1,32 @@
+# GL403 good: the same `priority` field addition done right — the wire
+# version bumped to 3 and the sidecar lock (gl403_good_codec.lock.json)
+# was regenerated with `--update-wire-lock`, so lock, version constant,
+# and field set agree. A mixed deployment now fails EXPLICITLY on the
+# version check instead of silently dropping the field. Lint corpus only
+# — never imported.
+import json
+
+SOLVE_WIRE_VERSION = 3
+
+
+def encode_solve_request(pods, max_slots, tenant, priority):
+    header = {
+        "version": SOLVE_WIRE_VERSION,
+        "pods": pods,
+        "max_slots": max_slots,
+        "tenant": tenant,
+        "priority": priority,
+    }
+    return json.dumps(header).encode()
+
+
+def decode_solve_request(data):
+    h = json.loads(data.decode())
+    if h["version"] != SOLVE_WIRE_VERSION:
+        raise ValueError("unsupported solve wire version")
+    return {
+        "pods": h["pods"],
+        "max_slots": h["max_slots"],
+        "tenant": h["tenant"],
+        "priority": h["priority"],
+    }

@@ -1,0 +1,22 @@
+# GL502 bad: the SlotState definition and SLOT_STATE_SPECS have drifted
+# in BOTH directions — `overflow` was added to the state without a
+# placement classification, and the spec table still names a `retired`
+# field the state no longer carries. Today this is a runtime raise on the
+# first multi-device solve; GL502 makes it an edit-time lint error. Lint
+# corpus only — never imported.
+from typing import NamedTuple
+
+import jax
+
+
+class SlotState(NamedTuple):
+    valmask: jax.Array  # [N, K, V]
+    kind: jax.Array  # [N]
+    overflow: jax.Array  # [] — missing from SLOT_STATE_SPECS: GL502
+
+
+SLOT_STATE_SPECS = {
+    "valmask": 0,
+    "kind": 0,
+    "retired": None,  # stale: not a SlotState field any more: GL502
+}

@@ -1,0 +1,20 @@
+# GL502 good: every SlotState field has exactly one SLOT_STATE_SPECS
+# entry classifying its slot-axis placement (a dim index to shard, None
+# to replicate) — the state definition and the sharding table in
+# lockstep. Lint corpus only — never imported.
+from typing import NamedTuple
+
+import jax
+
+
+class SlotState(NamedTuple):
+    valmask: jax.Array  # [N, K, V]
+    kind: jax.Array  # [N]
+    overflow: jax.Array  # [] scalar, rides the carry on every device
+
+
+SLOT_STATE_SPECS = {
+    "valmask": 0,
+    "kind": 0,
+    "overflow": None,
+}

@@ -1,0 +1,22 @@
+# GL501 bad: a DeviceScheduler-shaped solve path hands a SlotState jit
+# entry state built straight from host numpy — nothing in its dataflow
+# ever routed through parallel.mesh placement (slot_shardings /
+# axis_sharding / batch_sharding or an explicit device_put sharding), so
+# the SPMD solve compiles against absent shardings and silently degrades
+# to replicated copies. Lint corpus only — never imported.
+import numpy as np
+
+from karpenter_core_tpu.ops.ffd import SlotState, ffd_solve_donated
+
+
+class DeviceScheduler:
+    def _make_init_state(self, n_slots, k, v):
+        # every plane is host numpy: provenance {host}, never placed
+        return SlotState(
+            valmask=np.ones((n_slots, k, v), dtype=bool),
+            kind=np.zeros((n_slots,), dtype=np.int8),
+        )
+
+    def solve(self, steps, statics, n_slots, k, v):
+        state = self._make_init_state(n_slots, k, v)
+        return ffd_solve_donated(state, steps, statics)  # GL501

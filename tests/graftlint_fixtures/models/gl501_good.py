@@ -1,0 +1,36 @@
+# GL501 good: both sanctioned routings. The DeviceScheduler shape places
+# every slot-axis plane through a _dev_slots helper that resolves (one
+# call away) to parallel.mesh.axis_sharding; the frontier_core shape
+# commits the whole state with an explicit two-arg device_put placement.
+# Lint corpus only — never imported.
+import jax
+import numpy as np
+
+from karpenter_core_tpu.ops.ffd import SlotState, ffd_solve_donated
+from karpenter_core_tpu.parallel import mesh as pmesh
+
+
+class DeviceScheduler:
+    def __init__(self, mesh):
+        self._mesh = mesh
+
+    def _dev_slots(self, a):
+        return jax.device_put(a, pmesh.axis_sharding(self._mesh, a.ndim, 0))
+
+    def _make_init_state(self, n_slots, k, v):
+        return SlotState(
+            valmask=self._dev_slots(np.ones((n_slots, k, v), dtype=bool)),
+            kind=self._dev_slots(np.zeros((n_slots,), dtype=np.int8)),
+        )
+
+    def solve(self, steps, statics, n_slots, k, v):
+        state = self._make_init_state(n_slots, k, v)
+        return ffd_solve_donated(state, steps, statics)
+
+
+def frontier_core(init_state_np, steps, statics, mesh):
+    repl = pmesh.replicated(mesh)
+    state = jax.device_put(
+        init_state_np, jax.tree.map(lambda _: repl, init_state_np)
+    )
+    return ffd_solve_donated(state, steps, statics)

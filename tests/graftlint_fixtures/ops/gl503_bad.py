@@ -1,0 +1,24 @@
+# GL503 bad: four host materializations of slot-sharded values, each an
+# implicit cross-device gather (or unannotated placement) on a real mesh:
+# np.asarray of a sharded plane, a scalar int() concretization, a
+# per-shard .addressable_data read, and the bare single-arg
+# jax.device_put the retired GL104 used to catch. Lint corpus only —
+# never imported.
+import jax
+import numpy as np
+
+from karpenter_core_tpu.ops.ffd import ffd_solve
+from karpenter_core_tpu.parallel import mesh as pmesh
+
+
+def fetch_planes(mesh, plane_np):
+    plane = jax.device_put(plane_np, pmesh.axis_sharding(mesh, 2, 0))
+    host = np.asarray(plane)  # GL503: full gather
+    head = int(plane[0, 0])  # GL503: scalar concretization
+    shard0 = plane.addressable_data(0)  # GL503: per-shard host read
+    return host, head, shard0
+
+
+def run_solve(state_np, classes, statics):
+    state = jax.device_put(state_np)  # GL503: bare put (was GL104)
+    return ffd_solve(state, classes, statics)

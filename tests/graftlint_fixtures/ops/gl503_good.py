@@ -1,0 +1,25 @@
+# GL503 good: the sanctioned shapes. Host code fetches through
+# jax.device_get on a sliced window (the transfer is explicit and sized),
+# scalars concretize from the fetched host copy, and placement carries an
+# explicit sharding so the multi-device path stays pre-sharded. Lint
+# corpus only — never imported.
+import jax
+import numpy as np
+
+from karpenter_core_tpu.ops.ffd import ffd_solve
+from karpenter_core_tpu.parallel import mesh as pmesh
+
+
+def fetch_planes(mesh, plane_np, used):
+    plane = jax.device_put(plane_np, pmesh.axis_sharding(mesh, 2, 0))
+    window = jax.device_get(plane[:used])  # explicit, windowed fetch
+    host = np.asarray(window)
+    head = int(window[0, 0])
+    return host, head
+
+
+def run_solve(mesh, state_np, classes, statics, n_slots):
+    state = jax.device_put(
+        state_np, pmesh.slot_shardings(mesh, state_np, n_slots)
+    )
+    return ffd_solve(state, classes, statics)

@@ -1,0 +1,13 @@
+# GL504 bad: hand-rolled slot-axis shape arithmetic over the device
+# count — truncating floor-division sizing, a modulo remainder split, and
+# a reshape that folds a device axis in front of the slot dim. All three
+# work only while the slot count happens to divide the mesh; on any other
+# device count they truncate or crash where parallel.mesh.pad_to_devices
+# pads with inert slots. Lint corpus only — never imported.
+
+
+def shard_by_hand(x, max_slots, n_devices):
+    n = (max_slots // n_devices) * n_devices  # GL504: truncates
+    folded = x.reshape(n_devices, -1)  # GL504: manual device fold
+    tail = max_slots % n_devices  # GL504: remainder split
+    return folded, x[:n], tail

@@ -68,15 +68,18 @@ def test_tree_is_clean():
 
 
 def test_rule_inventory():
-    """At least 8 rules across the four invariant families."""
+    """At least 13 rules across the five invariant families."""
     run([str(FIXTURES / "gl000_good.py")])  # force registration
     ids = set(RULES)
-    assert len(ids) >= 8, f"only {len(ids)} rules registered: {sorted(ids)}"
+    assert len(ids) >= 13, f"only {len(ids)} rules registered: {sorted(ids)}"
     families = {rid[:3] for rid in ids if rid != "GL000"}
-    assert {"GL1", "GL2", "GL3", "GL4"} <= families, (
+    assert {"GL1", "GL2", "GL3", "GL4", "GL5"} <= families, (
         "expected jax-purity (GL1xx), determinism (GL2xx), concurrency"
-        f" (GL3xx) and parity (GL4xx) families, got {sorted(families)}"
+        " (GL3xx), parity (GL4xx) and shardcheck (GL5xx) families,"
+        f" got {sorted(families)}"
     )
+    assert "GL104" not in ids, "GL104 was retired into GL503 (shardcheck)"
+    assert {"GL403", "GL501", "GL502", "GL503", "GL504"} <= ids
 
 
 def test_baseline_is_frozen_empty():
@@ -179,3 +182,498 @@ def test_repo_paths_resolve_relative_to_root():
     """The default path works no matter the CWD (engine anchors on the
     repo root, so CI and `python -m` from anywhere agree)."""
     assert (REPO_ROOT / "karpenter_core_tpu").is_dir()
+
+
+# -- wire-schema lock mechanics (GL403) ------------------------------------
+
+
+_MINI_CODEC = '''\
+import json
+
+SOLVE_WIRE_VERSION = {version}
+
+
+def encode_solve_request(pods, max_slots{extra_param}):
+    header = {{
+        "version": SOLVE_WIRE_VERSION,
+        "pods": pods,
+        "max_slots": max_slots,{extra_field}
+    }}
+    return json.dumps(header).encode()
+
+
+def decode_solve_request(data):
+    h = json.loads(data.decode())
+    return {{"pods": h["pods"], "max_slots": h["max_slots"]{extra_read}}}
+'''
+
+
+def _mini_codec(version=2, with_priority=False):
+    return _MINI_CODEC.format(
+        version=version,
+        extra_param=", priority" if with_priority else "",
+        extra_field='\n        "priority": priority,' if with_priority else "",
+        extra_read=', "priority": h["priority"]' if with_priority else "",
+    )
+
+
+def _codec_fixture(tmp_path, source, name="gl403_tmp_codec.py"):
+    d = tmp_path / "graftlint_fixtures"
+    d.mkdir(exist_ok=True)
+    p = d / name
+    p.write_text(source)
+    return p, p.with_name(p.stem + ".lock.json")
+
+
+def test_wire_lock_field_added_without_bump_fails(tmp_path):
+    from tools.graftlint.rules.parity import update_wire_lock
+
+    p, lock = _codec_fixture(tmp_path, _mini_codec(version=2))
+    update_wire_lock(codec_path=p, lock_path=lock)
+    clean = run([str(p)], use_baseline=False, rule_ids=["GL403"])
+    assert clean.ok
+
+    # grow the field set, keep the version: GL403 must fail the lint
+    p.write_text(_mini_codec(version=2, with_priority=True))
+    grown = run([str(p)], use_baseline=False, rule_ids=["GL403"])
+    assert len(grown.new) == 1
+    assert "without a SOLVE_WIRE_VERSION bump" in grown.new[0][0].message
+    assert "priority" in grown.new[0][0].message
+
+
+def test_wire_lock_bump_plus_regen_passes(tmp_path):
+    from tools.graftlint.rules.parity import update_wire_lock
+
+    p, lock = _codec_fixture(tmp_path, _mini_codec(version=2))
+    update_wire_lock(codec_path=p, lock_path=lock)
+
+    # bump alone (stale lock) still fails — the lock must be regenerated
+    p.write_text(_mini_codec(version=3, with_priority=True))
+    stale = run([str(p)], use_baseline=False, rule_ids=["GL403"])
+    assert not stale.ok
+    assert any("stale" in f.message for f, _ in stale.new)
+
+    update_wire_lock(codec_path=p, lock_path=lock)
+    again = run([str(p)], use_baseline=False, rule_ids=["GL403"])
+    assert again.ok, [f.render() for f, _ in again.new]
+
+
+def test_update_wire_lock_refuses_unbumped_change(tmp_path):
+    """--update-wire-lock enforces the bump: it must never absorb an
+    unversioned field-set change into the lock."""
+    from tools.graftlint.rules.parity import update_wire_lock
+
+    p, lock = _codec_fixture(tmp_path, _mini_codec(version=2))
+    update_wire_lock(codec_path=p, lock_path=lock)
+    p.write_text(_mini_codec(version=2, with_priority=True))
+    with pytest.raises(SystemExit, match="without a version bump"):
+        update_wire_lock(codec_path=p, lock_path=lock)
+    # after bumping, the regeneration goes through
+    p.write_text(_mini_codec(version=3, with_priority=True))
+    n = update_wire_lock(codec_path=p, lock_path=lock)
+    assert n == 1
+    data = json.loads(lock.read_text())
+    assert data["versions"]["SOLVE_WIRE_VERSION"] == 3
+    assert "priority" in data["encoders"]["encode_solve_request"]["fields"]
+
+
+def test_real_codec_matches_committed_lock():
+    """The committed lock and solver/codec.py agree — the moment a codec
+    PR changes a field set, this (and the tree gate) forces the version
+    bump + `--update-wire-lock` ritual."""
+    result = run(
+        ["karpenter_core_tpu/solver/codec.py"],
+        use_baseline=False,
+        rule_ids=["GL403"],
+    )
+    assert result.ok, "\n".join(f.render() for f, _ in result.new)
+
+
+def test_wire_lock_extraction_expands_mask_helper():
+    """The one-level interprocedural expansion: _masks_to_arrays'
+    f-string keys land in encode_request's locked field set."""
+    from tools.graftlint.engine import ParsedFile
+    from tools.graftlint.rules.parity import CODEC_PATH, extract_wire_schema
+
+    pf = ParsedFile(CODEC_PATH, "solver/codec.py", CODEC_PATH.read_text())
+    schema = extract_wire_schema(pf)
+    fields = set(schema["encoders"]["encode_request"]["fields"])
+    assert {"class_mask", "class_gt", "it_mask", "it_negative"} <= fields
+    assert schema["encoders"]["encode_request"]["versioned_by"] == [
+        "SNAPSHOT_WIRE_VERSION"
+    ]
+    # private helpers are locked too, attributed through the call graph
+    assert schema["encoders"]["_encode_sim_node"]["versioned_by"] == [
+        "SOLVE_WIRE_VERSION"
+    ]
+
+
+# -- incremental cache + parallel lint -------------------------------------
+
+
+def test_incremental_cache_hits_and_matches(tmp_path):
+    cache = tmp_path / "cache.json"
+    cold = run([str(FIXTURES / "ops")], use_baseline=False, cache_path=cache)
+    assert cold.cache_hits == 0 and cold.cache_misses == cold.files
+    warm = run([str(FIXTURES / "ops")], use_baseline=False, cache_path=cache)
+    assert warm.cache_hits == warm.files and warm.cache_misses == 0
+    assert [(f, s) for f, s in warm.new] == [(f, s) for f, s in cold.new]
+    assert [f for f in warm.suppressed] == [f for f in cold.suppressed]
+
+
+def test_incremental_cache_busts_on_rule_change(tmp_path, monkeypatch):
+    import tools.graftlint.engine as engine
+
+    cache = tmp_path / "cache.json"
+    run([str(FIXTURES / "ops")], use_baseline=False, cache_path=cache)
+    # any rule-implementation change flips the rule-set hash and must
+    # invalidate every cached entry
+    monkeypatch.setattr(engine, "_rules_hash", lambda: "different")
+    busted = run([str(FIXTURES / "ops")], use_baseline=False, cache_path=cache)
+    assert busted.cache_hits == 0 and busted.cache_misses == busted.files
+
+
+def test_incremental_cache_busts_on_content_change(tmp_path):
+    d = tmp_path / "graftlint_fixtures"
+    d.mkdir()
+    f = d / "gl201_edit.py"
+    f.write_text((FIXTURES / "gl201_good.py").read_text())
+    cache = tmp_path / "cache.json"
+    run([str(d)], use_baseline=False, cache_path=cache)
+    f.write_text((FIXTURES / "gl201_bad.py").read_text())
+    changed = run([str(d)], use_baseline=False, cache_path=cache)
+    assert changed.cache_misses == 1
+    assert changed.new, "edited file must re-lint, not serve stale results"
+
+
+def test_rule_restricted_runs_bypass_cache(tmp_path):
+    cache = tmp_path / "cache.json"
+    result = run(
+        [str(FIXTURES / "gl201_bad.py")],
+        use_baseline=False,
+        rule_ids=["GL201"],
+        cache_path=cache,
+    )
+    assert result.cache_hits == 0 and result.cache_misses == 0
+    assert not cache.exists()
+
+
+def test_jobs_parallel_matches_serial():
+    serial = run([str(FIXTURES)], use_baseline=False)
+    parallel = run([str(FIXTURES)], use_baseline=False, jobs=2)
+    assert [(f, s) for f, s in parallel.new] == [(f, s) for f, s in serial.new]
+    assert parallel.suppressed == serial.suppressed
+
+
+# -- machine-readable output -----------------------------------------------
+
+
+def test_json_format_stable_ids(capsys):
+    from tools.graftlint.engine import main
+
+    rc = main(
+        [str(FIXTURES / "gl201_bad.py"), "--rule", "GL201", "--format", "json"]
+    )
+    out1 = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out1["schema"] == "graftlint-json/1"
+    assert out1["findings"], "bad fixture must produce findings"
+    for f in out1["findings"]:
+        assert set(f) == {"id", "rule", "path", "line", "message"}
+    # ids are content-addressed: a second run yields identical ids
+    main([str(FIXTURES / "gl201_bad.py"), "--rule", "GL201", "--format", "json"])
+    out2 = json.loads(capsys.readouterr().out)
+    assert [f["id"] for f in out1["findings"]] == [
+        f["id"] for f in out2["findings"]
+    ]
+    assert len({f["id"] for f in out1["findings"]}) == len(out1["findings"])
+
+
+def test_sarif_format_shape(capsys):
+    from tools.graftlint.engine import main
+
+    rc = main(
+        [str(FIXTURES / "gl201_bad.py"), "--rule", "GL201", "--format", "sarif"]
+    )
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    run_ = doc["runs"][0]
+    assert run_["tool"]["driver"]["name"] == "graftlint"
+    assert {r["id"] for r in run_["tool"]["driver"]["rules"]} == {"GL201"}
+    for res in run_["results"]:
+        assert res["ruleId"] == "GL201"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("gl201_bad.py")
+        assert loc["region"]["startLine"] >= 1
+        assert res["partialFingerprints"]["graftlint/v1"]
+
+
+def test_text_format_unchanged_default(capsys):
+    from tools.graftlint.engine import main
+
+    rc = main([str(FIXTURES / "gl201_bad.py"), "--rule", "GL201"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "GL201" in out and "graftlint:" in out
+    assert not out.lstrip().startswith("{"), "text stays the default format"
+
+
+# -- shardcheck pins against the real tree ---------------------------------
+
+
+def test_slotstate_specs_match_state_fields():
+    """The GL502 property, pinned at runtime against the real modules:
+    SLOT_STATE_SPECS classifies exactly the SlotState fields."""
+    from karpenter_core_tpu.ops.ffd import SlotState
+    from karpenter_core_tpu.parallel.mesh import SLOT_STATE_SPECS
+
+    assert set(SlotState._fields) == set(SLOT_STATE_SPECS)
+
+
+def test_shardcheck_clean_on_solve_path():
+    """GL501/GL503: the production solve path (models/, ops/, parallel/)
+    satisfies the pre-sharded-placement invariant with all shardcheck
+    rules enabled."""
+    result = run(
+        [
+            "karpenter_core_tpu/models",
+            "karpenter_core_tpu/ops",
+            "karpenter_core_tpu/parallel",
+        ],
+        use_baseline=False,
+        rule_ids=["GL501", "GL502", "GL503", "GL504"],
+    )
+    assert result.ok, "\n".join(f.render() for f, _ in result.new)
+
+
+# -- review-hardening regressions ------------------------------------------
+
+
+def test_update_wire_lock_refuses_unbumped_add_and_remove(tmp_path):
+    """Encoder ADDITION and REMOVAL are schema changes too: the update
+    must refuse both without a bump, not silently absorb them."""
+    from tools.graftlint.rules.parity import update_wire_lock
+
+    p, lock = _codec_fixture(tmp_path, _mini_codec(version=2))
+    update_wire_lock(codec_path=p, lock_path=lock)
+
+    p.write_text(
+        _mini_codec(version=2)
+        + '\n\ndef encode_extra(x):\n'
+        '    return {"version": SOLVE_WIRE_VERSION, "x": x}\n'
+    )
+    with pytest.raises(SystemExit, match="new encoder"):
+        update_wire_lock(codec_path=p, lock_path=lock)
+
+    p.write_text("SOLVE_WIRE_VERSION = 2\n")
+    with pytest.raises(SystemExit, match="removed encoder"):
+        update_wire_lock(codec_path=p, lock_path=lock)
+
+    # with the bump, both go through
+    p.write_text(
+        _mini_codec(version=3)
+        + '\n\ndef encode_extra(x):\n'
+        '    return {"version": SOLVE_WIRE_VERSION, "x": x}\n'
+    )
+    assert update_wire_lock(codec_path=p, lock_path=lock) == 2
+
+
+def test_gl503_mixed_host_attr_name_stays_silent(tmp_path):
+    """The attribute-summary fallback joins same-named stores project-
+    wide; a name that ALSO carries host stores must not flag — ambiguity
+    degrades to silence, never noise (tier-1 gates on zero findings)."""
+    d = tmp_path / "ops"
+    d.mkdir()
+    (d / "sharded_store.py").write_text(
+        "import jax\n"
+        "from karpenter_core_tpu.parallel import mesh as pmesh\n\n\n"
+        "class Prep:\n"
+        "    pass\n\n\n"
+        "def build(mesh, x):\n"
+        "    return Prep(init_state=jax.device_put("
+        "x, pmesh.axis_sharding(mesh, 2, 0)))\n"
+    )
+    (d / "host_reuse.py").write_text(
+        "import numpy as np\n\n\n"
+        "class HostPlan:\n"
+        "    def __init__(self):\n"
+        "        self.init_state = np.zeros(4)\n\n\n"
+        "def use(plan):\n"
+        "    return np.asarray(plan.init_state)\n"
+    )
+    result = run([str(d)], use_baseline=False, rule_ids=["GL503"])
+    assert result.ok, "\n".join(f.render() for f, _ in result.new)
+
+    # the UNAMBIGUOUS shape (no host store anywhere) still fires — the
+    # consolidation.py prefix_batches pattern the justified suppression
+    # covers
+    (d / "host_reuse.py").write_text(
+        "import numpy as np\n\n\n"
+        "def use(plan):\n"
+        "    return np.asarray(plan.init_state)\n"
+    )
+    result = run([str(d)], use_baseline=False, rule_ids=["GL503"])
+    assert len(result.new) == 1
+    assert "implicit full gather" in result.new[0][0].message
+
+
+def test_gl503_fires_on_module_defining_own_entry(tmp_path):
+    """The retired GL104's second trigger, carried over: a module that
+    DEFINES its own SlotState-carrying jit entry (not just one calling
+    ffd_solve) is still policed for bare device_put placement."""
+    d = tmp_path / "ops"
+    d.mkdir()
+    f = d / "own_entry.py"
+    f.write_text(
+        "import jax\n\n\n"
+        "@jax.jit\n"
+        "def topo_solve(state, weights):\n"
+        "    return state\n\n\n"
+        "def drive(state_np, weights):\n"
+        "    return topo_solve(jax.device_put(state_np), weights)\n"
+    )
+    result = run([str(f)], use_baseline=False, rule_ids=["GL503"])
+    assert len(result.new) == 1
+    assert "was GL104" in result.new[0][0].message
+
+
+def test_incremental_cache_survives_subset_runs(tmp_path):
+    """A subset-path run must merge into the cache, not evict the
+    entries it didn't scan — or every partial lint destroys the warm
+    full-tree hit rate."""
+    cache = tmp_path / "cache.json"
+    full = run([str(FIXTURES)], use_baseline=False, cache_path=cache)
+    subset = run(
+        [str(FIXTURES / "ops")], use_baseline=False, cache_path=cache
+    )
+    assert subset.cache_hits == subset.files
+    again = run([str(FIXTURES)], use_baseline=False, cache_path=cache)
+    assert again.cache_hits == full.files and again.cache_misses == 0
+
+
+def test_gl501_off_path_helper_not_flagged(tmp_path):
+    """GL501's documented scope: only call sites reachable from
+    DeviceScheduler/frontier_core. An off-path models/ helper
+    deliberately driving a single-device solve stays silent."""
+    d = tmp_path / "models"
+    d.mkdir()
+    f = d / "off_path.py"
+    f.write_text(
+        "import numpy as np\n"
+        "from karpenter_core_tpu.ops.ffd import SlotState, ffd_solve\n\n\n"
+        "def debug_single_device_solve(steps, statics):\n"
+        "    state = SlotState(kind=np.zeros(4, dtype=np.int8))\n"
+        "    return ffd_solve(state, steps, statics)\n"
+    )
+    result = run([str(f)], use_baseline=False, rule_ids=["GL501"])
+    assert result.ok, "\n".join(fi.render() for fi, _ in result.new)
+
+    # the same host-built state INSIDE DeviceScheduler is on-path: flagged
+    f.write_text(
+        "import numpy as np\n"
+        "from karpenter_core_tpu.ops.ffd import SlotState, ffd_solve\n\n\n"
+        "class DeviceScheduler:\n"
+        "    def _helper(self, steps, statics):\n"
+        "        state = SlotState(kind=np.zeros(4, dtype=np.int8))\n"
+        "        return ffd_solve(state, steps, statics)\n"
+    )
+    result = run([str(f)], use_baseline=False, rule_ids=["GL501"])
+    assert len(result.new) == 1
+
+
+def test_dataflow_queries_survive_reparse():
+    """The dataflow index is content-hash cached across run() calls while
+    every run hands it freshly parsed AST nodes — queries on the new
+    nodes must resolve correctly (memo keys retain their nodes; a
+    recycled id() must never alias a dead entry)."""
+    import gc
+
+    for _ in range(3):
+        result = run(
+            ["karpenter_core_tpu/models", "karpenter_core_tpu/ops",
+             "karpenter_core_tpu/parallel"],
+            use_baseline=False,
+            rule_ids=["GL501", "GL503"],
+        )
+        assert result.ok, "\n".join(f.render() for f, _ in result.new)
+        gc.collect()  # free the run's parse; the next run re-parses
+
+
+def test_cache_ignores_out_of_repo_paths_and_prunes_dead_entries(tmp_path):
+    d = tmp_path / "graftlint_fixtures"
+    d.mkdir()
+    (d / "outside.py").write_text("x = 1\n")
+    cache = tmp_path / "cache.json"
+    # seed the cache with an entry for a repo file that no longer exists
+    cache.write_text(json.dumps({
+        "karpenter_core_tpu/gone_forever.py": {
+            "key": "stale", "new": [], "suppressed": []
+        }
+    }))
+    result = run([str(d)], use_baseline=False, cache_path=cache)
+    assert result.cache_hits == 0 and result.cache_misses == 1
+    data = json.loads(cache.read_text())
+    assert data == {}, (
+        "out-of-repo paths must not be absorbed and dead entries must"
+        f" be pruned, got {sorted(data)}"
+    )
+
+
+def test_gl501_keyword_state_call_still_flagged(tmp_path):
+    """A keyword-style entry call (`ffd_solve(state=...)`) must not
+    disarm GL501."""
+    d = tmp_path / "models"
+    d.mkdir()
+    f = d / "kw_call.py"
+    f.write_text(
+        "import numpy as np\n"
+        "from karpenter_core_tpu.ops.ffd import SlotState, ffd_solve\n\n\n"
+        "class DeviceScheduler:\n"
+        "    def solve(self, steps, statics):\n"
+        "        st = SlotState(kind=np.zeros(4, dtype=np.int8))\n"
+        "        return ffd_solve(state=st, classes=steps, statics=statics)\n"
+    )
+    result = run([str(f)], use_baseline=False, rule_ids=["GL501"])
+    assert len(result.new) == 1
+
+
+def test_gl503_skips_call_form_jit_interior(tmp_path):
+    """The traced-interior exclusion covers call-form jit wrapping
+    (`solve = jax.jit(_impl)`), not just decorators — that interior is
+    GL101's territory, and GL503 must not double-report there."""
+    d = tmp_path / "ops"
+    d.mkdir()
+    f = d / "call_form.py"
+    f.write_text(
+        "import jax\n"
+        "import numpy as np\n"
+        "from karpenter_core_tpu.parallel import mesh as pmesh\n\n\n"
+        "def _impl(plane, mesh):\n"
+        "    sharded = jax.device_put(plane, pmesh.axis_sharding(mesh, 2, 0))\n"
+        "    return np.asarray(sharded)\n\n\n"
+        "solve = jax.jit(_impl)\n"
+    )
+    result = run([str(f)], use_baseline=False, rule_ids=["GL503"])
+    assert result.ok, "\n".join(fi.render() for fi, _ in result.new)
+
+
+def test_dataflow_memo_does_not_grow_across_runs():
+    """prov() queries from later re-parses memoize under weak keys: once
+    the caller's parse is freed, the entries evict — repeated lint runs
+    in one process must not grow the cached index's memos (editor
+    integrations, the tier-1 gate)."""
+    import gc
+
+    from tools.graftlint import dataflow
+
+    paths = ["karpenter_core_tpu/models", "karpenter_core_tpu/ops",
+             "karpenter_core_tpu/parallel"]
+    run(paths, use_baseline=False, rule_ids=["GL501", "GL503"])
+    gc.collect()
+    sizes = []
+    for _ in range(3):
+        run(paths, use_baseline=False, rule_ids=["GL501", "GL503"])
+        gc.collect()
+        sizes.append(max(len(df._envs) for df in dataflow._CACHE.values()))
+    assert sizes[0] == sizes[-1], f"memo grew across runs: {sizes}"

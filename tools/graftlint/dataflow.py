@@ -1,0 +1,374 @@
+"""Interprocedural dataflow for graftlint: the sharding provenance lattice.
+
+PR 6 made pjit-over-the-slot-axis the production solve path. Its
+correctness contract is *placement discipline*: every SlotState plane must
+land on the device pre-sharded through ``parallel.mesh`` (slot_shardings /
+axis_sharding / batch_sharding), and host code must never materialize a
+slot-sharded plane wholesale (an implicit cross-device gather). Per-file
+AST matching cannot see that contract — the placement happens in
+``DeviceScheduler._dev_slots``, three calls away from the jit entry that
+consumes the state — so this module gives the GL5xx rules an
+interprocedural view:
+
+- a **project-wide call graph**: every def (functions and methods) indexed
+  by name across the scanned file set, with call resolution by dotted-name
+  tail (``self._dev_slots(...)`` resolves to every ``_dev_slots`` def);
+- a **provenance lattice** for array values, tags accumulated as a set::
+
+      HOST ──┐                 host memory (numpy, device_get results)
+      DEVICE ─┼─► value tags   on device, placement unannotated
+      REPL ──┤                 explicitly replicated over the mesh
+      SHARD ──┘                 routed through the slot-axis sharding API
+
+  ``PLACED = {REPL, SHARD}``. An empty tag set means "unknown" and is
+  never flagged — the analysis under-approximates: it only reports when
+  it can positively trace a value to its sources.
+- **function return summaries** (the provenance a call produces, joined
+  over every return site) and **attribute summaries** (keyword-constructed
+  pytree fields: ``_Prepared(init_state=self._make_init_state(...))``
+  records ``init_state -> {SHARD, ...}``), so a chain like
+
+      ffd_solve_donated(prep.init_state, ...)
+        <- _Prepared(init_state=...) <- _make_init_state
+        <- self._dev_slots <- jax.device_put(a, pmesh.axis_sharding(...))
+
+  resolves to SHARD across four hops and two classes.
+
+The whole index is built once per scanned file set and cached by content
+hash (every relpath + source digest), so repeated ``run()`` calls in one
+process — the tier-1 gate, bench.py --lint, editor integrations — pay the
+fixpoint once. Known over-approximations, deliberate and documented:
+attribute summaries are keyed by bare attribute name project-wide (not
+per-class), and call resolution is by name tail (not import graph). Both
+can only ADD tags, and every consumer flags on positive evidence, so the
+imprecision degrades to silence, not noise.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import weakref
+from typing import Dict, List, Optional, Set
+
+from tools.graftlint.engine import ParsedFile, dotted_name
+
+HOST = "host"
+DEVICE = "device"  # on device, placement unannotated
+REPL = "replicated"
+SHARD = "sharded"
+PLACED = frozenset({REPL, SHARD})
+
+# the sanctioned placement API (parallel/mesh.py): call tails that mint a
+# slot-axis sharding / an explicit replication
+_MESH_SHARDERS = {"slot_shardings", "axis_sharding", "batch_sharding"}
+_MESH_REPLICATORS = {"replicated"}
+
+_NP_PREFIXES = ("np.", "numpy.", "onp.")
+_JNP_PREFIXES = ("jnp.", "jax.numpy.")
+
+# array-metadata attributes: reading them yields host scalars/objects, not
+# the array — branching on .shape or accounting .nbytes is never a gather
+_METADATA_ATTRS = {
+    "shape", "ndim", "dtype", "nbytes", "size", "sharding", "itemsize",
+    "_fields",
+}
+
+_MAX_DEPTH = 6  # call-summary resolution depth cap
+_MAX_CANDIDATES = 6  # same-named defs considered per call
+
+
+def _content_key(files: List[ParsedFile]) -> str:
+    h = hashlib.sha256()
+    for pf in sorted(files, key=lambda p: p.relpath):
+        h.update(pf.relpath.encode())
+        h.update(hashlib.sha256(pf.source.encode()).digest())
+    return h.hexdigest()
+
+
+class ProjectDataflow:
+    """Provenance queries over one scanned file set. Use :func:`get`."""
+
+    def __init__(self, files: List[ParsedFile]):
+        self.files = files
+        # name -> [(pf, def node)] for every function/method in the project
+        self.defs: Dict[str, List] = {}
+        # class name -> ClassDef (constructor-call recognition)
+        self.classes: Dict[str, ast.ClassDef] = {}
+        for pf in files:
+            for node in pf.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+                self.defs.setdefault(node.name, []).append((pf, node))
+            for node in pf.walk(ast.ClassDef):
+                self.classes.setdefault(node.name, node)
+        # attribute name -> joined provenance of every recorded store
+        self.attr_summary: Dict[str, Set[str]] = {}
+        # memo keys are the AST NODES THEMSELVES (identity hash), held
+        # WEAKLY: an id() key would outlive its node (a recycled address
+        # then returns a different function's env), while a strong key
+        # would pin every later run's re-parsed tree forever (the index
+        # itself is process-cached by content hash). Weak keys give both
+        # properties: construction-time entries persist exactly as long
+        # as self.files retains their trees, and query-time entries from
+        # a caller's re-parse evict with that parse.
+        self._summaries = weakref.WeakKeyDictionary()
+        self._envs = weakref.WeakKeyDictionary()
+        self._in_progress: Set[int] = set()
+        # two eager passes: pass 1 populates attribute summaries from
+        # constructor calls and attribute stores everywhere; pass 2
+        # recomputes envs/summaries against the grown attr table so
+        # cross-module attribute reads (consolidation reading
+        # provisioner's _Prepared fields) see the final join
+        for _ in range(2):
+            self._summaries.clear()
+            self._envs.clear()
+            for pf in files:
+                self._env_for(pf, None)
+                for node in pf.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+                    self._env_for(pf, node)
+
+    # -- public query ------------------------------------------------------
+
+    def prov(self, pf: ParsedFile, expr: ast.AST, fn) -> frozenset:
+        """Provenance tag set of an expression evaluated in the local
+        environment of ``fn`` (None = module level)."""
+        env = self._env_for(pf, fn)
+        return frozenset(self._eval(pf, expr, env, _MAX_DEPTH))
+
+    # -- environments ------------------------------------------------------
+
+    def _env_for(self, pf: ParsedFile, fn) -> Dict[str, Set[str]]:
+        key = fn if fn is not None else pf.tree
+        cached = self._envs.get(key)
+        if cached is not None:
+            return cached
+        env: Dict[str, Set[str]] = {}
+        self._envs[key] = env  # pre-bind: cycles read the partial env
+        if isinstance(fn, ast.Lambda):
+            return env  # no statements, nothing to bind
+        body = pf.tree.body if fn is None else fn.body
+        self._walk_stmts(pf, body, env, _MAX_DEPTH)
+        return env
+
+    def _walk_stmts(self, pf, stmts, env, depth) -> None:
+        for st in stmts:
+            if isinstance(
+                st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue  # nested scopes own their env
+            if isinstance(st, ast.Assign):
+                p = self._eval(pf, st.value, env, depth)
+                for tgt in st.targets:
+                    self._bind(pf, tgt, st.value, p, env, depth)
+            elif isinstance(st, ast.AnnAssign) and st.value is not None:
+                p = self._eval(pf, st.value, env, depth)
+                self._bind(pf, st.target, st.value, p, env, depth)
+            elif isinstance(st, ast.AugAssign):
+                p = self._eval(pf, st.value, env, depth)
+                if isinstance(st.target, ast.Name):
+                    env.setdefault(st.target.id, set()).update(p)
+            elif isinstance(st, ast.For) or isinstance(st, ast.AsyncFor):
+                p = self._eval(pf, st.iter, env, depth)
+                self._bind(pf, st.target, st.iter, p, env, depth)
+                self._walk_stmts(pf, st.body, env, depth)
+                self._walk_stmts(pf, st.orelse, env, depth)
+            elif isinstance(st, (ast.If, ast.While)):
+                # both arms walked over one env: reassignment joins, the
+                # safe over-approximation for a branch-insensitive lattice
+                self._walk_stmts(pf, st.body, env, depth)
+                self._walk_stmts(pf, st.orelse, env, depth)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    if item.optional_vars is not None:
+                        p = self._eval(pf, item.context_expr, env, depth)
+                        self._bind(
+                            pf, item.optional_vars, item.context_expr, p,
+                            env, depth,
+                        )
+                self._walk_stmts(pf, st.body, env, depth)
+            elif isinstance(st, ast.Try):
+                self._walk_stmts(pf, st.body, env, depth)
+                for h in st.handlers:
+                    self._walk_stmts(pf, h.body, env, depth)
+                self._walk_stmts(pf, st.orelse, env, depth)
+                self._walk_stmts(pf, st.finalbody, env, depth)
+            elif isinstance(st, (ast.Return, ast.Expr)):
+                if st.value is not None:
+                    # evaluated for effect: constructor calls inside the
+                    # expression record attribute summaries
+                    self._eval(pf, st.value, env, depth)
+
+    def _bind(self, pf, target, value, prov: Set[str], env, depth) -> None:
+        if isinstance(target, ast.Name):
+            env.setdefault(target.id, set()).update(prov)
+        elif isinstance(target, ast.Starred):
+            self._bind(pf, target.value, value, prov, env, depth)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            if isinstance(value, (ast.Tuple, ast.List)) and len(
+                value.elts
+            ) == len(target.elts):
+                for t, v in zip(target.elts, value.elts):
+                    self._bind(pf, t, v, self._eval(pf, v, env, depth), env, depth)
+            else:
+                for t in target.elts:
+                    self._bind(pf, t, value, prov, env, depth)
+        elif isinstance(target, ast.Attribute):
+            # obj.attr = expr: record in the attribute summary. A None
+            # store is a tombstone (prep.init_state = None after donation),
+            # not a placement decision — skip it.
+            if prov and not (
+                isinstance(value, ast.Constant) and value.value is None
+            ):
+                self.attr_summary.setdefault(target.attr, set()).update(prov)
+        # Subscript targets carry no name to bind
+
+    # -- expression evaluation ---------------------------------------------
+
+    def _eval(self, pf, node: ast.AST, env, depth) -> Set[str]:
+        if isinstance(node, ast.Constant):
+            if node.value is None:
+                return set()
+            return {HOST}
+        if isinstance(node, ast.Name):
+            return set(env.get(node.id, ()))
+        if isinstance(node, ast.Attribute):
+            if node.attr in _METADATA_ATTRS:
+                return set()
+            base = self._eval(pf, node.value, env, depth)
+            if base:
+                return base
+            return set(self.attr_summary.get(node.attr, ()))
+        if isinstance(node, ast.Call):
+            return self._eval_call(pf, node, env, depth)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out: Set[str] = set()
+            for e in node.elts:
+                out |= self._eval(pf, e, env, depth)
+            return out
+        if isinstance(node, ast.Subscript):
+            # slicing keeps provenance: state.valmask[:n] is still sharded
+            return self._eval(pf, node.value, env, depth)
+        if isinstance(node, ast.IfExp):
+            return self._eval(pf, node.body, env, depth) | self._eval(
+                pf, node.orelse, env, depth
+            )
+        if isinstance(node, ast.BinOp):
+            return self._eval(pf, node.left, env, depth) | self._eval(
+                pf, node.right, env, depth
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self._eval(pf, node.operand, env, depth)
+        if isinstance(node, ast.NamedExpr):
+            p = self._eval(pf, node.value, env, depth)
+            env.setdefault(node.target.id, set()).update(p)
+            return p
+        if isinstance(node, ast.Starred):
+            return self._eval(pf, node.value, env, depth)
+        return set()
+
+    def _eval_call(self, pf, node: ast.Call, env, depth) -> Set[str]:
+        name = dotted_name(node.func)
+        tail = name.rsplit(".", 1)[-1] if name else ""
+
+        if tail in _MESH_SHARDERS:
+            return {SHARD}
+        if tail in _MESH_REPLICATORS:
+            return {REPL}
+        if name in ("jax.device_put", "device_put"):
+            placement = None
+            if len(node.args) >= 2:
+                placement = node.args[1]
+            elif node.keywords:
+                for kw in node.keywords:
+                    if kw.arg in ("device", "sharding", None):
+                        placement = kw.value
+                        break
+            if placement is None:
+                return {DEVICE}  # bare put: unannotated placement
+            sh = self._eval(pf, placement, env, depth)
+            sh &= {SHARD, REPL}
+            return sh or {REPL}  # explicitly placed, shape unknown -> repl
+        if name in ("jax.device_get", "device_get"):
+            return {HOST}
+        if name.endswith("tree.map") or name in ("jax.tree_map", "tree_map"):
+            out: Set[str] = set()
+            for a in node.args:
+                out |= self._eval(pf, a, env, depth)
+            return out
+        if name.startswith(_NP_PREFIXES):
+            return {HOST}
+        if name.startswith(_JNP_PREFIXES):
+            return {DEVICE}
+        if name in ("int", "float", "bool"):
+            return {HOST}  # concretization: the RESULT is host
+        if tail == "_replace" and isinstance(node.func, ast.Attribute):
+            out = self._eval(pf, node.func.value, env, depth)
+            for kw in node.keywords:
+                out |= self._eval(pf, kw.value, env, depth)
+            return out
+
+        # constructor call of a class (SlotState(...), _Prepared(...)):
+        # record keyword fields in the attribute summary, provenance is the
+        # union of the parts. CamelCase names count even when the class def
+        # lives outside the scanned set (SlotState imported from ops/ffd
+        # into a partial-path run) — the keyword-record is what matters.
+        cls = self.classes.get(tail)
+        if cls is not None or (tail[:1].isupper() and tail not in self.defs):
+            out = set()
+            for a in node.args:
+                out |= self._eval(pf, a, env, depth)
+            for kw in node.keywords:
+                kp = self._eval(pf, kw.value, env, depth)
+                out |= kp
+                if kw.arg and kp:
+                    self.attr_summary.setdefault(kw.arg, set()).update(kp)
+            return out
+
+        # project function/method: join the return summaries of every
+        # same-named def (conservative tail resolution)
+        candidates = self.defs.get(tail, ())
+        if candidates and depth > 0:
+            out = set()
+            for cpf, fn in candidates[:_MAX_CANDIDATES]:
+                out |= self._summary(cpf, fn, depth - 1)
+            # evaluate args for constructor-recording side effects
+            for a in node.args:
+                self._eval(pf, a, env, depth)
+            for kw in node.keywords:
+                self._eval(pf, kw.value, env, depth)
+            return out
+        return set()
+
+    def _summary(self, pf, fn, depth) -> Set[str]:
+        """Return-site provenance join of one def."""
+        cached = self._summaries.get(fn)
+        if cached is not None:
+            return set(cached)
+        if id(fn) in self._in_progress:
+            return set()  # recursion: bottom, refined on the next pass
+        self._in_progress.add(id(fn))
+        try:
+            env = self._env_for(pf, fn)
+            out: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    owner = pf.enclosing_function(node)
+                    if owner is fn:
+                        out |= self._eval(pf, node.value, env, depth)
+            self._summaries[fn] = frozenset(out)
+            return out
+        finally:
+            self._in_progress.discard(id(fn))
+
+
+_CACHE: Dict[str, ProjectDataflow] = {}
+
+
+def get(files: List[ParsedFile]) -> ProjectDataflow:
+    """The (content-hash cached) dataflow index for one scanned set."""
+    key = _content_key(files)
+    df = _CACHE.get(key)
+    if df is None:
+        df = ProjectDataflow(files)
+        if len(_CACHE) > 8:  # a handful of distinct scan sets per process
+            _CACHE.clear()
+        _CACHE[key] = df
+    return df

@@ -50,6 +50,7 @@ shipped rule families — real violations get fixed or inline-justified.
 from __future__ import annotations
 
 import ast
+import hashlib
 import io
 import json
 import re
@@ -61,6 +62,10 @@ from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+# incremental-mode finding cache (gitignored): per-file results keyed on
+# (content hash, rule-set hash), so an unchanged file never re-runs the
+# file-scope rules. Project-scope rules re-run every pass by construction.
+CACHE_PATH = Path(__file__).resolve().parent / ".finding_cache.json"
 
 # single source of truth for the tier-1 wall-time budget: the test gate
 # (tests/test_graftlint.py) and bench.py --lint both enforce this value
@@ -81,6 +86,12 @@ class Finding:
     def fingerprint(self, source_line: str) -> str:
         """Line-number-independent identity for baseline entries."""
         return f"{self.rule}|{self.path}|{source_line.strip()}"
+
+    def stable_id(self, source_line: str) -> str:
+        """Short content-addressed finding id for machine formats (CI
+        annotation dedup, editor integrations): line-number independent,
+        so a finding keeps its id across unrelated edits above it."""
+        return hashlib.sha1(self.fingerprint(source_line).encode()).hexdigest()[:16]
 
     def render(self) -> str:
         return f"{self.path}:{self.line}: {self.rule} {self.message}"
@@ -262,6 +273,8 @@ class RunResult:
     suppressed: List[Finding]
     files: int
     rule_seconds: Dict[str, float]
+    cache_hits: int = 0
+    cache_misses: int = 0
 
     @property
     def ok(self) -> bool:
@@ -296,14 +309,100 @@ def _bad_suppression_findings(pf: ParsedFile) -> List[Finding]:
     return out
 
 
+_RULES_HASH: Optional[str] = None
+
+
+def _rules_hash() -> str:
+    """Content hash of the whole lint implementation (engine, dataflow,
+    every rule module, the wire lock): the incremental cache's rule-set
+    key, so ANY rule change busts every cached entry."""
+    global _RULES_HASH
+    if _RULES_HASH is None:
+        h = hashlib.sha256()
+        root = Path(__file__).resolve().parent
+        for p in sorted(root.rglob("*.py")) + sorted(root.glob("*.lock.json")):
+            if "__pycache__" in p.parts:
+                continue
+            h.update(p.name.encode())
+            h.update(p.read_bytes())
+        _RULES_HASH = h.hexdigest()
+    return _RULES_HASH
+
+
+def _file_scope_results(pf: ParsedFile, rule_ids: Optional[List[str]] = None) -> dict:
+    """Run every file-scope rule (plus GL000) over one parsed file and
+    partition by inline suppression. Returns a JSON-serializable dict —
+    the unit the incremental cache stores and the --jobs workers ship."""
+    from tools.graftlint import rules as _rules  # noqa: F401 (registration)
+
+    res = {
+        "relpath": pf.relpath,
+        "new": [],  # [rule, line, message, source line]
+        "suppressed": [],  # [rule, line, message]
+        "rule_seconds": {},
+    }
+    active = [
+        r for rid, r in sorted(RULES.items())
+        if r.scope != "project" and (rule_ids is None or rid in rule_ids)
+    ]
+    for rule in active:
+        t0 = time.perf_counter()
+        if rule.applies(pf):
+            for f in rule.check(pf):
+                if pf.is_suppressed(f):
+                    res["suppressed"].append([f.rule, f.line, f.message])
+                else:
+                    res["new"].append(
+                        [f.rule, f.line, f.message, pf.source_line(f.line)]
+                    )
+        res["rule_seconds"][rule.id] = time.perf_counter() - t0
+    if rule_ids is None or "GL000" in rule_ids:
+        t0 = time.perf_counter()
+        for f in _bad_suppression_findings(pf):
+            res["new"].append([f.rule, f.line, f.message, pf.source_line(f.line)])
+        res["rule_seconds"]["GL000"] = time.perf_counter() - t0
+    return res
+
+
+def _lint_file_worker(job: Tuple[str, str, str]) -> dict:
+    """--jobs N worker: parse one file and run the file-scope rules in a
+    separate process. The SOURCE ships from the parent (which already
+    read and content-hashed it for the cache key) — re-reading here would
+    let an edit between the two reads store findings for new content
+    under the old content's hash."""
+    path_str, rel, source = job
+    pf = ParsedFile(Path(path_str), rel, source)
+    return _file_scope_results(pf)
+
+
+def _load_cache(path: Path) -> dict:
+    if not path.exists():
+        return {}
+    try:
+        data = json.loads(path.read_text())
+    except (ValueError, OSError):
+        return {}
+    return data if isinstance(data, dict) else {}
+
+
 def run(
     paths: List[str],
     use_baseline: bool = True,
     rule_ids: Optional[List[str]] = None,
     baseline_path: Optional[Path] = None,
+    jobs: int = 1,
+    cache_path: Optional[Path] = None,
 ) -> RunResult:
     """Run every registered rule over ``paths``; returns the partitioned
-    findings. ``rule_ids`` restricts the pass (rule unit tests)."""
+    findings. ``rule_ids`` restricts the pass (rule unit tests).
+
+    Incremental mode: with ``cache_path`` set (and no rule restriction),
+    file-scope findings are cached per file keyed on (content hash,
+    rule-set hash) — an unchanged file costs one dict lookup. Project-
+    scope rules (cross-file parity, the sharding dataflow family) re-run
+    every pass: their verdicts depend on the whole scanned set.
+    ``jobs > 1`` fans the uncached file-scope work over a process pool.
+    """
     from tools.graftlint import rules as _rules  # noqa: F401 (registration)
 
     files = _collect_files(paths)
@@ -315,52 +414,134 @@ def run(
             raise SystemExit(
                 f"graftlint: unknown rule id(s): {', '.join(sorted(unknown))}"
             )
-    active = [
-        r for rid, r in sorted(RULES.items())
-        if rule_ids is None or rid in rule_ids
-    ]
     rule_seconds: Dict[str, float] = {}
-    raw: List[Tuple[Finding, ParsedFile]] = []
     by_rel = {pf.relpath: pf for pf in files}
 
-    for rule in active:
+    # -- file-scope rules: cache, then (possibly parallel) execution -------
+    caching = cache_path is not None and rule_ids is None
+    cache_data = _load_cache(cache_path) if caching else {}
+    rhash = _rules_hash() if caching else ""
+    per_file: Dict[str, dict] = {}
+    file_keys: Dict[str, str] = {}
+    cache_hits = cache_misses = 0
+    pending: List[ParsedFile] = []
+    for pf in files:
+        if caching:
+            if pf.relpath.startswith("/"):
+                # out-of-repo path (ad-hoc lint of tmp fixtures): lint
+                # fresh every time, never absorb into the repo cache
+                cache_misses += 1
+            else:
+                key = (
+                    hashlib.sha256(pf.source.encode()).hexdigest()
+                    + ":"
+                    + rhash
+                )
+                file_keys[pf.relpath] = key
+                ent = cache_data.get(pf.relpath)
+                if isinstance(ent, dict) and ent.get("key") == key:
+                    per_file[pf.relpath] = ent
+                    cache_hits += 1
+                    continue
+                cache_misses += 1
+        pending.append(pf)
+
+    if jobs > 1 and rule_ids is None and len(pending) > 1:
+        import multiprocessing as mp
+        import sys
+        from concurrent.futures import ProcessPoolExecutor
+
+        # fork under a loaded (multithreaded) JAX runtime can deadlock;
+        # the standalone CLI never imports jax, but in-process callers
+        # (pytest, bench.py) do — pay the spawn cost there
+        ctx = mp.get_context("spawn" if "jax" in sys.modules else "fork")
+        with ProcessPoolExecutor(max_workers=jobs, mp_context=ctx) as ex:
+            for res in ex.map(
+                _lint_file_worker,
+                [(str(pf.path), pf.relpath, pf.source) for pf in pending],
+            ):
+                per_file[res["relpath"]] = res
+    else:
+        for pf in pending:
+            per_file[pf.relpath] = _file_scope_results(pf, rule_ids)
+    for res in per_file.values():
+        for rid, dt in res.get("rule_seconds", {}).items():
+            rule_seconds[rid] = rule_seconds.get(rid, 0.0) + dt
+
+    if caching:
+        fresh = {
+            rel: {
+                "key": file_keys[rel],
+                "new": res["new"],
+                "suppressed": res["suppressed"],
+                # timings are run-local, not part of the cached verdict
+            }
+            for rel, res in per_file.items()
+            if rel in file_keys
+        }
+        # MERGE into the loaded cache (a subset-path run must not evict
+        # the full-tree entries it didn't scan), pruning entries whose
+        # file no longer exists — deleted/renamed files are never scanned
+        # again, so without the prune their entries would live forever
+        merged_cache = {
+            rel: ent
+            for rel, ent in cache_data.items()
+            if isinstance(ent, dict)
+            and not rel.startswith("/")
+            and (REPO_ROOT / rel).exists()
+        }
+        merged_cache.update(fresh)
+        try:
+            cache_path.write_text(json.dumps(merged_cache, sort_keys=True))
+        except OSError:
+            pass  # a read-only checkout lints fine, just never warm
+
+    # -- project-scope rules: always fresh, over the full parsed set -------
+    active_project = [
+        r for rid, r in sorted(RULES.items())
+        if r.scope == "project" and (rule_ids is None or rid in rule_ids)
+    ]
+    raw_project: List[Tuple[Finding, ParsedFile]] = []
+    for rule in active_project:
         t0 = time.perf_counter()
-        if rule.scope == "project":
-            for f in rule.check_project(files):
-                pf = by_rel.get(f.path)
-                if pf is not None:
-                    raw.append((f, pf))
-        else:
-            for pf in files:
-                if rule.applies(pf):
-                    for f in rule.check(pf):
-                        raw.append((f, pf))
+        for f in rule.check_project(files):
+            pf = by_rel.get(f.path)
+            if pf is not None:
+                raw_project.append((f, pf))
         rule_seconds[rule.id] = time.perf_counter() - t0
 
-    if rule_ids is None or "GL000" in rule_ids:
-        t0 = time.perf_counter()
-        for pf in files:
-            for f in _bad_suppression_findings(pf):
-                raw.append((f, pf))
-        rule_seconds["GL000"] = time.perf_counter() - t0
+    # -- merge, suppress (project side), baseline --------------------------
+    merged_new: List[Tuple[Finding, str]] = []
+    suppressed: List[Finding] = []
+    for rel, res in per_file.items():
+        for rid, line, msg, src in res["new"]:
+            merged_new.append((Finding(rid, rel, line, msg), src))
+        for rid, line, msg in res["suppressed"]:
+            suppressed.append(Finding(rid, rel, line, msg))
+    for f, pf in raw_project:
+        if pf.is_suppressed(f):
+            suppressed.append(f)
+        else:
+            merged_new.append((f, pf.source_line(f.line)))
 
     baseline = _load_baseline(baseline_path) if use_baseline else {}
     budget = dict(baseline)
     new: List[Tuple[Finding, str]] = []
     baselined: List[Finding] = []
-    suppressed: List[Finding] = []
-    for f, pf in sorted(raw, key=lambda t: (t[0].path, t[0].line, t[0].rule)):
-        if f.rule != "GL000" and pf.is_suppressed(f):
-            suppressed.append(f)
-            continue
-        src = pf.source_line(f.line)
+    for f, src in sorted(
+        merged_new, key=lambda t: (t[0].path, t[0].line, t[0].rule)
+    ):
         fp = f.fingerprint(src)
         if budget.get(fp, 0) > 0:
             budget[fp] -= 1
             baselined.append(f)
             continue
         new.append((f, src))
-    return RunResult(new, baselined, suppressed, len(files), rule_seconds)
+    suppressed.sort(key=lambda f: (f.path, f.line, f.rule))
+    return RunResult(
+        new, baselined, suppressed, len(files), rule_seconds,
+        cache_hits, cache_misses,
+    )
 
 
 def write_baseline(result: RunResult, path: Optional[Path] = None) -> int:
@@ -374,6 +555,106 @@ def write_baseline(result: RunResult, path: Optional[Path] = None) -> int:
         json.dumps({"entries": entries}, indent=2, sort_keys=True) + "\n"
     )
     return len(entries)
+
+
+def _unique_ids(result: RunResult) -> List[Tuple[Finding, str, str]]:
+    """(finding, source line, stable id) with duplicate-line findings
+    disambiguated by an occurrence suffix — ids stay stable and unique."""
+    seen: Dict[str, int] = {}
+    out = []
+    for f, src in result.new:
+        base = f.stable_id(src)
+        n = seen.get(base, 0)
+        seen[base] = n + 1
+        out.append((f, src, base if n == 0 else f"{base}-{n + 1}"))
+    return out
+
+
+def _render_json(result: RunResult) -> str:
+    return json.dumps(
+        {
+            "schema": "graftlint-json/1",
+            "findings": [
+                {
+                    "id": fid,
+                    "rule": f.rule,
+                    "path": f.path,
+                    "line": f.line,
+                    "message": f.message,
+                }
+                for f, _src, fid in _unique_ids(result)
+            ],
+            "summary": {
+                "files": result.files,
+                "new": len(result.new),
+                "baselined": len(result.baselined),
+                "suppressed": len(result.suppressed),
+                "cache_hits": result.cache_hits,
+                "cache_misses": result.cache_misses,
+                "rule_seconds": {
+                    rid: round(dt, 4)
+                    for rid, dt in sorted(result.rule_seconds.items())
+                },
+            },
+        },
+        indent=2,
+        sort_keys=True,
+    )
+
+
+def _render_sarif(result: RunResult) -> str:
+    used = sorted({f.rule for f, _src in result.new})
+    rules_meta = [
+        {
+            "id": rid,
+            "name": RULES[rid].name if rid in RULES else "suppression-hygiene",
+            "shortDescription": {
+                "text": RULES[rid].rationale
+                if rid in RULES
+                else "suppression without justification",
+            },
+        }
+        for rid in used
+    ]
+    return json.dumps(
+        {
+            "$schema": (
+                "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json"
+            ),
+            "version": "2.1.0",
+            "runs": [
+                {
+                    "tool": {
+                        "driver": {
+                            "name": "graftlint",
+                            "informationUri": "tools/graftlint",
+                            "rules": rules_meta,
+                        }
+                    },
+                    "results": [
+                        {
+                            "ruleId": f.rule,
+                            "level": "error",
+                            "message": {"text": f.message},
+                            "locations": [
+                                {
+                                    "physicalLocation": {
+                                        "artifactLocation": {"uri": f.path},
+                                        "region": {"startLine": f.line},
+                                    }
+                                }
+                            ],
+                            "partialFingerprints": {"graftlint/v1": fid},
+                        }
+                        for f, _src, fid in _unique_ids(result)
+                    ],
+                }
+            ],
+        },
+        indent=2,
+        sort_keys=True,
+    )
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -399,9 +680,40 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--rule", action="append", default=None,
         help="restrict to one rule id (repeatable)",
     )
+    ap.add_argument(
+        "--format", choices=("text", "json", "sarif"), default="text",
+        help="output format (json/sarif carry stable finding ids)",
+    )
+    ap.add_argument(
+        "--jobs", type=int, default=1, metavar="N",
+        help="run the file-scope rules over N worker processes",
+    )
+    ap.add_argument(
+        "--no-cache", action="store_true",
+        help="disable the incremental per-file finding cache",
+    )
+    ap.add_argument(
+        "--update-wire-lock", action="store_true",
+        help="regenerate tools/graftlint/wire_schema.lock.json from"
+        " solver/codec.py (refuses a field-set change without a wire"
+        " version bump)",
+    )
     args = ap.parse_args(argv)
 
     from tools.graftlint import rules as _rules  # noqa: F401
+
+    if args.update_wire_lock:
+        from tools.graftlint.rules.parity import (
+            WIRE_LOCK_PATH,
+            update_wire_lock,
+        )
+
+        n = update_wire_lock()
+        print(
+            f"graftlint: {WIRE_LOCK_PATH.name} rewritten with"
+            f" {n} locked encoder(s)"
+        )
+        return 0
 
     if args.list_rules:
         for rid, r in sorted(RULES.items()):
@@ -417,12 +729,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
 
     paths = args.paths or ["karpenter_core_tpu"]
-    result = run(paths, use_baseline=not args.baseline, rule_ids=args.rule)
+    result = run(
+        paths,
+        use_baseline=not args.baseline,
+        rule_ids=args.rule,
+        jobs=max(1, args.jobs),
+        cache_path=None if (args.no_cache or args.rule) else CACHE_PATH,
+    )
 
     if args.baseline:
         n = write_baseline(result)
         print(f"graftlint: baseline rewritten with {n} entr{'y' if n == 1 else 'ies'}")
         return 0
+
+    if args.format == "json":
+        print(_render_json(result))
+        return 0 if result.ok else 1
+    if args.format == "sarif":
+        print(_render_sarif(result))
+        return 0 if result.ok else 1
 
     for f, _src in result.new:
         print(f.render())
@@ -436,5 +761,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         f" ({len(result.baselined)} baselined,"
         f" {len(result.suppressed)} suppressed)"
         f" across {result.files} file(s), {len(result.rule_seconds)} rule(s)"
+        + (
+            f", cache {result.cache_hits}/{result.cache_hits + result.cache_misses} hit"
+            if result.cache_hits + result.cache_misses
+            else ""
+        )
     )
     return 0 if result.ok else 1

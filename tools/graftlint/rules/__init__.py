@@ -9,4 +9,5 @@ from tools.graftlint.rules import (  # noqa: F401
     determinism,
     jaxpurity,
     parity,
+    sharding,
 )

@@ -12,16 +12,16 @@ GL101 jit-host-sync        — host-sync calls inside a traced region
 GL102 jit-tracer-branch    — Python branching on (non-static) tracer values
 GL103 jit-state-no-donate  — jit entry points that carry slot-state
                              without donate_argnums
-GL104 slotstate-unsharded  — sharding-unaware device placement
-                             (single-arg jax.device_put) in a module that
-                             drives a SlotState jit entry: placement must
-                             route through parallel.mesh.slot_shardings /
-                             an explicit sharding, or the multi-device
-                             path silently degrades to replicated copies
+
+GL104 (slotstate-unsharded-deviceput) retired: subsumed by GL503 in the
+shardcheck family (tools/graftlint/rules/sharding.py), which checks the
+same bare-device_put pattern plus every other host materialization of a
+slot-sharded value on the interprocedural provenance lattice.
 """
 from __future__ import annotations
 
 import ast
+import weakref
 from typing import Dict, List, Optional, Set, Tuple
 
 from tools.graftlint.engine import ParsedFile, Rule, dotted_name, register
@@ -76,7 +76,9 @@ class _ModuleIndex:
     """Traced-region reachability for one module."""
 
     def __init__(self, pf: ParsedFile):
-        self.pf = pf
+        # no self.pf: the index is cached under the ParsedFile as a WEAK
+        # key (see _INDEX_CACHE), and a strong value->key reference would
+        # keep every entry alive forever
         # name -> EVERY def carrying it (module-level and nested): two
         # same-named inner functions (the conventional `def body` of a
         # lax.scan) must both be traced, not whichever parsed last — a
@@ -173,7 +175,11 @@ class _ModuleIndex:
         cur = fn
         while cur is not None:
             out |= self.static_by_fn.get(cur, set())
-            cur = self.pf.enclosing_function(cur)
+            cur = getattr(cur, "_gl_parent", None)
+            while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                cur = getattr(cur, "_gl_parent", None)
         return out
 
     def _propagate_statics(self, call: ast.Call, caller, callee) -> bool:
@@ -230,16 +236,18 @@ def _accel_file(pf: ParsedFile) -> bool:
     )
 
 
-_INDEX_CACHE: Dict[int, _ModuleIndex] = {}
+# weak keys: an entry lives exactly as long as its ParsedFile — a run's
+# parse (and the module tree the index pins through its def tables) frees
+# when the run drops it, instead of accumulating per lint invocation
+_INDEX_CACHE: "weakref.WeakKeyDictionary[ParsedFile, _ModuleIndex]" = (
+    weakref.WeakKeyDictionary()
+)
 
 
 def _index(pf: ParsedFile) -> _ModuleIndex:
-    idx = _INDEX_CACHE.get(id(pf))
+    idx = _INDEX_CACHE.get(pf)
     if idx is None:
-        idx = _INDEX_CACHE[id(pf)] = _ModuleIndex(pf)
-        if len(_INDEX_CACHE) > 512:
-            _INDEX_CACHE.clear()
-            _INDEX_CACHE[id(pf)] = idx
+        idx = _INDEX_CACHE[pf] = _ModuleIndex(pf)
     return idx
 
 
@@ -382,67 +390,6 @@ def _carries_slot_state(fn) -> Optional[str]:
         ):
             return p.arg
     return None
-
-
-# SlotState jit entries: defined in ops/ffd.py, called from models/ and
-# the bench/test harnesses. A module both (a) reaching one of these and
-# (b) device_put-ting without a sharding is a call site that bypasses
-# parallel.mesh.slot_shardings — on a multi-device mesh the un-annotated
-# copy lands single-device/replicated and every kernel input must be
-# resharded per dispatch.
-_SLOTSTATE_JIT_ENTRIES = {
-    "ffd_solve",
-    "ffd_solve_donated",
-    "_prefix_scan",
-}
-
-
-def _reaches_slotstate_entry(pf: ParsedFile, idx: _ModuleIndex) -> bool:
-    """Module calls a known SlotState jit entry, or defines a jit entry
-    carrying SlotState itself (ops/ffd.py-shaped modules)."""
-    for call in pf.walk(ast.Call):
-        name = dotted_name(call.func)
-        if name.rsplit(".", 1)[-1] in _SLOTSTATE_JIT_ENTRIES:
-            return True
-    for _site, target, _kw in idx.jit_sites:
-        if _carries_slot_state(target) is not None:
-            return True
-    return False
-
-
-@register
-class SlotStateUnshardedPut(Rule):
-    id = "GL104"
-    name = "slotstate-unsharded-deviceput"
-    rationale = (
-        "a bare jax.device_put(x) (no sharding argument) in a module that"
-        " drives a SlotState jit entry bypasses parallel.mesh"
-        ".slot_shardings — on a multi-device mesh the copy lands"
-        " unannotated and the kernel pays a reshard per dispatch"
-    )
-
-    def applies(self, pf: ParsedFile) -> bool:
-        return _accel_file(pf)
-
-    def check(self, pf: ParsedFile):
-        idx = _index(pf)
-        if not _reaches_slotstate_entry(pf, idx):
-            return
-        for node in pf.walk(ast.Call):
-            name = dotted_name(node.func)
-            if name not in ("jax.device_put", "device_put"):
-                continue
-            # a second positional arg or a device=/... keyword carries the
-            # placement decision; a bare single-arg put does not
-            if len(node.args) >= 2 or node.keywords:
-                continue
-            yield self.finding(
-                pf, node,
-                "jax.device_put without a sharding in a SlotState solve"
-                " module — place slot-axis arrays via parallel.mesh"
-                ".slot_shardings (or an explicit NamedSharding) so the"
-                " multi-device path stays pre-sharded",
-            )
 
 
 @register

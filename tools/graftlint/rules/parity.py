@@ -13,13 +13,33 @@ GL401 codec-field-parity — every encode_X/_encode_X in solver/codec.py
 GL402 metric-registered  — every ALL_CAPS instrument used via
                            .inc/.observe/.set/.time resolves to a
                            REGISTRY.counter/gauge/histogram definition
+GL403 wire-schema-lock   — every encode_* payload field set in
+                           solver/codec.py, keyed by the wire version
+                           constant that governs it, is frozen in
+                           tools/graftlint/wire_schema.lock.json; a
+                           field-set change without a version bump fails
+                           the lint, and `--update-wire-lock` regenerates
+                           the lock with the bump enforced (the contract
+                           ROADMAP item 5's delta protocol builds on)
 """
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
 
-from tools.graftlint.engine import Finding, ParsedFile, Rule, dotted_name, register
+from tools.graftlint.engine import (
+    REPO_ROOT,
+    Finding,
+    ParsedFile,
+    Rule,
+    dotted_name,
+    register,
+)
+
+WIRE_LOCK_PATH = Path(__file__).resolve().parent.parent / "wire_schema.lock.json"
+CODEC_PATH = REPO_ROOT / "karpenter_core_tpu" / "solver" / "codec.py"
 
 
 def _fn_defs(pf: ParsedFile) -> Dict[str, ast.FunctionDef]:
@@ -265,3 +285,335 @@ class MetricRegistered(Rule):
             if name in defined:
                 continue
             yield from used[name]
+
+
+# ---------------------------------------------------------------------------
+# GL403: the wire-schema lock.
+#
+# GL401 pins encode<->decode symmetry *within one revision*; nothing pins
+# the field set *across revisions*. A PR that adds a wire field and its
+# decode twin sails through GL401, ships, and a mixed deployment (old
+# sidecar, new client) silently drops the field — exactly the
+# unavailable_offerings near-miss, one axis over. The lock freezes every
+# encoder's statically-extracted field set keyed by the wire version
+# constant that governs it; changing the set without bumping the version
+# fails the lint, and the committed lockfile makes the bump reviewable.
+# ---------------------------------------------------------------------------
+
+
+def _const_str_args(call: ast.Call) -> Dict[int, str]:
+    return {
+        i: a.value
+        for i, a in enumerate(call.args)
+        if isinstance(a, ast.Constant) and isinstance(a.value, str)
+    }
+
+
+def _fstring_template(node: ast.JoinedStr) -> Optional[List[Tuple[str, str]]]:
+    """f-string as [(kind, text)] parts, kind 'const' | 'param'; None when
+    a formatted value is not a plain name (unresolvable statically)."""
+    parts: List[Tuple[str, str]] = []
+    for v in node.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(("const", v.value))
+        elif isinstance(v, ast.FormattedValue) and isinstance(
+            v.value, ast.Name
+        ):
+            parts.append(("param", v.value.id))
+        else:
+            return None
+    return parts
+
+
+def extract_wire_schema(pf: ParsedFile) -> dict:
+    """Statically extract the wire schema of a codec module.
+
+    Returns ``{"versions": {const_name: int}, "encoders": {fn_name:
+    {"versioned_by": [const_name...], "fields": [key...]}}}``.
+
+    Field keys per function: constant dict-literal keys, ``np.savez*``
+    keyword names, and constant subscript-store keys. Helpers that write
+    f-string keys parameterized on an argument (``out[f"{prefix}_mask"]``,
+    the _masks_to_arrays shape) contribute their *instantiated* keys to
+    each call site that binds the parameter to a string constant — the
+    one-level interprocedural expansion the snapshot codec needs.
+
+    Version attribution: an encoder writing ``"version": SOME_CONST``
+    is governed by that constant; private helpers inherit the union of
+    their (transitive) callers' constants through the codec-internal call
+    graph; anything still unattributed is governed by every version
+    constant (any bump permits its change).
+    """
+    defs: Dict[str, ast.FunctionDef] = {
+        n.name: n
+        for n in pf.tree.body
+        if isinstance(n, ast.FunctionDef)
+    }
+    versions: Dict[str, int] = {}
+    for node in pf.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Name)
+                    and tgt.id.endswith("_WIRE_VERSION")
+                    and isinstance(node.value.value, int)
+                ):
+                    versions[tgt.id] = node.value.value
+
+    fields: Dict[str, Set[str]] = {n: set() for n in defs}
+    templates: Dict[str, List[List[Tuple[str, str]]]] = {n: [] for n in defs}
+    version_keys: Dict[str, Set[str]] = {n: set() for n in defs}
+    calls: Dict[str, List[ast.Call]] = {n: [] for n in defs}
+
+    for name, fn in defs.items():
+        params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        fields[name].add(k.value)
+                        if (
+                            k.value == "version"
+                            and isinstance(v, ast.Name)
+                            and v.id in versions
+                        ):
+                            version_keys[name].add(v.id)
+            elif isinstance(node, ast.Call):
+                cname = dotted_name(node.func)
+                if cname.endswith("savez") or cname.endswith("savez_compressed"):
+                    for kw in node.keywords:
+                        if kw.arg:
+                            fields[name].add(kw.arg)
+                tail = cname.rsplit(".", 1)[-1] if cname else ""
+                if tail in defs and tail != name:
+                    calls[name].append(node)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.targets[0], ast.Subscript
+            ):
+                s = node.targets[0].slice
+                if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                    fields[name].add(s.value)
+                elif isinstance(s, ast.JoinedStr):
+                    tpl = _fstring_template(s)
+                    if tpl is not None and all(
+                        kind != "param" or text in params for kind, text in tpl
+                    ):
+                        templates[name].append(tpl)
+
+    # one-level template expansion at call sites binding constants
+    for caller, sites in calls.items():
+        for call in sites:
+            callee = dotted_name(call.func).rsplit(".", 1)[-1]
+            tpls = templates.get(callee)
+            if not tpls:
+                continue
+            callee_params = [
+                a.arg
+                for a in defs[callee].args.posonlyargs + defs[callee].args.args
+            ]
+            bindings = {
+                callee_params[i]: v
+                for i, v in _const_str_args(call).items()
+                if i < len(callee_params)
+            }
+            for kw in call.keywords:
+                if (
+                    kw.arg
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)
+                ):
+                    bindings[kw.arg] = kw.value.value
+            for tpl in tpls:
+                if all(kind == "const" or text in bindings for kind, text in tpl):
+                    fields[caller].add(
+                        "".join(
+                            text if kind == "const" else bindings[text]
+                            for kind, text in tpl
+                        )
+                    )
+
+    # propagate version constants caller -> callee to a fixpoint
+    changed = True
+    while changed:
+        changed = False
+        for caller, sites in calls.items():
+            for call in sites:
+                callee = dotted_name(call.func).rsplit(".", 1)[-1]
+                before = len(version_keys[callee])
+                version_keys[callee] |= version_keys[caller]
+                if len(version_keys[callee]) > before:
+                    changed = True
+
+    encoders = {}
+    for name in sorted(defs):
+        if not name.lstrip("_").startswith("encode_") or not fields[name]:
+            continue
+        governed = sorted(version_keys[name]) or sorted(versions)
+        encoders[name] = {
+            "versioned_by": governed,
+            "fields": sorted(fields[name]),
+        }
+    return {"versions": versions, "encoders": encoders}
+
+
+def _load_lock(path: Path) -> Optional[dict]:
+    if not path.exists():
+        return None
+    try:
+        return json.loads(path.read_text())
+    except (ValueError, OSError):
+        return None
+
+
+@register
+class WireSchemaLock(Rule):
+    id = "GL403"
+    name = "wire-schema-lock"
+    rationale = (
+        "a wire field-set change without a version bump ships a silent"
+        " mixed-deployment incompatibility (the field drops on the floor"
+        " between revisions) — the committed lock makes every schema"
+        " change an explicit, reviewed version bump"
+    )
+    scope = "project"
+
+    def check_project(self, files: List[ParsedFile]):
+        for pf in files:
+            lock_path = self._lock_for(pf)
+            if lock_path is None:
+                continue
+            yield from self._check(pf, lock_path)
+
+    def _lock_for(self, pf: ParsedFile) -> Optional[Path]:
+        if pf.relpath.endswith("solver/codec.py"):
+            return WIRE_LOCK_PATH
+        if "graftlint_fixtures" in pf.relpath and "gl403" in pf.path.name:
+            # fixtures carry a sidecar lock: <fixture stem>.lock.json
+            return pf.path.with_name(pf.path.stem + ".lock.json")
+        return None
+
+    def _check(self, pf: ParsedFile, lock_path: Path):
+        schema = extract_wire_schema(pf)
+        lock = _load_lock(lock_path)
+        if lock is None:
+            yield self.finding(
+                pf, pf.tree,
+                f"no wire-schema lock at {lock_path.name} — run"
+                " `python -m tools.graftlint --update-wire-lock` to freeze"
+                " the current field sets",
+            )
+            return
+        locked_versions = dict(lock.get("versions", {}))
+        locked_encoders = dict(lock.get("encoders", {}))
+        defs = {
+            n.name: n for n in pf.tree.body if isinstance(n, ast.FunctionDef)
+        }
+
+        def bumped(governed: List[str]) -> bool:
+            return any(
+                schema["versions"].get(k) != locked_versions.get(k)
+                for k in governed
+            )
+
+        stale_lock = False
+        for name, cur in schema["encoders"].items():
+            node = defs.get(name, pf.tree)
+            ent = locked_encoders.get(name)
+            if ent is None:
+                yield self.finding(
+                    pf, node,
+                    f"{name} is not in the wire-schema lock — new wire"
+                    " payloads need a version bump and"
+                    " `--update-wire-lock`",
+                )
+                continue
+            if cur["fields"] != ent.get("fields"):
+                if bumped(cur["versioned_by"]):
+                    stale_lock = True  # bumped but lock not regenerated
+                else:
+                    added = sorted(set(cur["fields"]) - set(ent.get("fields", [])))
+                    removed = sorted(set(ent.get("fields", [])) - set(cur["fields"]))
+                    gov = "/".join(cur["versioned_by"])
+                    yield self.finding(
+                        pf, node,
+                        f"{name} wire field set changed without a {gov}"
+                        f" bump (added {added}, removed {removed}) — an"
+                        " old peer on the same version number silently"
+                        " drops the difference; bump the version, then"
+                        " `--update-wire-lock`",
+                    )
+        for name in sorted(set(locked_encoders) - set(schema["encoders"])):
+            yield self.finding(
+                pf, pf.tree,
+                f"locked encoder {name} no longer exists in the codec —"
+                " removing a wire payload is a schema change: bump and"
+                " `--update-wire-lock`",
+            )
+        for k in sorted(set(schema["versions"]) | set(locked_versions)):
+            if schema["versions"].get(k) != locked_versions.get(k):
+                stale_lock = True
+        if stale_lock:
+            yield self.finding(
+                pf, pf.tree,
+                f"{lock_path.name} is stale against the codec (version"
+                " constants or bumped field sets differ) — run"
+                " `python -m tools.graftlint --update-wire-lock`",
+            )
+
+
+def update_wire_lock(
+    codec_path: Optional[Path] = None, lock_path: Optional[Path] = None
+) -> int:
+    """Regenerate the wire-schema lock from the codec source, with the
+    bump enforced: an encoder whose field set differs from the existing
+    lock while every version constant governing it is unchanged aborts
+    the regeneration — the lock must never absorb an unversioned schema
+    change. Returns the number of locked encoders."""
+    codec_path = codec_path or CODEC_PATH
+    lock_path = lock_path or WIRE_LOCK_PATH
+    source = codec_path.read_text()
+    pf = ParsedFile(codec_path, codec_path.name, source)
+    schema = extract_wire_schema(pf)
+    old = _load_lock(lock_path)
+    if old is not None:
+        old_versions = dict(old.get("versions", {}))
+        old_encoders = dict(old.get("encoders", {}))
+
+        def bumped(governed: List[str]) -> bool:
+            return any(
+                schema["versions"].get(k) != old_versions.get(k)
+                for k in governed
+            )
+
+        offenders = []
+        for name, cur in schema["encoders"].items():
+            ent = old_encoders.get(name)
+            gov = "/".join(cur["versioned_by"])
+            if ent is None:
+                # a NEW payload is a schema change too: an old peer on the
+                # same version number cannot decode it
+                if not bumped(cur["versioned_by"]):
+                    offenders.append(f"{name} (new encoder, governed by {gov})")
+            elif cur["fields"] != ent.get("fields") and not bumped(
+                cur["versioned_by"]
+            ):
+                offenders.append(f"{name} (governed by {gov})")
+        for name, ent in old_encoders.items():
+            if name in schema["encoders"]:
+                continue
+            governed = ent.get("versioned_by") or sorted(old_versions)
+            if not bumped(governed):
+                offenders.append(
+                    f"{name} (removed encoder, governed by"
+                    f" {'/'.join(governed)})"
+                )
+        if offenders:
+            raise SystemExit(
+                "graftlint: refusing to update the wire lock — schema"
+                " changed without a version bump: "
+                + ", ".join(sorted(offenders))
+            )
+    lock_path.write_text(
+        json.dumps(schema, indent=2, sort_keys=True) + "\n"
+    )
+    return len(schema["encoders"])

@@ -1,0 +1,423 @@
+"""Family 5 — shardcheck: SPMD placement discipline for the sharded solve.
+
+PR 6 made pjit-over-the-slot-axis the production path. XLA compiles the
+solve SPMD from the *argument shardings* — nothing at runtime checks that
+the arguments actually carried the right ones. Three silent failure modes
+follow, each invisible to pytest on a 1-chip box: a SlotState that lands
+unannotated compiles, runs, and quietly degrades to replicated copies
+with a reshard per dispatch; a host materialization of a slot-sharded
+plane compiles into an implicit cross-device gather; and hand-rolled
+slot-axis arithmetic that bypasses ``pad_to_devices`` works on any device
+count that happens to divide evenly — until one doesn't. These rules ride
+the interprocedural provenance lattice (tools/graftlint/dataflow.py) so
+the placement can live several calls away from the consumption site.
+
+GL501 slotstate-entry-unrouted — a SlotState jit entry reachable from
+                                 DeviceScheduler/frontier_core (models/)
+                                 consumes state whose arrays never routed
+                                 through parallel.mesh placement
+                                 (slot_shardings/axis_sharding/
+                                 batch_sharding, or an explicit
+                                 device_put placement)
+GL502 slotstate-spec-parity    — the SlotState field set must equal the
+                                 SLOT_STATE_SPECS keys in parallel/mesh.py
+                                 (the runtime raise, promoted to edit time)
+GL503 sharded-host-gather      — host materialization of a slot-sharded
+                                 value in ops//models/ (np.asarray,
+                                 .addressable_data, scalar int()/float(),
+                                 bare single-arg jax.device_put — subsumes
+                                 the retired GL104)
+GL504 pad-to-devices-bypass    — literal slot-axis shape arithmetic
+                                 (slots-name //,%,* devices-name; reshape
+                                 folding a device axis) instead of
+                                 parallel.mesh.pad_to_devices
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.graftlint import dataflow
+from tools.graftlint.engine import ParsedFile, Rule, dotted_name, register
+
+# SlotState jit entries: defined in ops/ffd.py (plus the consolidation
+# sweep's _prefix_scan), consumed by models/ and the harnesses. One list,
+# shared by GL501 (routing) and GL503 (the bare-device_put precondition
+# inherited from the retired GL104).
+SLOTSTATE_JIT_ENTRIES = {
+    "ffd_solve",
+    "ffd_solve_donated",
+    "_prefix_scan",
+}
+
+
+def _models_file(pf: ParsedFile) -> bool:
+    return "/models/" in f"/{pf.relpath}"
+
+
+def _accel_file(pf: ParsedFile) -> bool:
+    return "/ops/" in f"/{pf.relpath}" or "/models/" in f"/{pf.relpath}"
+
+
+def _reaches_slotstate_entry(pf: ParsedFile) -> bool:
+    """Module calls a known SlotState jit entry, or defines one itself
+    (an ops/ffd.py-shaped module introducing a new SlotState kernel) — in
+    either case an un-annotated placement feeds the sharded solve. The
+    second half reuses the jaxpurity traced-region index so the GL104
+    semantics this rule subsumed carry over exactly."""
+    for call in pf.walk(ast.Call):
+        name = dotted_name(call.func)
+        if name and name.rsplit(".", 1)[-1] in SLOTSTATE_JIT_ENTRIES:
+            return True
+    from tools.graftlint.rules import jaxpurity as _jp
+
+    idx = _jp._index(pf)
+    for _site, target, _kw in idx.jit_sites:
+        if _jp._carries_slot_state(target) is not None:
+            return True
+    return False
+
+
+def _traced_fns(pf: ParsedFile):
+    """Functions whose interior is traced (jit roots — decorator, call,
+    and partial forms — plus everything reachable from them): GL101's
+    territory, excluded from GL503's host-side checks. Reuses the
+    jaxpurity module index so the two rules agree on the boundary."""
+    from tools.graftlint.rules import jaxpurity as _jp
+
+    return _jp._index(pf).traced
+
+
+@register
+class SlotStateEntryUnrouted(Rule):
+    id = "GL501"
+    name = "slotstate-entry-unrouted"
+    rationale = (
+        "a SlotState jit entry on the DeviceScheduler/frontier_core solve"
+        " path consuming state never routed through parallel.mesh"
+        " placement compiles SPMD against the wrong (absent) shardings —"
+        " the multi-device path silently degrades to replicated copies"
+    )
+    scope = "project"
+
+    # the roots the rationale names: the production solve object and the
+    # consolidation sweep entry
+    _ROOT_CLASSES = {"DeviceScheduler"}
+    _ROOT_FUNCS = {"frontier_core"}
+
+    def _reachable(self, files: List[ParsedFile]) -> set:
+        """Ids of every def reachable (by name-tail call edges) from a
+        DeviceScheduler method or frontier_core — the documented scope,
+        so an off-path models/ helper deliberately driving a single-
+        device solve is not flagged against a contract it never made.
+        Indexed over THIS run's parse (never the content-hash-cached
+        dataflow's construction-time nodes: enclosing-function checks
+        below compare against this run's node identities)."""
+        defs: Dict[str, List[ast.AST]] = {}
+        seeds: List[ast.AST] = []
+        for pf in files:
+            for node in pf.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+                defs.setdefault(node.name, []).append(node)
+                if node.name in self._ROOT_FUNCS:
+                    seeds.append(node)
+            for node in pf.walk(ast.ClassDef):
+                if node.name in self._ROOT_CLASSES:
+                    seeds.extend(
+                        n
+                        for n in ast.walk(node)
+                        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    )
+        reachable, frontier = {id(fn) for fn in seeds}, list(seeds)
+        while frontier:
+            fn = frontier.pop()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = dotted_name(node.func).rsplit(".", 1)[-1]
+                for callee in defs.get(tail, ()):
+                    if id(callee) not in reachable:
+                        reachable.add(id(callee))
+                        frontier.append(callee)
+        return reachable
+
+    def check_project(self, files: List[ParsedFile]) -> Iterable:
+        targets = [pf for pf in files if _models_file(pf)]
+        if not targets:
+            return
+        df = dataflow.get(files)
+        reachable = self._reachable(files)
+        for pf in targets:
+            for node in pf.walk(ast.Call):
+                name = dotted_name(node.func)
+                tail = name.rsplit(".", 1)[-1] if name else ""
+                if tail not in SLOTSTATE_JIT_ENTRIES:
+                    continue
+                # the state rides first positionally in every entry, but a
+                # keyword-style call site must not disarm the rule
+                state_expr = node.args[0] if node.args else next(
+                    (kw.value for kw in node.keywords if kw.arg == "state"),
+                    None,
+                )
+                if state_expr is None:
+                    continue
+                fn = pf.enclosing_function(node)
+                if fn is None or id(fn) not in reachable:
+                    continue  # off the documented DeviceScheduler/frontier path
+                prov = df.prov(pf, state_expr, fn)
+                if prov and not (prov & dataflow.PLACED):
+                    yield self.finding(
+                        pf, node,
+                        f"{tail} consumes SlotState with provenance"
+                        f" {{{', '.join(sorted(prov))}}} — the arrays never"
+                        " routed through parallel.mesh placement"
+                        " (slot_shardings/axis_sharding/batch_sharding or"
+                        " an explicit device_put sharding), so the"
+                        " pre-sharded-placement invariant of the pjit"
+                        " solve path is broken at this call site",
+                    )
+
+
+def _slotstate_fields(pf: ParsedFile) -> List[Tuple[ast.ClassDef, List[str]]]:
+    out = []
+    for node in pf.walk(ast.ClassDef):
+        if node.name != "SlotState":
+            continue
+        if not any(dotted_name(b).endswith("NamedTuple") for b in node.bases):
+            continue
+        fields = [
+            st.target.id
+            for st in node.body
+            if isinstance(st, ast.AnnAssign) and isinstance(st.target, ast.Name)
+        ]
+        out.append((node, fields))
+    return out
+
+
+def _spec_keys(pf: ParsedFile) -> List[Tuple[ast.AST, List[str]]]:
+    out = []
+    for node in pf.walk(ast.Assign):
+        if not any(
+            isinstance(t, ast.Name) and t.id == "SLOT_STATE_SPECS"
+            for t in node.targets
+        ):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        keys = [
+            k.value
+            for k in node.value.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)
+        ]
+        out.append((node, keys))
+    return out
+
+
+@register
+class SlotStateSpecParity(Rule):
+    id = "GL502"
+    name = "slotstate-spec-parity"
+    rationale = (
+        "SLOT_STATE_SPECS (parallel/mesh.py) classifies every SlotState"
+        " field's slot-axis placement by name; a field added to one side"
+        " only is today a runtime raise on the first multi-device solve —"
+        " promote it to a lint error at edit time"
+    )
+    scope = "project"
+
+    def check_project(self, files: List[ParsedFile]) -> Iterable:
+        states: List[Tuple[ParsedFile, ast.AST, List[str]]] = []
+        specs: List[Tuple[ParsedFile, ast.AST, List[str]]] = []
+        for pf in files:
+            local_states = _slotstate_fields(pf)
+            local_specs = _spec_keys(pf)
+            if local_states and local_specs:
+                # fixture-style: both halves in one file pair locally
+                for snode, fields in local_states:
+                    for dnode, keys in local_specs:
+                        yield from self._compare(pf, snode, fields, pf, dnode, keys)
+                continue
+            states.extend((pf, n, f) for n, f in local_states)
+            specs.extend((pf, n, k) for n, k in local_specs)
+        # the tree shape: one SlotState (ops/ffd.py), one SLOT_STATE_SPECS
+        # (parallel/mesh.py). Partial-path runs that scan only one half
+        # stay silent — the tier-1 full-tree run sees both.
+        if len(states) == 1 and len(specs) == 1:
+            (spf, snode, fields), (dpf, dnode, keys) = states[0], specs[0]
+            yield from self._compare(spf, snode, fields, dpf, dnode, keys)
+
+    def _compare(self, spf, snode, fields, dpf, dnode, keys) -> Iterable:
+        missing = sorted(set(fields) - set(keys))
+        stale = sorted(set(keys) - set(fields))
+        if missing:
+            yield self.finding(
+                dpf, dnode,
+                f"SLOT_STATE_SPECS is missing SlotState field(s) {missing}"
+                " — classify their slot-axis placement (dim index or None"
+                " for replicated) or the first multi-device solve raises",
+            )
+        if stale:
+            yield self.finding(
+                dpf, dnode,
+                f"SLOT_STATE_SPECS names field(s) {stale} that SlotState"
+                " no longer has — remove the stale entries so the spec"
+                " table stays in lockstep with the state definition",
+            )
+
+
+@register
+class ShardedHostGather(Rule):
+    id = "GL503"
+    name = "sharded-host-gather"
+    rationale = (
+        "materializing a slot-sharded value on host (np.asarray,"
+        " .addressable_data, scalar int()/float(), a bare single-arg"
+        " jax.device_put) is an implicit full cross-device gather —"
+        " fetch through jax.device_get on a sliced window, or keep the"
+        " reduction on device"
+    )
+    scope = "project"
+
+    @staticmethod
+    def _sharded(prov: frozenset) -> bool:
+        """Unambiguously sharded: the attribute-summary fallback joins
+        same-named stores project-wide, so a host tag in the set means
+        the name ALSO carries host values somewhere — flagging would be
+        noise. Ambiguity degrades to silence, never to a false finding."""
+        return dataflow.SHARD in prov and dataflow.HOST not in prov
+
+    def check_project(self, files: List[ParsedFile]) -> Iterable:
+        targets = [pf for pf in files if _accel_file(pf)]
+        if not targets:
+            return
+        df = dataflow.get(files)
+        for pf in targets:
+            reaches = _reaches_slotstate_entry(pf)
+            traced = _traced_fns(pf)
+            for node in pf.walk(ast.Call):
+                fn = pf.enclosing_function(node)
+                if fn is not None and fn in traced:
+                    continue  # traced interior: GL101's territory
+                name = dotted_name(node.func)
+                tail = name.rsplit(".", 1)[-1] if name else ""
+                if (
+                    name.startswith(("np.", "numpy.", "onp."))
+                    and tail in ("asarray", "array", "copy")
+                    and node.args
+                ):
+                    prov = df.prov(pf, node.args[0], fn)
+                    if self._sharded(prov):
+                        yield self.finding(
+                            pf, node,
+                            f"{name} on a slot-sharded value is an implicit"
+                            " full gather across the mesh — device_get a"
+                            " sliced window instead (models/provisioner"
+                            " windowed fetch), or justify the transfer",
+                        )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "addressable_data"
+                ):
+                    prov = df.prov(pf, node.func.value, fn)
+                    if self._sharded(prov):
+                        yield self.finding(
+                            pf, node,
+                            ".addressable_data on a slot-sharded value"
+                            " reads one device's shard on host — per-shard"
+                            " host logic in the solve path breaks the"
+                            " single-program model; reduce on device",
+                        )
+                elif name in ("int", "float") and node.args:
+                    prov = df.prov(pf, node.args[0], fn)
+                    if self._sharded(prov):
+                        yield self.finding(
+                            pf, node,
+                            f"scalar {name}() on a slot-sharded value"
+                            " concretizes it on host (implicit gather +"
+                            " sync) — device_get the scalar explicitly or"
+                            " keep it on device",
+                        )
+                elif (
+                    name in ("jax.device_put", "device_put")
+                    and len(node.args) == 1
+                    and not node.keywords
+                    and reaches
+                ):
+                    yield self.finding(
+                        pf, node,
+                        "jax.device_put without a sharding in a module"
+                        " that drives a SlotState jit entry bypasses"
+                        " parallel.mesh placement — on a multi-device mesh"
+                        " the copy lands unannotated and every dispatch"
+                        " pays a reshard (was GL104)",
+                    )
+
+
+_DEVICE_NAMES = {"devices", "n_dev", "n_devices", "num_devices"}
+_SLOT_NAMES = {"n_slots", "max_slots", "num_slots", "slots", "N", "P", "n_pad"}
+_SHAPE_OPS = (ast.FloorDiv, ast.Mod, ast.Mult)
+
+
+def _mentioned_names(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+@register
+class PadToDevicesBypass(Rule):
+    id = "GL504"
+    name = "pad-to-devices-bypass"
+    rationale = (
+        "hand-rolled slot-axis shape arithmetic (slots // devices,"
+        " reshape over a device axis) silently truncates or crashes when"
+        " the slot count stops dividing the mesh — route slot-axis sizing"
+        " through parallel.mesh.pad_to_devices (padded slots are inert by"
+        " construction, the parity-tested invariant)"
+    )
+
+    def applies(self, pf: ParsedFile) -> bool:
+        return _accel_file(pf) or "/parallel/" in f"/{pf.relpath}"
+
+    def check(self, pf: ParsedFile) -> Iterable:
+        for node in pf.walk(ast.BinOp):
+            if not isinstance(node.op, _SHAPE_OPS):
+                continue
+            fn = pf.enclosing_function(node)
+            if getattr(fn, "name", "") == "pad_to_devices":
+                continue  # the sanctioned helper's own arithmetic
+            left, right = _mentioned_names(node.left), _mentioned_names(node.right)
+            if (left & _DEVICE_NAMES and right & _SLOT_NAMES) or (
+                right & _DEVICE_NAMES and left & _SLOT_NAMES
+            ):
+                yield self.finding(
+                    pf, node,
+                    "slot-axis shape arithmetic over the device count —"
+                    " size the slot axis with parallel.mesh.pad_to_devices"
+                    " so uneven meshes pad instead of truncating",
+                )
+        for node in pf.walk(ast.Call):
+            name = dotted_name(node.func)
+            tail = name.rsplit(".", 1)[-1] if name else ""
+            if tail != "reshape":
+                continue
+            shape_args = list(node.args)
+            if name in ("jnp.reshape", "np.reshape", "jax.numpy.reshape"):
+                shape_args = shape_args[1:]  # (array, shape)
+            flat: List[ast.AST] = []
+            for a in shape_args:
+                flat.extend(a.elts if isinstance(a, (ast.Tuple, ast.List)) else [a])
+            for a in flat[:2]:  # a device axis folds in front
+                names = _mentioned_names(a)
+                if names & _DEVICE_NAMES:
+                    yield self.finding(
+                        pf, node,
+                        "reshape folding a device axis into the slot dim"
+                        " re-implements mesh placement by hand — shard"
+                        " with parallel.mesh (axis_sharding/batch_sharding)"
+                        " and size with pad_to_devices",
+                    )
+                    break
