@@ -15,6 +15,12 @@ Secondary lines (reported in `detail`):
                   concurrent, shed rate + greedy-fallback parity, cache
                   evictions under a deliberately undersized bound, and
                   aggregate pods/sec across the fleet
+  cfg9_verified   the verification trust anchor's cost: the primary
+                  config runs with the ResultVerifier ON (the production
+                  default — every config above already pays it), and this
+                  summary pins the verify phase against the <5% of solve
+                  p50 budget; `--no-verify` is the escape hatch and its
+                  use is recorded in the JSON
   cfg8_multidev   the primary config sharded over the local device slice
                   (DeviceScheduler(devices=all), pjit over the slot
                   axis; target >=4x single-device pods/sec on >=8
@@ -48,11 +54,17 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 N_PODS = int(os.environ.get("BENCH_PODS", "50000"))
 N_TYPES = int(os.environ.get("BENCH_TYPES", "800"))
 FAST = os.environ.get("BENCH_FAST", "") == "1"
+# --no-verify: the escape hatch for isolating verification cost — the
+# production default is verification ON, and the flag's use is RECORDED in
+# the bench JSON so a suspiciously fast run can't hide that it skipped the
+# trust anchor
+NO_VERIFY = "--no-verify" in sys.argv
 GIB = 2.0**30
 
 
@@ -279,12 +291,13 @@ def _spread(times):
 def _phase_breakdown(sched) -> dict:
     """Per-phase split of the LAST solve (DeviceScheduler.last_phase_stats):
     host plan (topology groups + class sort), host prepare (tensor
-    build/cache), device dispatch incl. the result fetch, host decode —
-    plus the device<->host bytes actually moved, so the next round can see
-    where the remaining time lives without re-profiling."""
+    build/cache), device dispatch incl. the result fetch, host decode, and
+    the result-verification pass — plus the device<->host bytes actually
+    moved, so the next round can see where the remaining time lives
+    without re-profiling."""
     st = sched.last_phase_stats or {}
     out = {}
-    for k in ("plan_s", "prepare_s", "kernel_s", "decode_s"):
+    for k in ("plan_s", "prepare_s", "kernel_s", "decode_s", "verify_s"):
         if k in st:
             out[k] = round(st[k], 4)
     # n_devices + per-device h2d/fetch bytes ride every config so single-
@@ -299,12 +312,17 @@ def _phase_breakdown(sched) -> dict:
 
 
 def _solve_bench(pods, nodepools, catalog, max_slots=1024, repeats=5,
-                 parity=True, devices=1):
+                 parity=True, devices=1, verify=None):
     from karpenter_core_tpu.models.provisioner import DeviceScheduler
 
+    # verify defaults to the RUN-WIDE flag: --no-verify must govern every
+    # config, or the recorded "verification": false would lie about which
+    # numbers still paid the trust anchor
+    if verify is None:
+        verify = not NO_VERIFY
     its = {p.name: list(catalog) for p in nodepools}
     sched = DeviceScheduler(
-        nodepools, its, max_slots=max_slots, devices=devices
+        nodepools, its, max_slots=max_slots, devices=devices, verify=verify
     )
 
     t0 = time.perf_counter()
@@ -332,6 +350,39 @@ def _solve_bench(pods, nodepools, catalog, max_slots=1024, repeats=5,
         out["greedy_nodes"] = greedy_nodes
         out["greedy_solve_s"] = round(greedy_s, 1)
         out["parity_nodes_delta"] = res.node_count() - greedy_nodes
+    return out
+
+
+def _verified_summary(primary: dict, cfg1: dict) -> dict:
+    """cfg9_verified: the verification trust anchor's cost, pinned.
+
+    Verification is ON in the primary config (the production default), so
+    its per-solve cost already rides every measurement above as the
+    ``verify_s`` phase; this summary judges it against the <5% budget —
+    relative to cfg1's solve p50 (the acceptance reference) and to the
+    primary's own p50 — and records whether the --no-verify escape hatch
+    was pulled for this run."""
+    verify_s = (primary.get("phases") or {}).get("verify_s")
+    out = {
+        "verification_on": not NO_VERIFY,
+        "verify_s": verify_s,
+        "pods": N_PODS,
+    }
+    if verify_s is None:
+        out["skipped"] = "--no-verify: no verification phase measured"
+        return out
+    p50 = primary["p50_solve_s"]
+    out["pct_of_primary_p50"] = round(100.0 * verify_s / p50, 2) if p50 else None
+    if cfg1:
+        ref = cfg1["p50_solve_s"]
+        # the verify phase scales with pod count; cfg1's own verify cost
+        # is the like-for-like comparison at the 5k point
+        cfg1_verify = (cfg1.get("phases") or {}).get("verify_s")
+        out["cfg1_p50_s"] = ref
+        out["cfg1_verify_s"] = cfg1_verify
+        if cfg1_verify is not None and ref:
+            out["cfg1_pct_of_p50"] = round(100.0 * cfg1_verify / ref, 2)
+            out["budget_ok"] = cfg1_verify <= 0.05 * ref
     return out
 
 
@@ -542,6 +593,7 @@ def _sidecar_bench(n_pods=5000, n_types=400, repeats=5):
         rs = remote.RemoteScheduler(
             client, pools, dict(its),
             device_scheduler_opts={"max_slots": 1024},
+            verify=not NO_VERIFY,
         )
         rpc_times = []
         for _ in range(repeats):
@@ -632,6 +684,7 @@ def _fleet_bench(n_tenants=8, n_pods=1000, n_types=200, repeats=3):
             return remote.RemoteScheduler(
                 client, p["pools"], p["its"],
                 device_scheduler_opts={"max_slots": 1024},
+                verify=not NO_VERIFY,
             )
 
         # -- solo baselines (also the shared compile warm-up) -------------
@@ -980,6 +1033,14 @@ def main():
             max_slots=4096,
             repeats=3,
         )
+        # cfg9_verified: the primary config WITH verification (the
+        # production default) — the verifier pass is a phase of every
+        # solve above; here its cost is pinned against the solve p50 and
+        # judged against the <5% budget (vs cfg1's p50, the reference
+        # point the acceptance names, and vs the primary's own p50)
+        detail["cfg9_verified"] = _verified_summary(
+            primary, detail.get("cfg1_5k400")
+        )
         detail["shape_churn"] = _shape_churn_bench()
         detail["cfg4_consol"] = _consolidation_bench()
         detail["cfg5_sidecar"] = _sidecar_bench()
@@ -998,6 +1059,9 @@ def main():
                 "unit": "pods/sec",
                 "vs_baseline": round(pods_per_sec / 100.0, 2),
                 "budget_ok": budget_ok,
+                # the escape hatch's use is part of the record: a run
+                # without verification is not comparable to one with it
+                "verification": not NO_VERIFY,
                 "detail": detail,
             }
         )

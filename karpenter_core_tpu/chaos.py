@@ -139,6 +139,87 @@ class ChaosKubeClient:
         return out
 
 
+class SolverChaos:
+    """Device-tier fault injector for the solverd sidecar (the third chaos
+    seam, after the kube client and the cloud provider): installed on a
+    ``SolverDaemon`` it perturbs the solve pipeline at the three points
+    the robustness layer must survive —
+
+    * ``wedge`` / ``wedge:<s>`` — the device step sleeps past the
+      watchdog budget (the wedged-solve shape: the exclusive grant is
+      held, the watchdog trips, the process exits crash-only);
+    * ``crash`` — the device step raises (the poison-pill shape: the
+      client sees a 500, both quarantines count a strike, and N crashes
+      route the problem straight to greedy fleet-wide);
+    * ``corrupt_wire`` — the encoded result bytes are deterministically
+      damaged (truncation + bit flips), exercising the client's decode/
+      ``_materialize`` hardening and the quarantine strike path;
+    * ``bad_result`` — the Results object is sabotaged BEFORE encoding
+      (a pod silently dropped from a claim), producing a structurally
+      valid wire whose content fails the client's ResultVerifier.
+
+    Faults draw from the shared seeded ``ChaosSchedule`` (seam
+    ``solverd.solve``), so a soak replays identically per seed."""
+
+    FAULTS = ("wedge", "crash", "corrupt_wire", "bad_result")
+
+    def __init__(
+        self,
+        schedule: ChaosSchedule,
+        wedge_seconds: float = 1.0,
+        sleep=time.sleep,
+    ):
+        self.schedule = schedule
+        self.wedge_seconds = wedge_seconds
+        self.sleep = sleep
+        self.injected: Dict[str, int] = {}
+
+    def next_fault(self) -> str:
+        return self.schedule.next_fault("solverd.solve", self.FAULTS)
+
+    def _count(self, fault: str) -> None:
+        self.injected[fault] = self.injected.get(fault, 0) + 1
+
+    def wedge(self, fault: str) -> None:
+        """Hold the exclusive device grant well past any sane budget."""
+        self._count("wedge")
+        seconds = self.wedge_seconds
+        if ":" in fault:
+            seconds = float(fault.split(":", 1)[1])
+        self.sleep(seconds)
+
+    def crash(self) -> None:
+        """Blow up the device phase (counted as a poison strike by both
+        quarantine sites; the client sees a 500)."""
+        self._count("crash")
+        raise RuntimeError("chaos: injected device-phase crash")
+
+    def corrupt(self, data: bytes) -> bytes:
+        """Deterministic wire damage: drop the tail and flip bytes in the
+        middle — enough to defeat both the npz container and any JSON
+        inside, without randomness (the soak must replay per seed)."""
+        self._count("corrupt_wire")
+        if len(data) < 16:
+            return b"\x00" * len(data)
+        cut = data[: max(len(data) // 2, 8)]
+        mid = len(cut) // 2
+        return cut[:mid] + bytes(b ^ 0xFF for b in cut[mid:mid + 8]) + cut[mid + 8:]
+
+    def sabotage(self, results) -> None:
+        """Make a valid Results lie: silently drop one placed pod (it
+        stays out of pod_errors, so pod conservation breaks — the exact
+        defect class an optimizing-backend bug would produce)."""
+        self._count("bad_result")
+        for claim in results.new_node_claims:
+            if claim.pods:
+                claim.pods.pop()
+                return
+        for sim in results.existing_nodes:
+            if sim.pods:
+                sim.pods.pop()
+                return
+
+
 class IceStorm(NamedTuple):
     """A capacity stockout window: ``offerings`` are unfillable during
     [start, start+duration) of the provider's clock."""

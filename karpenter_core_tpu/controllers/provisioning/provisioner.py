@@ -39,6 +39,7 @@ class Provisioner:
         recorder=None,
         solver_client=None,
         unavailable_offerings=None,
+        verify_results: bool = True,
     ):
         self.kube = kube
         self.cluster = cluster
@@ -56,6 +57,10 @@ class Provisioner:
         # the solverd sidecar via solver/remote.py; the client owns the
         # circuit breaker, so it outlives individual schedulers
         self.solver_client = solver_client
+        # host-side verification of every device/sidecar result
+        # (solver/verify.py) before the reconcilers act on it; a rejected
+        # result degrades that solve to greedy and emits a Warning event
+        self.verify_results = verify_results
         # host+device profiling hook (reference pprof, operator.go:159-175):
         # set by the operator from --profile-solves / --profile-dir
         self.profile_solves = 0
@@ -183,12 +188,16 @@ class Provisioner:
                     self.solver_client,
                     topology=topology,
                     device_scheduler_opts=self.device_scheduler_opts,
+                    verify=self.verify_results,
+                    recorder=self.recorder,
                     **common,
                 )
             from karpenter_core_tpu.models.provisioner import DeviceScheduler
 
             return DeviceScheduler(
-                topology=topology, **common, **self.device_scheduler_opts
+                topology=topology, verify=self.verify_results,
+                recorder=self.recorder,
+                **common, **self.device_scheduler_opts,
             )
         return Scheduler(topology=topology, **common)
 

@@ -3,8 +3,10 @@
 Builds the full controller stack over the in-memory store + kwok provider
 and runs the reconcile loop. Flags/env parse through Options.parse
 (--solver greedy|tpu, --solver-mode inproc|sidecar, --solver-addr,
---solver-timeout, --batch-max-duration, --batch-idle-duration,
---log-level, --feature-gates Name=true,...), plus loop controls:
+--solver-timeout, --solver-verify true|false (host-side verification of
+every device/sidecar result — on by default), --batch-max-duration,
+--batch-idle-duration, --log-level, --feature-gates Name=true,...), plus
+loop controls:
 --poll-interval seconds between passes, --max-iters to bound the run
 (0 = run until interrupted).
 

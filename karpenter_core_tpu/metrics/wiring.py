@@ -199,9 +199,37 @@ SOLVERD_SCHED_CACHE = REGISTRY.counter(
     "Sidecar DeviceScheduler reuse across RPC solves by outcome (hit|miss)"
     " — a hit carries the prepared-state caches across the wire boundary",
 )
-SOLVER_SIDECAR_RESTARTS = REGISTRY.counter(
-    "solver_sidecar_restarts_total",
-    "Sidecar processes respawned by the supervisor",
+SOLVERD_RESTARTS = REGISTRY.counter(
+    "solverd_restarts_total",
+    "Sidecar processes respawned by the supervisor, by cause: crash (the"
+    " child died or was watchdog-killed; charges crash-loop backoff) vs"
+    " drain (a clean drain-exit — the child flushed its queue and asked to"
+    " be restarted; respawns immediately, never charges backoff)",
+)
+SOLVER_RESULT_REJECTED = REGISTRY.counter(
+    "solver_result_rejected_total",
+    "Solve results that failed host-side verification (solver/verify.py),"
+    " by violated-invariant reason and solve path (inproc|sidecar|frontier);"
+    " every rejection degrades that solve to the greedy path — a moving"
+    " counter means the device tier is producing untrustworthy packings",
+)
+SOLVER_QUARANTINE_ENTRIES = REGISTRY.gauge(
+    "solverd_quarantine_entries",
+    "Problem fingerprints currently quarantined as poison pills, by site"
+    " (client: the operator routes them straight to greedy; gateway: the"
+    " sidecar refuses them pre-decode with 422)",
+)
+SOLVER_QUARANTINE_ROUTED = REGISTRY.counter(
+    "solver_quarantine_routed_total",
+    "Requests short-circuited by an active poison-pill quarantine entry,"
+    " by site — device grants and sidecar respawns this problem did NOT"
+    " burn",
+)
+SOLVERD_WATCHDOG_TRIPS = REGISTRY.counter(
+    "solverd_watchdog_trips_total",
+    "Device-step watchdog trips: the exclusive device phase exceeded its"
+    " hard wall-clock bound and the sidecar exited crash-only (queued"
+    " requests were flushed with 503 first; the supervisor respawns)",
 )
 
 # -- fleetd: the multi-tenant solve gateway (solver/fleet.py) --------------

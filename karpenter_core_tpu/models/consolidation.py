@@ -179,7 +179,7 @@ def schedulability_frontier(
     # operator threads --solver-devices through device_scheduler_opts);
     # a sidecar owns its own device count (solverd --devices)
     dev_opts = getattr(provisioner, "device_scheduler_opts", None) or {}
-    return frontier_core(
+    frontier = frontier_core(
         nodepools,
         instance_types,
         cand_nodes,
@@ -190,6 +190,20 @@ def schedulability_frontier(
         max_slots=max_slots,
         devices=dev_opts.get("devices", 1),
     )
+    # the same structural trust anchor the sidecar path applies
+    # (solver/remote.remote_frontier): a defective frontier degrades to
+    # the caller's host binary search, never into a disruption command
+    from karpenter_core_tpu.solver.verify import verify_frontier
+
+    defect = verify_frontier(frontier)
+    if defect is not None:
+        from karpenter_core_tpu.metrics import wiring as m
+
+        m.SOLVER_RESULT_REJECTED.inc(
+            {"reason": "structure", "path": "frontier"}
+        )
+        return None
+    return frontier
 
 
 def frontier_core(

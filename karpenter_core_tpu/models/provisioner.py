@@ -235,6 +235,8 @@ class DeviceScheduler:
         topology: Optional[Topology] = None,
         unavailable_offerings: "frozenset | set" = frozenset(),
         devices: int = 1,
+        verify: bool = True,
+        recorder=None,
     ):
         # ICE'd offerings project onto the catalog exactly like the greedy
         # path (apply_unavailable), so the host-side machinery — template
@@ -340,6 +342,17 @@ class DeviceScheduler:
         self._h2d_bytes = 0
         self._h2d_dev_bytes = 0
         self.last_phase_stats: Dict[str, float] = {}
+        # host-side result verification (solver/verify.py): an independent
+        # O(pods) constraint re-check over the final Results — the trust
+        # anchor between the device kernels and NodeClaim creation. A
+        # rejected result degrades THIS solve to the greedy host path
+        # (metrics + Warning event via the recorder when one is wired).
+        self.verify = verify
+        self.recorder = recorder
+        # built lazily ONCE: the verifier's setup (domain universe,
+        # per-pool catalog name sets) is invariant for this scheduler's
+        # lifetime — only the topology context swaps per request
+        self._verifier = None
 
     _FP_CACHE_CAP = 4
     _BATCH_CACHE_CAP = 4
@@ -512,11 +525,52 @@ class DeviceScheduler:
 
         for c in claims:
             c.finalize_scheduling()
-        return Results(
+        results = Results(
             new_node_claims=claims,
             existing_nodes=existing_sims,
             pod_errors=errors,
         )
+        if self.verify:
+            from karpenter_core_tpu.solver import verify as verifymod
+
+            t0 = time.perf_counter()
+            if self._verifier is None:
+                self._verifier = verifymod.ResultVerifier(
+                    self.nodepools,
+                    self.instance_types,
+                    existing_nodes=self.existing_nodes,
+                    daemonset_pods=self.daemonset_pods,
+                    topology=self._topology_context,
+                    unavailable_offerings=self.unavailable_offerings,
+                )
+            else:
+                # a cached scheduler (solverd reuse) swaps contexts per
+                # request; everything else the verifier holds is invariant
+                self._verifier.topology = self._topology_context
+            violations = self._verifier.verify(results, all_pods)
+            stats["verify_s"] = time.perf_counter() - t0
+            if violations:
+                verifymod.reject(violations, "inproc", self.recorder)
+                return self._verified_fallback(all_pods)
+        return results
+
+    def _verified_fallback(self, pods: List[Pod]) -> Results:
+        """A device result failed verification: re-solve on the host
+        greedy path over the same inputs (the RemoteScheduler degradation
+        twin, one layer down). Correctness beats speed exactly once — the
+        rejection metric says the device tier needs attention."""
+        from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+            Scheduler,
+        )
+
+        return Scheduler(
+            self.nodepools,
+            self.instance_types,
+            existing_nodes=self.existing_nodes,
+            daemonset_pods=self.daemonset_pods,
+            topology=self._topology_context,
+            unavailable_offerings=self.unavailable_offerings,
+        ).solve(pods)
 
     # ------------------------------------------------------------------
 

@@ -63,6 +63,17 @@ RECONCILE_BACKOFF_CAP = 60.0
 CRASHLOOP_THRESHOLD = 3
 
 
+def _parse_bool(value: str) -> bool:
+    """Flag/env bool: the feature-gate truthy set, rejecting typos loudly
+    (a misspelled 'fales' must not silently enable verification-off)."""
+    low = value.strip().lower()
+    if low in ("true", "1", "yes", "on"):
+        return True
+    if low in ("false", "0", "no", "off"):
+        return False
+    raise ValueError(f"not a boolean: {value!r}")
+
+
 @dataclass
 class Options:
     """Flag surface (reference: pkg/operator/options/options.go:49-102, plus
@@ -78,6 +89,20 @@ class Options:
     solver_mode: str = "inproc"  # inproc | sidecar
     solver_addr: str = ""
     solver_timeout: float = 30.0  # per-RPC deadline, seconds
+    # host-side verification of every device/sidecar solve result
+    # (solver/verify.py) before the reconcilers act on it: the trust
+    # anchor that lets optimizing backends swap in behind the Solver seam.
+    # A rejected result degrades that solve to greedy with
+    # solver_result_rejected_total{reason} + a Warning event.
+    solver_verify: bool = True
+    # crash-only survivability knobs for a SPAWNED sidecar (an external
+    # --solver-addr sidecar configures its own): the hard wall-clock bound
+    # on the exclusive device step (0 disables; rides the spawn argv as
+    # solverd --watchdog-seconds), and the poison-pill journal path that
+    # lets the gateway's quarantine survive the very crash it predicts
+    # (empty = in-memory quarantine only)
+    solver_watchdog_seconds: float = 120.0
+    solver_quarantine_journal: str = ""
     # shard the solve over the first N local devices (parallel/mesh.py
     # slot mesh; 0 = all local devices, 1 = single-device). In-proc this
     # threads into the DeviceScheduler; in sidecar mode it rides the
@@ -117,6 +142,19 @@ class Options:
         "solver_addr": ("--solver-addr", "KARPENTER_SOLVER_ADDR", str),
         "solver_timeout": (
             "--solver-timeout", "KARPENTER_SOLVER_TIMEOUT", float,
+        ),
+        "solver_verify": (
+            "--solver-verify", "KARPENTER_SOLVER_VERIFY", _parse_bool,
+        ),
+        "solver_watchdog_seconds": (
+            "--solver-watchdog-seconds",
+            "KARPENTER_SOLVER_WATCHDOG_SECONDS",
+            float,
+        ),
+        "solver_quarantine_journal": (
+            "--solver-quarantine-journal",
+            "KARPENTER_SOLVER_QUARANTINE_JOURNAL",
+            str,
         ),
         "solver_tenant": (
             "--solver-tenant", "KARPENTER_SOLVER_TENANT", str,
@@ -202,6 +240,11 @@ class Options:
             raise ValueError(
                 "--solver-devices must be >= 0 (0 = all local devices),"
                 f" got {opts.solver_devices}"
+            )
+        if opts.solver_watchdog_seconds < 0:
+            raise ValueError(
+                "--solver-watchdog-seconds must be >= 0 (0 disables),"
+                f" got {opts.solver_watchdog_seconds}"
             )
         # malformed weights must fail at the flag surface, not inside a
         # respawned sidecar's argparse three failures deep
@@ -301,6 +344,15 @@ class Operator:
                         if self.options.solver_devices != 1
                         else None
                     ),
+                    # crash-only survivability: the watchdog bound is
+                    # explicit policy (it rides the argv so a respawned
+                    # child keeps it), and the poison journal is what
+                    # makes gateway-side quarantine survive the crash it
+                    # predicts
+                    watchdog_seconds=self.options.solver_watchdog_seconds,
+                    quarantine_journal=(
+                        self.options.solver_quarantine_journal or None
+                    ),
                 )
                 addr = self.solver_supervisor.start()
             self.solver_client = SolverClient(
@@ -326,6 +378,7 @@ class Operator:
             recorder=self.recorder,
             solver_client=self.solver_client,
             unavailable_offerings=self.unavailable_offerings,
+            verify_results=self.options.solver_verify,
         )
         self.provisioner.profile_solves = self.options.profile_solves
         self.provisioner.profile_dir = self.options.profile_dir

@@ -90,7 +90,7 @@ def encode_request(
 def decode_request(data: bytes):
     """Inverse of encode_request: (vocab, resource_names, class_masks,
     class_requests, class_counts, it_masks, it_allocatable)."""
-    z = np.load(io.BytesIO(data))
+    z = _load_npz(data)
     header = json.loads(bytes(z[_HEADER_KEY]).decode())
     if header.get("version") != SNAPSHOT_WIRE_VERSION:
         # explicit skew error, same policy as the solverd decoders below: a
@@ -132,7 +132,7 @@ def encode_response(
 
 
 def decode_response(data: bytes) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    z = np.load(io.BytesIO(data))
+    z = _load_npz(data)
     return z["takes"], z["unplaced"], z["slot_template"]
 
 
@@ -169,9 +169,26 @@ def _json_payload(header: dict) -> bytes:
     return buf.getvalue()
 
 
+def _load_npz(data: bytes):
+    """np.load with container-level damage normalized to ValueError: a
+    truncated/corrupt npz raises zipfile.BadZipFile (and friends) which
+    would sail past the decode-failure nets in solver/remote.py — every
+    decoder here funnels through this so "malformed bytes" is ALWAYS a
+    ValueError, never a transport-specific surprise in a reconciler."""
+    import zipfile
+
+    try:
+        return np.load(io.BytesIO(data))
+    except (zipfile.BadZipFile, OSError, EOFError, IndexError) as e:
+        raise ValueError(f"malformed wire container: {e}") from e
+
+
 def _json_header(data: bytes) -> dict:
-    z = np.load(io.BytesIO(data))
-    return json.loads(bytes(z[_HEADER_KEY]).decode())
+    z = _load_npz(data)
+    try:
+        return json.loads(bytes(z[_HEADER_KEY]).decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"malformed wire header: {e}") from e
 
 
 def _encode_req(r) -> dict:
@@ -622,7 +639,7 @@ def encode_frontier_response(frontier) -> bytes:
 
 
 def decode_frontier_response(data: bytes):
-    z = np.load(io.BytesIO(data))
+    z = _load_npz(data)
     header = json.loads(bytes(z[_HEADER_KEY]).decode())
     if header.get("version") != SOLVE_WIRE_VERSION:
         raise ValueError(

@@ -77,6 +77,30 @@ class ShedError(Exception):
         self.retry_after = retry_after
 
 
+class DrainError(Exception):
+    """The gateway is draining: admission is closed and queued requests
+    are being flushed ahead of a clean restart. The HTTP layer answers
+    503 (drain ≠ shed ≠ fault: the client degrades this solve to greedy
+    without charging the circuit breaker — the sidecar ANSWERED, it is
+    restarting, not dead)."""
+
+    def __init__(self, message: str = "gateway draining"):
+        super().__init__(message)
+
+
+class QuarantinedError(Exception):
+    """A request refused because its problem fingerprint is quarantined as
+    a poison pill. The HTTP layer answers 422; the client routes the solve
+    straight to greedy (and quarantines locally) without burning a device
+    grant or charging the breaker."""
+
+    def __init__(self, fingerprint: str, message: str = ""):
+        super().__init__(
+            message or f"fingerprint {fingerprint[:12]} quarantined"
+        )
+        self.fingerprint = fingerprint
+
+
 def parse_tenant_weights(spec: str) -> Dict[str, float]:
     """``"a=3,b=1.5"`` -> ``{"a": 3.0, "b": 1.5}`` (the --tenant-weights
     flag format). Unlisted tenants get the gateway's default weight."""
@@ -166,6 +190,9 @@ class FleetGateway:
         self._wait_samples: Dict[str, deque] = {}
         self._shed_counts: Dict[str, int] = {}
         self._grant_count = 0
+        # drain mode: admission closed, queue flushed with 503s ahead of a
+        # clean (supervisor-respawned) process exit
+        self._draining = False
 
     # -- admission ---------------------------------------------------------
 
@@ -186,12 +213,15 @@ class FleetGateway:
         deadline: Optional[float] = None,
     ) -> Ticket:
         """Admission decision, made BEFORE the request body is decoded (a
-        shed must cost the sidecar nothing). Raises ShedError, or returns
-        a Ticket the caller must resolve via await_grant+release (or
-        abandon on a pre-grant failure)."""
+        shed must cost the sidecar nothing). Raises ShedError (overload),
+        DrainError (restarting), or returns a Ticket the caller must
+        resolve via await_grant+release (or abandon on a pre-grant
+        failure)."""
         if lane not in _LANES:
             raise ValueError(f"unknown lane {lane!r}")
         with self._lock:
+            if self._draining:
+                raise DrainError()
             now = self.time_fn()
             p50 = self._device_p50_locked()
             if self._pending >= self.max_depth:
@@ -238,8 +268,14 @@ class FleetGateway:
         this ticket the device. Raises ShedError if the ticket's deadline
         expired while it queued (the client has already degraded to
         greedy; running the solve anyway would burn device time on an
-        answer nobody reads)."""
+        answer nobody reads), or DrainError when the gateway drained the
+        queue out from under it."""
         with self._lock:
+            if self._draining:
+                ticket.state = "drained"
+                self._pending -= 1
+                self._export_depth_locked()
+                raise DrainError()
             ticket.ready_at = self.time_fn()
             ticket.state = "queued"
             lanes = self._queued.get(ticket.tenant)
@@ -261,6 +297,8 @@ class FleetGateway:
                 "expired", self.device_p50(),
                 "deadline expired while queued",
             )
+        if ticket.state == "drained":
+            raise DrainError()
 
     def _dispatch_locked(self) -> None:
         with self._lock:
@@ -395,6 +433,38 @@ class FleetGateway:
                 self._export_depth_locked()
             self._dispatch_locked()
 
+    # -- drain (the crash-only restart path) -------------------------------
+
+    def drain(self) -> int:
+        """Close admission and flush every queued ticket with a drain
+        rejection (their handler threads answer 503 — queued requests must
+        never just VANISH into a process exit). The active device ticket,
+        if any, is left to finish or be watchdog-killed; returns the number
+        of tickets flushed."""
+        with self._lock:
+            self._draining = True
+            flushed = 0
+            for lanes in list(self._queued.values()):
+                for lane in _LANES:
+                    while lanes[lane]:
+                        ticket = lanes[lane].popleft()
+                        ticket.state = "drained"
+                        self._pending -= 1
+                        flushed += 1
+                        ticket.event.set()
+            self._export_depth_locked()
+            return flushed
+
+    def resume(self) -> None:
+        """Re-open admission (in-thread test servers; a real sidecar exits
+        after drain and respawns fresh)."""
+        with self._lock:
+            self._draining = False
+
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
     # -- observability -----------------------------------------------------
 
     def depth(self) -> int:
@@ -442,6 +512,212 @@ class FleetGateway:
                 self._shed_counts = {}
                 self._grant_count = 0
             return out
+
+
+# poison-pill defaults (service flags / client kwargs override)
+QUARANTINE_STRIKES = 3
+QUARANTINE_TTL = 300.0
+QUARANTINE_CAP = 1024
+
+
+class PoisonQuarantine:
+    """TTL'd poison-pill ledger over problem fingerprints (sha256 of the
+    canonical request body — PR 4 made wire bytes canonical per logical
+    problem, so the digest is stable across retries of the same problem).
+
+    A problem that crashes, hangs, corrupts its result, or fails
+    verification ``strikes`` times inside the TTL window is quarantined:
+    for ``ttl`` seconds it routes straight to the greedy path (client
+    site) or is refused pre-decode with 422 (gateway site) instead of
+    burning device grants — and, for the wedge-the-process shapes,
+    sidecar respawns — for every tenant. A success clears the strike
+    count; quarantine entries expire on their own (the problem gets a
+    fresh chance — the fault may have been environmental).
+
+    The optional journal is the crash-only half: the gateway records the
+    fingerprint it is ABOUT to solve (``begin``) and clears it on
+    completion (``done``), so a poison pill that kills the process is
+    found in the journal at next boot and charged a strike even though
+    the process that hit it never got to say so.
+
+    All shared state is mutated under ``self._lock`` (the ``_locked``
+    helper discipline graftlint GL302/GL303 checks)."""
+
+    def __init__(
+        self,
+        strikes: int = QUARANTINE_STRIKES,
+        ttl: float = QUARANTINE_TTL,
+        cap: int = QUARANTINE_CAP,
+        time_fn=time.monotonic,
+        site: str = "client",
+        journal_path: Optional[str] = None,
+    ):
+        if strikes <= 0:
+            raise ValueError(f"strikes must be positive, got {strikes}")
+        self.strikes = strikes
+        self.ttl = ttl
+        self.cap = cap
+        self.time_fn = time_fn
+        self.site = site
+        self.journal_path = journal_path
+        self._lock = threading.RLock()
+        self._strike_counts: Dict[str, tuple] = {}  # fp -> (count, last_at)
+        self._entries: Dict[str, float] = {}  # fp -> quarantined_until
+        self._inflight: set = set()
+        if journal_path is not None:
+            self._recover_journal()
+
+    # -- the ledger --------------------------------------------------------
+
+    def strike(self, fingerprint: str, reason: str = "fault") -> bool:
+        """Record one fault against a fingerprint; returns True when this
+        strike tipped it into quarantine."""
+        with self._lock:
+            now = self.time_fn()
+            count, last_at = self._strike_counts.get(fingerprint, (0, now))
+            if now - last_at > self.ttl:
+                count = 0  # stale streak: faults outside the window forgive
+            count += 1
+            self._strike_counts[fingerprint] = (count, now)
+            if count < self.strikes:
+                self._prune_locked(now)
+                return False
+            self._entries[fingerprint] = now + self.ttl
+            del self._strike_counts[fingerprint]
+            self._prune_locked(now)
+            self._export_locked()
+            return True
+
+    def poison(self, fingerprint: str) -> None:
+        """Quarantine immediately (the gateway already counted its strikes
+        and told us via 422 — no reason to re-learn locally)."""
+        with self._lock:
+            self._entries[fingerprint] = self.time_fn() + self.ttl
+            self._strike_counts.pop(fingerprint, None)
+            self._prune_locked(self.time_fn())
+            self._export_locked()
+
+    def quarantined(self, fingerprint: str) -> bool:
+        with self._lock:
+            until = self._entries.get(fingerprint)
+            if until is None:
+                return False
+            if self.time_fn() >= until:
+                del self._entries[fingerprint]
+                self._export_locked()
+                return False
+            return True
+
+    def clear(self, fingerprint: str) -> None:
+        """A success: the problem is not poison — drop its strike streak.
+        An ACTIVE quarantine entry stays until its TTL (a success can only
+        have come from the greedy path while quarantined)."""
+        with self._lock:
+            self._strike_counts.pop(fingerprint, None)
+
+    def size(self) -> int:
+        with self._lock:
+            now = self.time_fn()
+            stale = [fp for fp, t in self._entries.items() if now >= t]
+            for fp in stale:
+                del self._entries[fp]
+            if stale:
+                self._export_locked()
+            return len(self._entries)
+
+    def _prune_locked(self, now: float) -> None:
+        """Bound both maps: fingerprints are derived from client-supplied
+        bodies, so an unbounded ledger is a memory leak with extra steps."""
+        with self._lock:
+            if len(self._strike_counts) > self.cap:
+                stale = sorted(
+                    self._strike_counts.items(), key=lambda kv: kv[1][1]
+                )
+                for fp, _ in stale[: len(self._strike_counts) - self.cap]:
+                    del self._strike_counts[fp]
+            expired = [fp for fp, t in self._entries.items() if now >= t]
+            for fp in expired:
+                del self._entries[fp]
+            if len(self._entries) > self.cap:
+                soonest = sorted(self._entries.items(), key=lambda kv: kv[1])
+                for fp, _ in soonest[: len(self._entries) - self.cap]:
+                    del self._entries[fp]
+
+    def _export_locked(self) -> None:
+        from karpenter_core_tpu.metrics import wiring as m
+
+        with self._lock:
+            m.SOLVER_QUARANTINE_ENTRIES.set(
+                float(len(self._entries)), {"site": self.site}
+            )
+
+    # -- crash-only journal ------------------------------------------------
+
+    def begin(self, fingerprint: str) -> None:
+        """Mark a fingerprint in flight on the device. If the process dies
+        before ``done``, the next boot finds it in the journal and charges
+        the crash it never lived to report."""
+        if self.journal_path is None:
+            return
+        with self._lock:
+            self._inflight.add(fingerprint)
+            self._write_journal_locked()
+
+    def done(self, fingerprint: str) -> None:
+        if self.journal_path is None:
+            return
+        with self._lock:
+            self._inflight.discard(fingerprint)
+            self._write_journal_locked()
+
+    def _write_journal_locked(self) -> None:
+        import json as _json
+        import os as _os
+
+        with self._lock:
+            # write-temp + atomic rename: the journal exists to survive a
+            # process death, so the death must never catch it half-written
+            # (a torn in-place rewrite would parse as garbage at recovery
+            # and silently forget the very strike it was recording)
+            tmp = f"{self.journal_path}.tmp"
+            try:
+                with open(tmp, "w") as f:
+                    _json.dump(
+                        {
+                            "inflight": sorted(self._inflight),
+                            "strikes": {
+                                fp: count
+                                for fp, (count, _at) in
+                                self._strike_counts.items()
+                            },
+                        },
+                        f,
+                    )
+                _os.replace(tmp, self.journal_path)
+            except OSError:
+                pass  # journal loss degrades protection, never the solve
+
+    def _recover_journal(self) -> None:
+        import json as _json
+
+        try:
+            with open(self.journal_path) as f:
+                state = _json.load(f)
+        except (OSError, ValueError):
+            return
+        now = self.time_fn()
+        with self._lock:
+            for fp, count in dict(state.get("strikes", {})).items():
+                self._strike_counts[fp] = (int(count), now)
+        # every fingerprint in flight at death gets the strike the dead
+        # process could not record — N wedge-deaths in a row quarantine it
+        for fp in state.get("inflight", []):
+            self.strike(fp, "crash-recovered")
+        # persist the merged view with the inflight set CLEARED: the
+        # strike is recorded now, and a later clean boot must not
+        # re-charge it
+        with self._lock:
+            self._write_journal_locked()
 
 
 class BoundedSchedulerCache:

@@ -33,6 +33,7 @@ degradation path is testable without real process failures.
 """
 from __future__ import annotations
 
+import hashlib
 import http.client
 import socket
 import time
@@ -46,6 +47,10 @@ STATE_OPEN = 2
 
 _STATE_NAMES = {0: "closed", 1: "half-open", 2: "open"}
 
+# causes where the sidecar ANSWERED — alive and regulating/restarting/
+# refusing — so the breaker is never charged and retries are pointless
+_ANSWERED_CAUSES = ("shed", "drain", "poisoned")
+
 
 class RemoteSolverError(Exception):
     """An RPC abandoned after retries (or short-circuited)."""
@@ -55,7 +60,10 @@ class RemoteSolverError(Exception):
         retry_after: Optional[float] = None,
     ):
         super().__init__(message or cause)
-        self.cause = cause  # timeout | error | circuit_open | injected | shed
+        # timeout | error | circuit_open | injected | shed | drain |
+        # poisoned | corrupt (a result wire whose FIELDS decoded but whose
+        # content is malformed — raised by RemoteScheduler._materialize)
+        self.cause = cause
         # server-estimated seconds until a retry would be admitted (429
         # sheds only); honored by call()'s backoff in place of the fixed
         # exponential schedule
@@ -161,6 +169,7 @@ class SolverClient:
         sleep=time.sleep,
         on_state_change=None,
         tenant: str = "default",
+        quarantine=None,
     ):
         host, _, port = addr.rpartition(":")
         self.host = host or "127.0.0.1"
@@ -176,6 +185,16 @@ class SolverClient:
             breaker.on_state_change = on_state_change
         self.fault_injector = fault_injector
         self.sleep = sleep
+        # client-side poison quarantine, keyed on the request-body digest:
+        # lives HERE (not on the per-solve RemoteScheduler) because the
+        # strike streak must survive across solves, like the breaker. A
+        # problem that times out, errors, corrupts, or fails verification
+        # N times inside the TTL routes straight to greedy without an RPC.
+        if quarantine is None:
+            from karpenter_core_tpu.solver.fleet import PoisonQuarantine
+
+            quarantine = PoisonQuarantine(site="client")
+        self.quarantine = quarantine
 
     @property
     def addr(self) -> str:
@@ -240,6 +259,21 @@ class SolverClient:
                     f"sidecar {path} shed the request: {data[:200]!r}",
                     retry_after=retry_after,
                 )
+            if resp.status == 503:
+                # drain: the gateway is flushing its queue ahead of a
+                # clean restart — degrade this solve, never the breaker
+                raise RemoteSolverError(
+                    "drain",
+                    f"sidecar {path} draining: {data[:200]!r}",
+                )
+            if resp.status == 422:
+                # poison-pill refusal: the gateway quarantined this
+                # problem digest; quarantine it locally too
+                raise RemoteSolverError(
+                    "poisoned",
+                    f"sidecar {path} quarantined the problem: "
+                    f"{data[:200]!r}",
+                )
             if resp.status != 200:
                 raise RemoteSolverError(
                     "error",
@@ -275,6 +309,14 @@ class SolverClient:
                 data, kernel = self._once(path, body)
             except RemoteSolverError as e:
                 cause, detail, retry_after = e.cause, str(e), e.retry_after
+                if e.cause in ("drain", "poisoned"):
+                    # the sidecar ANSWERED with a definitive refusal:
+                    # draining (it is about to restart) or a quarantined
+                    # poison digest — retrying is pointless and the
+                    # breaker stays untouched (a live answer is not a
+                    # dead sidecar)
+                    self.breaker.record_success()
+                    break
                 if e.cause == "shed":
                     # the sidecar ANSWERED — alive and regulating: reset
                     # the breaker's failure streak, and if waiting out the
@@ -299,10 +341,11 @@ class SolverClient:
                 continue
             self.breaker.record_success()
             return data, kernel
-        if cause != "shed":
-            # a shed is an admission decision, not a fault — it must never
-            # push the breaker toward open (that would turn a load spike
-            # into a blanket greedy degradation past the spike's end)
+        if cause not in _ANSWERED_CAUSES:
+            # a shed/drain/poison refusal is an ANSWER, not a fault — it
+            # must never push the breaker toward open (that would turn a
+            # load spike or a clean restart into a blanket greedy
+            # degradation past its end)
             self.breaker.record_failure()
         m.SOLVER_RPC_FAILURES.inc({"cause": cause})
         raise RemoteSolverError(cause, detail, retry_after=retry_after)
@@ -324,6 +367,8 @@ class RemoteScheduler:
         topology=None,
         device_scheduler_opts: Optional[dict] = None,
         unavailable_offerings: "frozenset | set" = frozenset(),
+        verify: bool = True,
+        recorder=None,
     ):
         self.client = client
         self.nodepools = list(nodepools)
@@ -335,12 +380,20 @@ class RemoteScheduler:
         # the ICE-cache snapshot ships on the wire so the sidecar masks the
         # same offerings; the greedy fallback applies it locally too
         self.unavailable_offerings = frozenset(unavailable_offerings)
+        # host-side result verification (solver/verify.py): the trust
+        # anchor between a sidecar result and NodeClaim creation — a
+        # result that fails the independent constraint re-check degrades
+        # to greedy exactly like an unreachable sidecar
+        self.verify = verify
+        self.recorder = recorder
 
     # -- the solve ---------------------------------------------------------
 
     def solve(self, pods: List):
         from karpenter_core_tpu.metrics import wiring as m
 
+        digest = None
+        quarantine = self.client.quarantine
         try:
             with m.SOLVER_RPC_PHASE_DURATION.time({"phase": "encode"}):
                 body = codec.encode_solve_request(
@@ -354,6 +407,14 @@ class RemoteScheduler:
                     unavailable_offerings=self.unavailable_offerings,
                     tenant=self.client.tenant,
                 )
+            # poison check AFTER encode (the digest IS the canonical wire
+            # bytes) but BEFORE any transport: a quarantined problem costs
+            # zero RPCs, device grants, or sidecar respawns
+            digest = hashlib.sha256(body).hexdigest()
+            if quarantine is not None and quarantine.quarantined(digest):
+                m.SOLVER_QUARANTINE_ROUTED.inc({"site": "client"})
+                m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "solve"})
+                return self._fallback_solve(pods)
             t0 = time.perf_counter()
             data, kernel = self.client.call("/solve", body)
             total = time.perf_counter() - t0
@@ -363,8 +424,9 @@ class RemoteScheduler:
             )
             with m.SOLVER_RPC_PHASE_DURATION.time({"phase": "decode"}):
                 wire = codec.decode_solve_results(data)
-                return self._materialize(wire, pods)
-        except RemoteSolverError:
+                results = self._materialize(wire, pods)
+        except RemoteSolverError as e:
+            self._note_rpc_failure(e, digest)
             m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "solve"})
             return self._fallback_solve(pods)
         except (ValueError, KeyError):
@@ -372,8 +434,52 @@ class RemoteScheduler:
             # degrade like an unreachable sidecar, but count the cause so
             # persistent skew is distinguishable from a dead process
             m.SOLVER_RPC_FAILURES.inc({"cause": "decode"})
+            if quarantine is not None and digest is not None:
+                quarantine.strike(digest, "decode")
             m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "solve"})
             return self._fallback_solve(pods)
+        if self.verify:
+            from karpenter_core_tpu.solver import verify as verifymod
+
+            with m.SOLVER_RPC_PHASE_DURATION.time({"phase": "verify"}):
+                violations = verifymod.ResultVerifier(
+                    self.nodepools,
+                    self.instance_types,
+                    existing_nodes=self.existing_nodes,
+                    daemonset_pods=self.daemonset_pods,
+                    topology=self.topology,
+                    unavailable_offerings=self.unavailable_offerings,
+                ).verify(results, pods)
+            if violations:
+                verifymod.reject(violations, "sidecar", self.recorder)
+                if quarantine is not None and digest is not None:
+                    quarantine.strike(digest, "verify")
+                m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "solve"})
+                return self._fallback_solve(pods)
+        if quarantine is not None and digest is not None:
+            quarantine.clear(digest)
+        return results
+
+    def _note_rpc_failure(self, e: RemoteSolverError, digest) -> None:
+        """Quarantine/breaker bookkeeping for one failed RPC round trip.
+        Transport failures already charged the breaker inside call();
+        ``corrupt`` (malformed result content, raised by _materialize)
+        never crossed call()'s accounting, so it charges here — a sidecar
+        producing garbage should open the breaker like a dead one."""
+        from karpenter_core_tpu.metrics import wiring as m
+
+        if e.cause == "corrupt":
+            self.client.breaker.record_failure()
+            m.SOLVER_RPC_FAILURES.inc({"cause": "corrupt"})
+        quarantine = self.client.quarantine
+        if quarantine is None or digest is None:
+            return
+        if e.cause == "poisoned":
+            # the gateway already counted its strikes: mirror its verdict
+            # locally so the NEXT solve skips the RPC entirely
+            quarantine.poison(digest)
+        elif e.cause in ("timeout", "error", "corrupt", "injected"):
+            quarantine.strike(digest, e.cause)
 
     def _fallback_solve(self, pods: List):
         """Greedy degradation: the host Scheduler over the same inputs —
@@ -397,7 +503,15 @@ class RemoteScheduler:
         """Re-bind a wire response to the caller's live objects: pods by
         uid, instance types by name, nodepools by name. The rebuilt
         InFlightNodeClaims are indistinguishable from locally-solved ones
-        (provision() and the disruption price filters mutate them)."""
+        (provision() and the disruption price filters mutate them).
+
+        Hardened against truncated/corrupt result wire: every field is
+        type-checked before use and any malformation raises
+        ``RemoteSolverError("corrupt")`` — the NORMAL degradation path
+        (greedy fallback, breaker charged) — instead of a TypeError
+        escaping into the reconciler. The subtle shapes matter: a
+        ``pod_uids`` field that decodes as a *string* iterates as
+        characters and would silently materialize an empty claim."""
         from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import (
             ExistingNodeSim,
             InFlightNodeClaim,
@@ -412,7 +526,20 @@ class RemoteScheduler:
         from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
             Topology,
         )
+        from karpenter_core_tpu.scheduling import Requirements
         from karpenter_core_tpu.utils import resources as resutil
+
+        def corrupt(detail: str):
+            raise RemoteSolverError(
+                "corrupt", f"malformed solve result: {detail}"
+            )
+
+        def str_list(v, field: str) -> List[str]:
+            if not isinstance(v, list) or not all(
+                isinstance(x, str) for x in v
+            ):
+                corrupt(f"{field} is not a list of strings: {v!r}")
+            return v
 
         pods_by_uid = {p.uid: p for p in pods}
         it_by_name: Dict[str, object] = {}
@@ -428,16 +555,42 @@ class RemoteScheduler:
                 *[p for p in self.daemonset_pods if _daemon_compatible(nct, p)]
             )
 
+        if not isinstance(wire.get("errors"), dict) or not all(
+            isinstance(k, str) and isinstance(v, str)
+            for k, v in wire["errors"].items()
+        ):
+            corrupt(f"errors is not a str->str dict: {wire.get('errors')!r}")
+        if not isinstance(wire.get("claims"), list):
+            corrupt(f"claims is not a list: {wire.get('claims')!r}")
+        if not isinstance(wire.get("existing"), list):
+            corrupt(f"existing is not a list: {wire.get('existing')!r}")
+
         errors = dict(wire["errors"])
         claims = []
         for c in wire["claims"]:
+            if not isinstance(c, dict):
+                corrupt(f"claim entry is not a dict: {c!r}")
+            if not isinstance(c.get("nodepool"), str):
+                corrupt(f"claim nodepool is not a string: {c!r}")
+            if not isinstance(c.get("requirements"), Requirements):
+                corrupt(f"claim requirements did not decode: {c!r}")
+            if not isinstance(c.get("requests"), dict) or not all(
+                isinstance(k, str) and isinstance(v, (int, float))
+                and not isinstance(v, bool)
+                for k, v in c["requests"].items()
+            ):
+                corrupt(f"claim requests is not a resource list: {c!r}")
+            uids = str_list(c.get("pod_uids"), "claim pod_uids")
+            options_names = str_list(
+                c.get("instance_types"), "claim instance_types"
+            )
             template = templates.get(c["nodepool"])
             if template is None:  # pool vanished between encode and decode
-                for uid in c["pod_uids"]:
+                for uid in uids:
                     errors[uid] = f"nodepool {c['nodepool']!r} no longer exists"
                 continue
             options = [
-                it_by_name[n] for n in c["instance_types"] if n in it_by_name
+                it_by_name[n] for n in options_names if n in it_by_name
             ]
             claim = InFlightNodeClaim(
                 template, Topology(), overhead[c["nodepool"]], options
@@ -445,19 +598,24 @@ class RemoteScheduler:
             claim.requirements = c["requirements"]
             claim.requests = dict(c["requests"])
             claim.pods = [
-                pods_by_uid[u] for u in c["pod_uids"] if u in pods_by_uid
+                pods_by_uid[u] for u in uids if u in pods_by_uid
             ]
             claims.append(claim)
 
         node_by_name = {n.name: n for n in self.existing_nodes}
         sims = []
         for e in wire["existing"]:
+            if not isinstance(e, dict) or not isinstance(
+                e.get("node"), str
+            ):
+                corrupt(f"existing entry is malformed: {e!r}")
+            uids = str_list(e.get("pod_uids"), "existing pod_uids")
             node = node_by_name.get(e["node"])
             if node is None:
                 continue
             sim = ExistingNodeSim(node, Topology(), {})
             sim.pods = [
-                pods_by_uid[u] for u in e["pod_uids"] if u in pods_by_uid
+                pods_by_uid[u] for u in uids if u in pods_by_uid
             ]
             sims.append(sim)
         return Results(
@@ -481,6 +639,8 @@ def remote_frontier(
     degradation for disruption too."""
     from karpenter_core_tpu.metrics import wiring as m
 
+    digest = None
+    quarantine = client.quarantine
     try:
         with m.SOLVER_RPC_PHASE_DURATION.time({"phase": "encode"}):
             body = codec.encode_frontier_request(
@@ -494,6 +654,13 @@ def remote_frontier(
                 max_slots=max_slots,
                 tenant=client.tenant,
             )
+        # same poison contract as the solve path: a quarantined frontier
+        # problem goes straight to the host binary search, zero RPCs
+        digest = hashlib.sha256(body).hexdigest()
+        if quarantine is not None and quarantine.quarantined(digest):
+            m.SOLVER_QUARANTINE_ROUTED.inc({"site": "client"})
+            m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "consolidate"})
+            return None
         t0 = time.perf_counter()
         data, kernel = client.call("/consolidate", body)
         total = time.perf_counter() - t0
@@ -502,11 +669,37 @@ def remote_frontier(
             max(total - kernel, 0.0), {"phase": "transit"}
         )
         with m.SOLVER_RPC_PHASE_DURATION.time({"phase": "decode"}):
-            return codec.decode_frontier_response(data)
-    except RemoteSolverError:
+            frontier = codec.decode_frontier_response(data)
+    except RemoteSolverError as e:
+        if quarantine is not None and digest is not None:
+            if e.cause == "poisoned":
+                quarantine.poison(digest)
+            elif e.cause in ("timeout", "error", "injected"):
+                quarantine.strike(digest, e.cause)
         m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "consolidate"})
         return None
     except (ValueError, KeyError):
         m.SOLVER_RPC_FAILURES.inc({"cause": "decode"})
+        if quarantine is not None and digest is not None:
+            quarantine.strike(digest, "decode")
         m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "consolidate"})
         return None
+    # structural verification: the (ok, n_new, price_lb) triples feed
+    # binary disruption decisions directly — garbage here silently
+    # mis-sizes a consolidation command, so a defective frontier degrades
+    # to the caller's host binary search like any RPC failure
+    from karpenter_core_tpu.solver.verify import verify_frontier
+
+    defect = verify_frontier(frontier)
+    if defect is not None:
+        m.SOLVER_RESULT_REJECTED.inc(
+            {"reason": "structure", "path": "frontier"}
+        )
+        m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "consolidate"})
+        return None
+    if quarantine is not None and digest is not None:
+        # success forgives the streak, exactly like the solve path —
+        # transient faults spread across a healthy week must never
+        # accumulate into a quarantine
+        quarantine.clear(digest)
+    return frontier

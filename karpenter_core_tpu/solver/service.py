@@ -18,6 +18,22 @@ gRPC+proto — same split, stdlib transport (the kube/httpserver.py pattern):
 * ``GET  /metrics``      — the sidecar's own registry, exposition format
 * ``POST /profile``      — toggle jax.profiler trace capture around solves
                            (requires ``--profile-dir``); GET reports state
+* ``POST /drain``        — crash-only clean restart: admission closes,
+                           queued requests answer 503 (drain ≠ shed ≠
+                           fault), and the process exits with
+                           DRAIN_EXIT_CODE once the in-flight device step
+                           clears — the supervisor respawns immediately
+                           without charging crash-loop backoff
+
+Two survivability guards wrap the exclusive device step: a ``DeviceWatchdog``
+(hard wall-clock bound; on overrun the queue is flushed with 503s and the
+process exits crash-only with WATCHDOG_EXIT_CODE — Python cannot kill a
+wedged device thread, so the process IS the unit of recovery) and a
+``PoisonQuarantine`` (a request-body digest that crashes/wedges the device
+N times inside a TTL is refused pre-decode with 422, so one tenant's
+poison problem cannot crash-loop the shared sidecar for the whole fleet;
+an optional journal carries the in-flight digest across the very crash it
+causes).
 
 Since the fleet gateway (solver/fleet.py) landed, one sidecar serves N
 operators: every request carries a tenant (wire field + ``X-Solver-Tenant``
@@ -38,15 +54,132 @@ Run: ``python -m karpenter_core_tpu.solver.service --port 0``
 """
 from __future__ import annotations
 
+import hashlib
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from karpenter_core_tpu.kube.httpserver import read_body, send_body
 from karpenter_core_tpu.solver import codec, fleet
+from karpenter_core_tpu.solver.supervisor import (
+    DRAIN_EXIT_CODE,
+    DRAIN_EXIT_DEADLINE_SECONDS,
+    WATCHDOG_EXIT_CODE,
+)
 
 _OCTET = "application/octet-stream"
+
+# grace window between flushing the queue (503s written by their handler
+# threads) and the crash-only process exit — long enough for in-memory
+# socket writes, short enough that a wedged chip is gone in well under a
+# supervision pass
+_EXIT_GRACE_SECONDS = 0.25
+
+
+class DeviceWatchdog:
+    """Hard wall-clock bound on the EXCLUSIVE device step.
+
+    A wedged device solve (driver hang, pathological compile, poisoned
+    input) holds the single device grant forever: every tenant's solves
+    queue behind it until their deadlines shed, and the whole fleet
+    silently degrades to greedy. Python cannot kill the wedged thread, so
+    the recovery is crash-only: on trip the daemon drains the gateway
+    (queued requests answer 503 instead of vanishing), the process exits
+    with WATCHDOG_EXIT_CODE, and the supervisor respawns it — the
+    quarantine journal remembers the fingerprint that wedged it.
+
+    Armed/disarmed around each device phase; the monitor thread wakes a
+    few times a second and only ever reads two floats, so the idle cost is
+    noise. ``check()`` evaluates once synchronously (the deterministic
+    test hook)."""
+
+    def __init__(
+        self,
+        budget_seconds: float,
+        on_trip,
+        exit_fn=None,
+        time_fn=time.monotonic,
+        poll_seconds: float = 0.05,
+    ):
+        if budget_seconds <= 0:
+            raise ValueError(
+                f"watchdog budget must be positive, got {budget_seconds}"
+            )
+        self.budget_seconds = budget_seconds
+        self.on_trip = on_trip
+        # None = report-and-drain only (in-thread test servers must not
+        # take the test process down with them); solverd main passes
+        # os._exit for the real crash-only contract
+        self.exit_fn = exit_fn
+        self.time_fn = time_fn
+        self.poll_seconds = poll_seconds
+        self.trips = 0
+        self._lock = threading.Lock()
+        self._armed_at = None
+        self._note = ""
+        self._thread = None
+
+    def arm(self, note: str = "") -> None:
+        with self._lock:
+            self._armed_at = self.time_fn()
+            self._note = note
+            # poll_seconds == 0 runs without a monitor thread — the
+            # deterministic mode where tests drive check() themselves
+            if self._thread is None and self.poll_seconds > 0:
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name="solverd-watchdog",
+                )
+                self._thread.start()
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._armed_at = None
+            self._note = ""
+
+    def armed(self) -> bool:
+        with self._lock:
+            return self._armed_at is not None
+
+    def _loop(self) -> None:
+        while True:
+            time.sleep(self.poll_seconds)
+            self.check()
+
+    def check(self) -> bool:
+        """One evaluation: trip when the armed device step has overrun its
+        budget. Returns True when it tripped."""
+        with self._lock:
+            armed_at, note = self._armed_at, self._note
+        if armed_at is None:
+            return False
+        if self.time_fn() - armed_at < self.budget_seconds:
+            return False
+        return self._trip(armed_at, note)
+
+    def _trip(self, armed_at: float, note: str) -> bool:
+        from karpenter_core_tpu.metrics import wiring as m
+
+        with self._lock:
+            # re-validate under the lock: the step may have finished
+            # (disarm) — or a NEW step armed — between the monitor's read
+            # and now; tripping on a stale observation would kill a
+            # healthy sidecar and charge the supervisor's crash backoff
+            if self._armed_at != armed_at:
+                return False
+            self._armed_at = None  # never double-trip on one overrun
+            self._note = ""
+            self.trips += 1
+        m.SOLVERD_WATCHDOG_TRIPS.inc()
+        try:
+            self.on_trip(note)
+        finally:
+            if self.exit_fn is not None:
+                time.sleep(_EXIT_GRACE_SECONDS)
+                self.exit_fn(WATCHDOG_EXIT_CODE)
+        return True
 
 
 class SolverDaemon:
@@ -79,6 +212,10 @@ class SolverDaemon:
         gateway: fleet.FleetGateway = None,
         sched_cache: fleet.BoundedSchedulerCache = None,
         devices: int = 1,
+        watchdog_seconds: float = 0.0,
+        quarantine: fleet.PoisonQuarantine = None,
+        chaos=None,
+        exit_fn=None,
     ):
         self.ready = False
         self.solves = 0
@@ -100,13 +237,73 @@ class SolverDaemon:
             else fleet.BoundedSchedulerCache()
         )
         self._state_lock = threading.Lock()
+        # poison-pill quarantine: a request whose body digest has crashed
+        # the device step N times is refused pre-decode (HTTP 422), so one
+        # tenant's poison cannot re-wedge the shared sidecar for everyone
+        self.quarantine = (
+            quarantine
+            if quarantine is not None
+            else fleet.PoisonQuarantine(site="gateway")
+        )
+        # chaos injector (chaos.SolverChaos): wedge/corrupt-wire/bad-result
+        # faults on the device tier, None in production
+        self.chaos = chaos
+        # None = exit disabled (in-thread test servers); solverd main
+        # passes os._exit so drain/watchdog exits are truly crash-only
+        self.exit_fn = exit_fn
+        self.watchdog = (
+            DeviceWatchdog(
+                watchdog_seconds, on_trip=self._on_watchdog_trip,
+                exit_fn=exit_fn,
+            )
+            if watchdog_seconds > 0
+            else None
+        )
+
+    def _on_watchdog_trip(self, note: str) -> None:
+        """Crash-only exit path: queued requests answer 503 (drain flush)
+        instead of vanishing into the process exit; the wedged thread keeps
+        the device — only the exit reclaims it."""
+        self.gateway.drain()
+
+    def drain(self) -> dict:
+        """POST /drain: stop admission, flush the queue (each queued
+        request's handler answers 503), then — when an exit_fn is wired —
+        exit with DRAIN_EXIT_CODE once the in-flight device step clears,
+        so the supervisor respawns a clean process without charging
+        crash-loop backoff."""
+        flushed = self.gateway.drain()
+        if self.exit_fn is not None:
+            t = threading.Thread(
+                target=self._exit_after_idle, daemon=True,
+                name="solverd-drain-exit",
+            )
+            t.start()
+        return {
+            "draining": True,
+            "flushed": flushed,
+            "exiting": self.exit_fn is not None,
+        }
+
+    def _exit_after_idle(self) -> None:
+        """Wait (bounded) for the active device step to finish, then exit
+        cleanly. A step that outlives the wait is wedged — the drain exit
+        proceeds anyway; crash-only beats hanging the restart."""
+        deadline = time.monotonic() + DRAIN_EXIT_DEADLINE_SECONDS
+        while time.monotonic() < deadline and self.gateway.depth() > 0:
+            time.sleep(0.05)
+        time.sleep(_EXIT_GRACE_SECONDS)
+        self.exit_fn(DRAIN_EXIT_CODE)
 
     # -- endpoints ---------------------------------------------------------
 
     def solve(self, body: bytes, tenant: str = None, deadline: float = None):
         """bytes -> (response bytes, solve seconds). Raises fleet.ShedError
         when admission rejects the request (the HTTP layer answers 429 +
-        Retry-After; solver/remote.py degrades that solve to greedy).
+        Retry-After; solver/remote.py degrades that solve to greedy),
+        fleet.DrainError while draining (503), and fleet.QuarantinedError
+        for a poison-pill digest (422) — all BEFORE any decode or device
+        work, so refusals cost the sidecar nothing.
 
         ``tenant`` is the transport-level identity (the X-Solver-Tenant
         header) and wins when present; a direct-drive caller that passes
@@ -114,6 +311,12 @@ class SolverDaemon:
         from karpenter_core_tpu.metrics import wiring as m
         from karpenter_core_tpu.models.provisioner import DeviceScheduler
 
+        # the poison key is the request-body digest (canonical wire bytes,
+        # PR 4), computed pre-decode: the decode itself may be the crash
+        digest = hashlib.sha256(body).hexdigest()
+        if self.quarantine.quarantined(digest):
+            m.SOLVER_QUARANTINE_ROUTED.inc({"site": "gateway"})
+            raise fleet.QuarantinedError(digest)
         ticket = self.gateway.submit(
             tenant or fleet.DEFAULT_TENANT, fleet.LANE_SOLVE, deadline
         )
@@ -126,9 +329,16 @@ class SolverDaemon:
         except BaseException:
             self.gateway.abandon(ticket)
             raise
-        self.gateway.await_grant(ticket)  # may raise ShedError (expired)
+        self.gateway.await_grant(ticket)  # may raise Shed/DrainError
+        # chaos draws AFTER the grant: a request that admission refused
+        # (shed/drain/quarantine) must not consume a scripted fault it
+        # will never execute — a consumed entry always fires
+        fault = self.chaos.next_fault() if self.chaos is not None else "ok"
         dt = 0.0
         grant_t0 = time.perf_counter()
+        self.quarantine.begin(digest)  # crash-only journal breadcrumb
+        if self.watchdog is not None:
+            self.watchdog.arm(f"solve tenant={ticket.tenant}")
         try:
             # device phase: the only exclusive section
             scheduler = self._sched_cache.get(problem["fingerprint"])
@@ -143,6 +353,12 @@ class SolverDaemon:
                     topology=problem["topology"],
                     unavailable_offerings=problem["unavailable_offerings"],
                     devices=self.devices,
+                    # the CLIENT verifies (solver/remote.py): it must not
+                    # trust the wire anyway, so a sidecar-side check would
+                    # double the overhead yet still miss wire corruption —
+                    # and a silent in-sidecar greedy degrade would hide
+                    # the rejection signal from the fleet's operators
+                    verify=False,
                 )
                 # the encoded request size is the entry's weight proxy: it
                 # tracks catalog/node scale without walking device buffers
@@ -155,6 +371,10 @@ class SolverDaemon:
                 # list; hand the cached scheduler this request's live
                 # topology context so exclusions are never stale
                 scheduler.update_topology_context(problem["topology"])
+            if fault.startswith("wedge"):
+                self.chaos.wedge(fault)  # holds the grant; watchdog trips
+            elif fault == "crash":
+                self.chaos.crash()  # device-phase raise -> poison strike
             t0 = time.perf_counter()
             with self._maybe_profile():
                 results = scheduler.solve(problem["pods"])
@@ -162,7 +382,14 @@ class SolverDaemon:
             # handler threads run concurrently; a bare += is a lost update
             with self._state_lock:
                 self.solves += 1
+        except BaseException:
+            # a device-phase exception is a poison strike: N of them
+            # inside the TTL and this digest is refused fleet-wide
+            self.quarantine.strike(digest, "crash")
+            raise
         finally:
+            if self.watchdog is not None:
+                self.watchdog.disarm()
             # charge the FULL exclusive occupancy — cache-miss scheduler
             # construction/prepare included, and the elapsed time even
             # when the solve raised. Fairness and the admission p50 must
@@ -172,12 +399,23 @@ class SolverDaemon:
             # time alone (dt) still rides X-Solver-Seconds so the client's
             # transit/kernel histogram split stays honest.
             self.gateway.release(ticket, time.perf_counter() - grant_t0)
+            # journal bookkeeping AFTER release: done() rewrites the
+            # journal file, and file I/O must never ride the exclusive
+            # device window (the digest only needs to stay journaled
+            # until the device phase ends — this IS that moment)
+            self.quarantine.done(digest)
+        self.quarantine.clear(digest)
         m.SOLVERD_TENANT_SOLVES.inc(
             {"tenant": ticket.tenant, "endpoint": "solve"}
         )
         # host phase again: encode outside the grant, the next tenant's
         # device phase is already running
-        return codec.encode_solve_results(results, dt), dt
+        if fault == "bad_result":
+            self.chaos.sabotage(results)  # verification-failing result
+        out = codec.encode_solve_results(results, dt)
+        if fault == "corrupt_wire":
+            out = self.chaos.corrupt(out)
+        return out, dt
 
     def _decode_solve(self, body: bytes) -> dict:
         """The solve request's host-phase decode — a named seam so chaos
@@ -217,10 +455,20 @@ class SolverDaemon:
         self, body: bytes, tenant: str = None, deadline: float = None
     ):
         """Consolidation sweeps ride the gateway's NORMAL lane: under
-        contention every pending provisioning solve dispatches first."""
+        contention every pending provisioning solve dispatches first.
+
+        Same poison-quarantine protection as solve(): a frontier problem
+        that wedges or crashes the device step is exactly as capable of
+        crash-looping the shared sidecar as a solve problem, so its body
+        digest is checked pre-decode, journaled around the device phase,
+        and struck on a device-phase exception."""
         from karpenter_core_tpu.metrics import wiring as m
         from karpenter_core_tpu.models.consolidation import frontier_core
 
+        digest = hashlib.sha256(body).hexdigest()
+        if self.quarantine.quarantined(digest):
+            m.SOLVER_QUARANTINE_ROUTED.inc({"site": "gateway"})
+            raise fleet.QuarantinedError(digest)
         ticket = self.gateway.submit(
             tenant or fleet.DEFAULT_TENANT, fleet.LANE_SWEEP, deadline
         )
@@ -234,6 +482,9 @@ class SolverDaemon:
         self.gateway.await_grant(ticket)
         dt = 0.0
         grant_t0 = time.perf_counter()
+        self.quarantine.begin(digest)
+        if self.watchdog is not None:
+            self.watchdog.arm(f"consolidate tenant={ticket.tenant}")
         try:
             t0 = time.perf_counter()
             frontier = frontier_core(
@@ -248,9 +499,16 @@ class SolverDaemon:
                 devices=self.devices,
             )
             dt = time.perf_counter() - t0
+        except BaseException:
+            self.quarantine.strike(digest, "crash")
+            raise
         finally:
+            if self.watchdog is not None:
+                self.watchdog.disarm()
             # full-occupancy charge, as in solve()
             self.gateway.release(ticket, time.perf_counter() - grant_t0)
+            self.quarantine.done(digest)  # after release, as in solve()
+        self.quarantine.clear(digest)
         m.SOLVERD_TENANT_SOLVES.inc(
             {"tenant": ticket.tenant, "endpoint": "consolidate"}
         )
@@ -258,17 +516,27 @@ class SolverDaemon:
 
     def health(self) -> dict:
         """The /healthz body: liveness (warm-up finished) + readiness
-        (liveness AND the admission queue below its bound). An overloaded
-        sidecar is alive-but-unready — the supervisor must not respawn it
-        into a load spike (a restart storm turns overload into outage)."""
+        (liveness AND the admission queue below its bound AND not
+        draining). An overloaded sidecar is alive-but-unready — the
+        supervisor must not respawn it into a load spike (a restart storm
+        turns overload into outage); a DRAINING one is alive-but-leaving,
+        reported so probes don't mistake the planned exit for a death."""
         depth = self.gateway.depth()
         saturated = self.gateway.saturated()
+        draining = self.gateway.draining()
         return {
             "ok": self.ready,
-            "ready": bool(self.ready and not saturated),
+            "ready": bool(self.ready and not saturated and not draining),
             "overloaded": saturated,
+            "draining": draining,
             "queue_depth": depth,
             "queue_capacity": self.gateway.max_depth,
+            # the poison ledger, so a fleet dashboard can tell "this
+            # sidecar is refusing a poison problem" from "cold"
+            "quarantine_entries": self.quarantine.size(),
+            "watchdog_trips": (
+                self.watchdog.trips if self.watchdog is not None else 0
+            ),
         }
 
     # -- boot warm-up ------------------------------------------------------
@@ -295,6 +563,10 @@ class SolverDaemon:
             DeviceScheduler(
                 [pool], {"prewarm": catalog}, max_slots=256,
                 devices=self.devices,
+                # same sidecar contract as the solve path: the CLIENT is
+                # the trust anchor, and a synthetic warm-up solve must
+                # never bump the fleet's rejection metric from inside boot
+                verify=False,
             ).prewarm()
         self.ready = True
 
@@ -371,6 +643,12 @@ class _Handler(BaseHTTPRequestHandler):
                     enable = q["enable"][0] not in ("0", "false", "off")
                 state = self.daemon.toggle_profile(enable)
                 return send_body(self, 200, json.dumps(state).encode())
+            elif path == "/drain":
+                # supervisor-initiated clean restart: stop admission,
+                # flush the queue (503s), exit with DRAIN_EXIT_CODE once
+                # the in-flight device step clears
+                state = self.daemon.drain()
+                return send_body(self, 200, json.dumps(state).encode())
             else:
                 return send_body(self, 404, b'{"error": "not found"}')
         except fleet.ShedError as e:
@@ -382,6 +660,23 @@ class _Handler(BaseHTTPRequestHandler):
                     {"error": "overloaded", "reason": e.reason}
                 ).encode(),
                 headers={"Retry-After": f"{e.retry_after:.3f}"},
+            )
+        except fleet.DrainError:
+            # draining is a CONTRACT too: 503 says "restarting, answer
+            # came from a live process" — the client degrades this solve
+            # to greedy without charging its breaker
+            return send_body(
+                self, 503, b'{"error": "draining"}',
+            )
+        except fleet.QuarantinedError as e:
+            # poison pill: refused pre-decode; 422 tells the client to
+            # quarantine locally and route straight to greedy
+            return send_body(
+                self, 422,
+                json.dumps({
+                    "error": "quarantined",
+                    "fingerprint": e.fingerprint,
+                }).encode(),
             )
         except Exception as e:
             return send_body(
@@ -457,9 +752,34 @@ def main() -> int:
         " single-device). Requests clamp to what exists, so a slice"
         " config degrades to single-device on a 1-chip box",
     )
+    ap.add_argument(
+        "--watchdog-seconds", type=float, default=120.0,
+        help="hard wall-clock bound on the exclusive device step; on"
+        " overrun the process drains its queue (503s) and exits"
+        " crash-only for the supervisor to respawn (0 disables)",
+    )
+    ap.add_argument(
+        "--quarantine-strikes", type=int,
+        default=fleet.QUARANTINE_STRIKES,
+        help="device-phase faults a problem digest may accumulate inside"
+        " the quarantine TTL before the sidecar refuses it with 422",
+    )
+    ap.add_argument(
+        "--quarantine-ttl", type=float, default=fleet.QUARANTINE_TTL,
+        help="seconds a quarantined poison-pill digest stays refused",
+    )
+    ap.add_argument(
+        "--quarantine-journal", default=None,
+        help="path for the crash-only poison journal: the digest in"
+        " flight on the device is recorded here, so a problem that"
+        " KILLS the process is charged its strike by the respawned"
+        " child (no journal = in-memory quarantine only)",
+    )
     args = ap.parse_args()
     if args.devices < 0:
         ap.error("--devices must be >= 0 (0 = all local devices)")
+    if args.watchdog_seconds < 0:
+        ap.error("--watchdog-seconds must be >= 0 (0 disables)")
 
     daemon = SolverDaemon(
         profile_dir=args.profile_dir,
@@ -472,6 +792,16 @@ def main() -> int:
             max_bytes=args.cache_mib << 20,
         ),
         devices=args.devices,
+        watchdog_seconds=args.watchdog_seconds,
+        quarantine=fleet.PoisonQuarantine(
+            strikes=args.quarantine_strikes,
+            ttl=args.quarantine_ttl,
+            site="gateway",
+            journal_path=args.quarantine_journal,
+        ),
+        # the real sidecar exits crash-only on watchdog trip / drain; the
+        # supervisor's exit-code contract does the rest
+        exit_fn=os._exit,
     )
     httpd = serve(args.port, host=args.host, daemon=daemon, ready=False)
     # the supervisor (solver/supervisor.py) reads this line to learn the

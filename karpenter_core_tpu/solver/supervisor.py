@@ -9,7 +9,9 @@ the child is alive — a dead child respawns under exponential backoff so a
 crash-looping solver cannot busy-spin the operator, and every respawn is
 surfaced through the ``on_event`` hook (the operator wires it to the event
 recorder as a "sidecar unavailable"/"restarted" condition) plus the
-``solver_sidecar_restarts_total`` counter.
+``solverd_restarts_total`` counter (``cause=crash`` charges the backoff;
+``cause=drain`` — the child flushed its queue via POST /drain and exited
+with DRAIN_EXIT_CODE — respawns immediately without one).
 
 The command is injectable so tests supervise a stub child; the default
 spawns the real solverd module.
@@ -22,6 +24,24 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+# exit-code contract with solverd (solver/service.py): a drain-initiated
+# exit (POST /drain flushed the queue and asked to be restarted) uses
+# DRAIN_EXIT_CODE so the supervisor can tell a CLEAN restart request from
+# a crash — drain exits respawn immediately and never charge crash-loop
+# backoff. A watchdog trip (wedged device step) exits with
+# WATCHDOG_EXIT_CODE: deliberate, but still a fault — it charges backoff
+# like any crash so a poison problem cannot hot-loop the respawn.
+DRAIN_EXIT_CODE = 64
+WATCHDOG_EXIT_CODE = 86
+# consecutive drain exits (no stable run between) tolerated before the
+# supervisor stops believing them and escalates to crash-cause backoff
+DRAIN_STREAK_CAP = 3
+# how long a draining child waits for its in-flight device step before
+# exiting anyway (solver/service.py _exit_after_idle reads this); the
+# supervisor's drain() wait is sized PAST it + the exit grace, so a drain
+# that succeeds at the deadline is never misreported as a failure
+DRAIN_EXIT_DEADLINE_SECONDS = 30.0
+
 
 def default_command(
     port: int,
@@ -32,6 +52,8 @@ def default_command(
     cache_entries: Optional[int] = None,
     cache_mib: Optional[int] = None,
     devices: Optional[int] = None,
+    watchdog_seconds: Optional[float] = None,
+    quarantine_journal: Optional[str] = None,
 ) -> List[str]:
     cmd = [
         sys.executable,
@@ -61,6 +83,13 @@ def default_command(
     # spawn command so a respawned sidecar re-shards over the same slice
     if devices is not None:
         cmd.extend(["--devices", str(devices)])
+    if watchdog_seconds is not None:
+        cmd.extend(["--watchdog-seconds", str(watchdog_seconds)])
+    # the quarantine journal is what makes poison protection survive the
+    # very crash the poison causes: the respawned child reads back the
+    # fingerprint that was in flight when its predecessor died
+    if quarantine_journal:
+        cmd.extend(["--quarantine-journal", quarantine_journal])
     return cmd
 
 
@@ -76,6 +105,8 @@ class SolverSupervisor:
         cache_entries: Optional[int] = None,
         cache_mib: Optional[int] = None,
         devices: Optional[int] = None,
+        watchdog_seconds: Optional[float] = None,
+        quarantine_journal: Optional[str] = None,
         backoff_initial: float = 1.0,
         backoff_max: float = 30.0,
         stable_window: float = 60.0,
@@ -90,6 +121,8 @@ class SolverSupervisor:
             cache_entries=cache_entries,
             cache_mib=cache_mib,
             devices=devices,
+            watchdog_seconds=watchdog_seconds,
+            quarantine_journal=quarantine_journal,
         )
         self.backoff_initial = backoff_initial
         self.backoff_max = backoff_max
@@ -112,6 +145,16 @@ class SolverSupervisor:
         self._next_spawn_at = 0.0
         self._down_since: Optional[float] = None
         self._last_spawn_at = 0.0
+        # how the current down child exited: "crash" (charges backoff) or
+        # "drain" (clean restart request — respawn immediately)
+        self._exit_cause = "crash"
+        # consecutive drain exits without an intervening stable run: a
+        # drain-LOOPING child (a misfiring preStop hook POSTing /drain
+        # every probe, or anything else exiting DRAIN_EXIT_CODE at boot —
+        # it collides with sysexits EX_USAGE) must not ride the
+        # no-backoff path into a respawn storm; past the streak cap it is
+        # treated as a crash
+        self._drain_streak = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -171,37 +214,98 @@ class SolverSupervisor:
             return False
         now = self.time_fn()
         if self.alive():
-            if self._delay and now - self._last_spawn_at >= self.stable_window:
+            if now - self._last_spawn_at >= self.stable_window:
                 self._delay = 0.0
+                self._drain_streak = 0
             return False
         if self._down_since is None:
             self._down_since = now
-            # the accumulated delay survives a "successful" spawn that dies
-            # again seconds later — only stability resets it
-            self._next_spawn_at = now + self._delay
-            self._emit(
-                "SidecarUnavailable",
-                f"solver sidecar exited with code {self.proc.returncode}",
-            )
+            rc = self.proc.returncode
+            if rc == DRAIN_EXIT_CODE and self._drain_streak < DRAIN_STREAK_CAP:
+                # clean drain-exit: the child flushed its queue and ASKED
+                # to be restarted — respawn immediately, charge nothing
+                # (a drain must never look like a crash loop). The streak
+                # cap is the exception: N consecutive drains with no
+                # stable run in between is a drain LOOP, and it earns
+                # crash-cause backoff like any other respawn storm.
+                self._exit_cause = "drain"
+                self._drain_streak += 1
+                self._next_spawn_at = now
+                self._emit(
+                    "SidecarDrained",
+                    f"solver sidecar drained and exited cleanly (code {rc})",
+                )
+            else:
+                # the accumulated delay survives a "successful" spawn that
+                # dies again seconds later — only stability resets it
+                self._exit_cause = "crash"
+                self._next_spawn_at = now + self._delay
+                self._emit(
+                    "SidecarUnavailable",
+                    "solver sidecar exited with code "
+                    + (f"{rc} (watchdog)" if rc == WATCHDOG_EXIT_CODE
+                       else f"{rc}"),
+                )
         if now < self._next_spawn_at:
             return False
-        self._delay = min(
-            max(self._delay * 2, self.backoff_initial), self.backoff_max
-        )
+        if self._exit_cause == "crash":
+            self._delay = min(
+                max(self._delay * 2, self.backoff_initial), self.backoff_max
+            )
         try:
             self._spawn()
         except (OSError, RuntimeError) as e:
+            if self._exit_cause == "drain":
+                # the clean path failed to come back — escalate like a crash
+                self._exit_cause = "crash"
+                self._delay = min(
+                    max(self._delay * 2, self.backoff_initial),
+                    self.backoff_max,
+                )
             self._next_spawn_at = now + self._delay
             self._emit("SidecarRestartFailed", str(e))
             return False
         from karpenter_core_tpu.metrics import wiring as m
 
-        m.SOLVER_SIDECAR_RESTARTS.inc()
+        m.SOLVERD_RESTARTS.inc({"cause": self._exit_cause})
         self.restarts += 1
         self._down_since = None
         self._emit(
             "SidecarRestarted", f"solver sidecar respawned on {self.addr}"
         )
+        return True
+
+    def drain(
+        self, timeout: float = DRAIN_EXIT_DEADLINE_SECONDS + 15.0
+    ) -> bool:
+        """Ask the child to drain and restart cleanly: POST /drain stops
+        admission, flushes queued requests with 503s, and exits with
+        DRAIN_EXIT_CODE once the in-flight device step finishes. Returns
+        True when the child exited within the timeout — the next poll()
+        then respawns it immediately (cause=drain, no backoff charge).
+        The default timeout sits PAST the child's own in-flight wait
+        deadline + exit grace, so a drain that completes at the wire is
+        reported as the success it is."""
+        import http.client
+
+        if not self.alive():
+            return False
+        host, _, port = self.addr.rpartition(":")
+        try:
+            conn = http.client.HTTPConnection(
+                host or "127.0.0.1", int(port), timeout=min(timeout, 5.0)
+            )
+            try:
+                conn.request("POST", "/drain", b"")
+                conn.getresponse().read()
+            finally:
+                conn.close()
+        except (OSError, ValueError):
+            return False
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return False
         return True
 
     def stop(self) -> None:

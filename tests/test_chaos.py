@@ -520,6 +520,278 @@ class TestChaosSmoke:
         assert faults == ["conflict", "ok", "too_many_requests", "ok"]
 
 
+# ---------------------------------------------------------------------------
+# device-tier chaos (ISSUE 8): wedged solves, corrupt wire, poison pills
+# ---------------------------------------------------------------------------
+
+
+def _device_chaos_rig(schedule: ChaosSchedule, watchdog_seconds=0.0,
+                      wedge_seconds=0.4, quarantine_strikes=3):
+    """Operator (sidecar mode, FakeClock) wired to an IN-THREAD chaotic
+    solverd: the SolverChaos injector perturbs the device tier while the
+    operator reconciles through it. Returns (op, daemon, chaos, srv)."""
+    from karpenter_core_tpu.chaos import SolverChaos
+    from karpenter_core_tpu.solver import fleet, service
+
+    chaos = SolverChaos(schedule, wedge_seconds=wedge_seconds)
+    daemon = service.SolverDaemon(
+        watchdog_seconds=watchdog_seconds,
+        chaos=chaos,
+        quarantine=fleet.PoisonQuarantine(
+            strikes=quarantine_strikes, site="gateway"
+        ),
+    )
+    srv = service.serve(0, daemon=daemon)
+    addr = f"127.0.0.1:{srv.server_address[1]}"
+    _reset_claim_counter()
+    clock = FakeClock()
+    kube = KubeStore(clock)
+    op = Operator(
+        kube=kube,
+        cloud_provider=KwokCloudProvider(kube, CATALOG),
+        clock=clock,
+        options=Options(
+            solver="tpu", solver_mode="sidecar", solver_addr=addr,
+            solver_timeout=60.0,
+        ),
+    )
+    # degradations must be cheap in-test: no real backoff sleeps
+    op.solver_client.sleep = lambda s: None
+    op.solver_client.max_retries = 0
+    return op, daemon, chaos, srv
+
+
+class TestDeviceTierChaosSmoke:
+    """Tier-1 fixed-script smoke: one corrupt wire + one lying result +
+    one poison crash, every pod still binds, and the device path (not a
+    stuck breaker) serves the clean tail."""
+
+    def test_corrupt_and_lying_results_degrade_then_recover(self):
+        from karpenter_core_tpu.metrics import wiring as m
+        from karpenter_core_tpu.solver.remote import STATE_CLOSED
+
+        schedule = ChaosSchedule(seed=5, script={
+            "solverd.solve": ["corrupt_wire", "bad_result", "crash"],
+        })
+        op, daemon, chaos, srv = _device_chaos_rig(schedule)
+        try:
+            op.kube.create(make_nodepool())
+            rejected_before = m.SOLVER_RESULT_REJECTED.value(
+                {"reason": "conservation", "path": "sidecar"}
+            )
+            for wave in range(4):
+                for i in range(3):
+                    op.kube.create(replicated(make_pod(
+                        cpu=1.0, name=f"dc{wave}-{i}"
+                    )))
+                op.run_until_idle(max_iters=200, disrupt=False)
+            assert all(p.node_name for p in op.kube.list_pods())
+            # each scripted fault consumed and survived
+            assert chaos.injected == {
+                "corrupt_wire": 1, "bad_result": 1, "crash": 1,
+            }
+            assert m.SOLVER_RESULT_REJECTED.value(
+                {"reason": "conservation", "path": "sidecar"}
+            ) == rejected_before + 1
+            # the breaker recovered: the clean tail runs the device path
+            assert op.solver_client.breaker.state == STATE_CLOSED
+            assert_coherent(op)
+        finally:
+            op.shutdown()
+            srv.shutdown()
+            srv.server_close()
+
+
+@pytest.mark.slow
+class TestDeviceTierChaosSoak:
+    """The acceptance soak: seeded wedge + crash (poison) + corrupt wire +
+    lying results, plus real-sidecar murder, while a second clean tenant
+    shares the same solverd. The operator must keep reaching greedy-parity
+    node counts, the breaker must recover, and the unaffected tenant's
+    queue wait must stay bounded."""
+
+    def test_soak_device_faults_reach_greedy_parity(self):
+        import random
+        import threading as _threading
+
+        from karpenter_core_tpu.metrics import wiring as m
+        from karpenter_core_tpu.solver import remote
+        from karpenter_core_tpu.solver.remote import STATE_CLOSED
+
+        # the script guarantees each fault class fires at least once —
+        # with a concurrent tenant the RATE draws interleave
+        # nondeterministically, so "did crash ever fire" must not hang on
+        # the dice; the rates then keep the pressure on for the rest
+        schedule = ChaosSchedule(
+            seed=1234,
+            script={"solverd.solve": [
+                "crash", "corrupt_wire", "bad_result", "wedge:0.4",
+            ]},
+            rates={
+                "solverd.solve.wedge": 0.04,
+                "solverd.solve.crash": 0.12,
+                "solverd.solve.corrupt_wire": 0.12,
+                "solverd.solve.bad_result": 0.12,
+            },
+        )
+        op, daemon, chaos, srv = _device_chaos_rig(
+            schedule, watchdog_seconds=0.15, wedge_seconds=0.4
+        )
+        rng = random.Random(77)
+        # replayed into the greedy-parity twin WAVE BY WAVE: incremental
+        # provisioning packs into whatever already launched, so a one-shot
+        # twin would undercount nodes and fail every honest run
+        pod_waves = []
+
+        # the unaffected tenant: a clean problem hammered through its own
+        # RemoteScheduler at the SAME gateway (distinct tenant id; chaos
+        # draws hit it too — that's life on a shared sidecar — but its
+        # QUEUE WAIT is what fairness must bound)
+        stop = _threading.Event()
+        tenant_errors = []
+
+        def clean_tenant():
+            try:
+                pools = [make_nodepool(name="tenant-b")]
+                its = {"tenant-b": list(CATALOG)}
+                client = remote.SolverClient(
+                    f"127.0.0.1:{srv.server_address[1]}",
+                    timeout=60, max_retries=0, sleep=lambda s: None,
+                    tenant="tenant-b",
+                )
+                rs = remote.RemoteScheduler(client, pools, its)
+                pods = [make_pod(cpu=0.5, name=f"tb{i}") for i in range(6)]
+                while not stop.is_set():
+                    res = rs.solve(pods)
+                    assert res.all_pods_scheduled()
+            except Exception as e:  # surfaced after join
+                tenant_errors.append(repr(e))
+
+        hammer = _threading.Thread(target=clean_tenant, daemon=True)
+        hammer.start()
+        try:
+            op.kube.create(make_nodepool())
+            serial = 0
+            for cycle in range(8):
+                wave = []
+                for _ in range(rng.randint(2, 5)):
+                    cpu = rng.choice([0.5, 1.0, 2.0])
+                    wave.append((f"dv{serial}", cpu))
+                    op.kube.create(replicated(make_pod(
+                        cpu=cpu, name=f"dv{serial}"
+                    )))
+                    serial += 1
+                pod_waves.append(wave)
+                op.run_until_idle(max_iters=400, disrupt=False)
+                # a watchdog trip drained the in-thread gateway: the
+                # "supervisor respawn" for an in-thread daemon is resume()
+                if daemon.gateway.draining():
+                    daemon.gateway.resume()
+                op.run_until_idle(max_iters=400, disrupt=False)
+                assert all(p.node_name for p in op.kube.list_pods()), (
+                    f"cycle {cycle}: unbound pods despite degradation paths"
+                )
+            # quiet tail: chaos off, breaker must close and the device
+            # path must serve again
+            schedule.rates = {}
+            for i in range(2):
+                op.kube.create(replicated(make_pod(
+                    cpu=1.0, name=f"tail{i}"
+                )))
+            pod_waves.append([(f"tail{i}", 1.0) for i in range(2)])
+            op.run_until_idle(max_iters=400, disrupt=False)
+            assert all(p.node_name for p in op.kube.list_pods())
+            assert op.solver_client.breaker.state == STATE_CLOSED
+        finally:
+            stop.set()
+            hammer.join(timeout=30)
+            op.shutdown()
+            srv.shutdown()
+            srv.server_close()
+        assert not tenant_errors, tenant_errors
+
+        # at least some of each fault class actually fired
+        assert chaos.injected.get("crash", 0) > 0
+        assert chaos.injected.get("corrupt_wire", 0) > 0
+        assert chaos.injected.get("bad_result", 0) > 0
+
+        # greedy-parity twin: the same pod stream, same wave structure, on
+        # a clean greedy operator; the chaos run may BEAT it (device
+        # packing) but must not be meaningfully worse
+        _reset_claim_counter()
+        clock = FakeClock()
+        kube = KubeStore(clock)
+        twin = Operator(
+            kube=kube, cloud_provider=KwokCloudProvider(kube, CATALOG),
+            clock=clock, options=Options(solver="greedy"),
+        )
+        kube.create(make_nodepool())
+        for wave in pod_waves:
+            for name, cpu in wave:
+                kube.create(replicated(make_pod(cpu=cpu, name=name)))
+            twin.run_until_idle(max_iters=400, disrupt=False)
+        greedy_nodes = len(twin.kube.list_nodes())
+        chaos_nodes = len(op.kube.list_nodes())
+        assert chaos_nodes <= greedy_nodes + max(2, 0.2 * greedy_nodes), (
+            f"chaos={chaos_nodes} greedy={greedy_nodes}"
+        )
+
+        # the unaffected tenant's queue wait stayed bounded: fairness
+        # holds even while the chaotic tenant burned faults
+        snap = daemon.gateway.snapshot()
+        waits = snap["tenants"].get("tenant-b", {})
+        if waits.get("n"):
+            bound = 3.0 * 2 * max(snap["device_p50_s"], 0.05)
+            assert waits["wait_p99_s"] <= bound + 1.0, (waits, snap)
+
+    def test_sidecar_murder_soak(self):
+        """Murder wave: a REAL spawned sidecar killed repeatedly mid-run;
+        provisioning keeps completing (greedy fallback inside the
+        deadline), the supervisor respawns it, and the device path comes
+        back each time."""
+        from tests.test_solverd import new_operator as solverd_operator
+
+        from karpenter_core_tpu.metrics import wiring as m
+
+        op = solverd_operator("sidecar", batch_idle_duration=0.0)
+        try:
+            sup = op.solver_supervisor
+            op.solver_client.max_retries = 0
+            op.solver_client.sleep = lambda s: None
+            op.kube.create(make_nodepool())
+            for round_ in range(3):
+                op.solver_client.timeout = 120.0
+                op.kube.create(replicated(make_pod(
+                    cpu=1.0, name=f"mm{round_}-alive"
+                )))
+                op.run_until_idle(disrupt=False)
+                assert all(p.node_name for p in op.kube.list_pods())
+                # murder; hold the respawn window shut so the next solve
+                # really runs against a dead process
+                op.solver_client.timeout = 1.0
+                sup._delay = 9999.0
+                sup.proc.kill()
+                sup.proc.wait(timeout=10)
+                fb = m.SOLVER_RPC_FALLBACKS.value({"endpoint": "solve"})
+                op.kube.create(replicated(make_pod(
+                    cpu=1.0, name=f"mm{round_}-dead"
+                )))
+                op.run_until_idle(disrupt=False)
+                assert all(p.node_name for p in op.kube.list_pods())
+                assert m.SOLVER_RPC_FALLBACKS.value(
+                    {"endpoint": "solve"}
+                ) > fb
+                # open the window: the supervisor brings it back
+                sup._delay = 0.0
+                sup._next_spawn_at = 0.0
+                assert sup.poll()
+                op.solver_client.set_addr(sup.addr)
+            assert m.SOLVERD_RESTARTS.value({"cause": "crash"}) >= 3
+            assert_coherent(op)
+        finally:
+            op.shutdown()
+
+
 @pytest.mark.slow
 class TestChaosSoak:
     """The long soak: heavier churn, both solve paths, repeated storms."""

@@ -9,7 +9,13 @@ Invariants per seed:
 * pod conservation (every scheduled pod lands exactly once),
 * node-count within the greedy-parity tolerance,
 * constraint satisfaction checked on the DEVICE result directly
-  (anti-affinity, taint tolerance, zone pins).
+  (anti-affinity, taint tolerance, zone pins),
+* the ResultVerifier (solver/verify.py) accepts the device result — the
+  false-positive guard: verification runs inside every production solve,
+  so a verifier that rejects legitimate packings silently degrades the
+  whole fleet to greedy. The mutation battery below is its twin: every
+  way of corrupting a VALID result must be rejected with the right
+  reason, or the verifier is a no-op wearing a trust anchor's name.
 """
 import copy
 import random
@@ -184,8 +190,7 @@ def check_device_invariants(res, existing):
                             )
 
 
-@pytest.mark.parametrize("seed", range(14))
-def test_fuzz_mixed_scenarios(seed):
+def fuzz_scenario(seed):
     rng = random.Random(1000 + seed)
     pods = random_pods(rng, rng.randint(30, 80))
     existing = random_existing(rng, rng.randint(0, 4))
@@ -193,14 +198,28 @@ def test_fuzz_mixed_scenarios(seed):
         NodeSelectorRequirement(L.LABEL_TOPOLOGY_ZONE, "In", ZONES)
     ])]
     its = {"default": list(CATALOG)}
+    return pods, existing, pools, its
+
+
+@pytest.mark.parametrize("seed", range(14))
+def test_fuzz_mixed_scenarios(seed):
+    from karpenter_core_tpu.metrics import wiring as m
+
+    pods, existing, pools, its = fuzz_scenario(seed)
 
     g = Scheduler(copy.deepcopy(pools), its,
                   existing_nodes=copy.deepcopy(existing))
     rg = g.solve(copy.deepcopy(pods))
+    rejected_before = dict(m.SOLVER_RESULT_REJECTED.values)
+    # verification ON (the production default): a fuzz seed that trips the
+    # verifier is a false positive — the solve would silently degrade
     d = DeviceScheduler(copy.deepcopy(pools), its,
                         existing_nodes=copy.deepcopy(existing),
                         max_slots=128)
     rd = d.solve(copy.deepcopy(pods))
+    assert dict(m.SOLVER_RESULT_REJECTED.values) == rejected_before, (
+        "verifier false-positive on a legitimate device result"
+    )
 
     assert set(rg.pod_errors) == set(rd.pod_errors), (
         rg.pod_errors, rd.pod_errors
@@ -219,3 +238,157 @@ def test_fuzz_mixed_scenarios(seed):
             2, 0.2 * rg.node_count()
         ), f"greedy={rg.node_count()} device={rd.node_count()}"
     check_device_invariants(rd, existing)
+
+
+# ---------------------------------------------------------------------------
+# ResultVerifier: false-positive guard + mutation battery
+# ---------------------------------------------------------------------------
+
+from karpenter_core_tpu.solver.verify import ResultVerifier  # noqa: E402
+
+
+@pytest.mark.parametrize("seed", range(14))
+def test_verifier_accepts_every_fuzz_seed(seed):
+    """Direct false-positive guard: BOTH solvers' results on every fuzz
+    seed verify clean (the greedy oracle is feasible by construction, so a
+    violation on its result is always a verifier bug)."""
+    pods, existing, pools, its = fuzz_scenario(seed)
+
+    d = DeviceScheduler(copy.deepcopy(pools), its,
+                        existing_nodes=copy.deepcopy(existing),
+                        max_slots=128, verify=False)
+    dp = copy.deepcopy(pods)
+    rd = d.solve(dp)
+    violations = ResultVerifier(
+        pools, its, existing_nodes=copy.deepcopy(existing)
+    ).verify(rd, dp)
+    assert not violations, [str(v) for v in violations]
+
+    g = Scheduler(copy.deepcopy(pools), its,
+                  existing_nodes=copy.deepcopy(existing))
+    gp = copy.deepcopy(pods)
+    rg = g.solve(gp)
+    violations = ResultVerifier(
+        pools, its, existing_nodes=copy.deepcopy(existing)
+    ).verify(rg, gp)
+    assert not violations, [str(v) for v in violations]
+
+
+class TestVerifierMutations:
+    """Corrupt a VALID device result in k distinct ways; each mutation
+    class must be rejected with its own reason — the detection
+    contract the chaos layer and the optimizing-backend roadmap item
+    both lean on."""
+
+    SEED = 1003  # a seed whose solve yields multiple multi-pod claims
+
+    def _solved(self):
+        pods, existing, pools, its = fuzz_scenario(self.SEED)
+        d = DeviceScheduler(copy.deepcopy(pools), its,
+                            existing_nodes=copy.deepcopy(existing),
+                            max_slots=128, verify=False)
+        sp = copy.deepcopy(pods)
+        res = d.solve(sp)
+        verifier = ResultVerifier(
+            pools, its, existing_nodes=copy.deepcopy(existing)
+        )
+        # precondition: the unmutated result is clean
+        assert not verifier.verify(res, sp)
+        return res, sp, pools, its, existing
+
+    def _reasons(self, verifier, res, sp):
+        return {v.reason for v in verifier.verify(res, sp)}
+
+    def test_dropped_pod_is_conservation(self):
+        res, sp, pools, its, existing = self._solved()
+        claim = next(c for c in res.new_node_claims if c.pods)
+        claim.pods.pop()
+        reasons = self._reasons(
+            ResultVerifier(pools, its, existing_nodes=existing), res, sp
+        )
+        assert "conservation" in reasons, reasons
+
+    def test_double_place_is_detected(self):
+        res, sp, pools, its, existing = self._solved()
+        donor = next(c for c in res.new_node_claims if c.pods)
+        other = next(c for c in res.new_node_claims if c is not donor)
+        other.pods.append(donor.pods[0])
+        reasons = self._reasons(
+            ResultVerifier(pools, its, existing_nodes=existing), res, sp
+        )
+        assert "double_place" in reasons, reasons
+
+    def test_overpacked_node_is_capacity(self):
+        res, sp, pools, its, existing = self._solved()
+        claims = [c for c in res.new_node_claims if c.pods]
+        assert len(claims) >= 2, "scenario must yield multiple claims"
+        target = claims[0]
+        for c in claims[1:]:
+            target.pods.extend(c.pods)
+            c.pods = []
+        reasons = self._reasons(
+            ResultVerifier(pools, its, existing_nodes=existing), res, sp
+        )
+        assert "capacity" in reasons, reasons
+
+    def test_violated_zone_pin_is_selector(self):
+        from karpenter_core_tpu.scheduling import Requirement
+
+        res, sp, pools, its, existing = self._solved()
+        mutated = False
+        for c in res.new_node_claims:
+            for p in c.pods:
+                if not (p.affinity and p.affinity.node_affinity
+                        and p.affinity.node_affinity.required):
+                    continue
+                exprs = [
+                    e
+                    for t in p.affinity.node_affinity.required
+                    for e in t.match_expressions
+                    if e.key == L.LABEL_TOPOLOGY_ZONE
+                ]
+                if exprs and len(exprs[0].values) < len(ZONES):
+                    forbidden = sorted(
+                        set(ZONES) - set(exprs[0].values)
+                    )[0]
+                    c.requirements[L.LABEL_TOPOLOGY_ZONE] = Requirement.new(
+                        L.LABEL_TOPOLOGY_ZONE, "In", [forbidden]
+                    )
+                    mutated = True
+                    break
+            if mutated:
+                break
+        assert mutated, "scenario must contain a zone-pinned pod"
+        reasons = self._reasons(
+            ResultVerifier(pools, its, existing_nodes=existing), res, sp
+        )
+        assert "selector" in reasons, reasons
+
+    def test_stale_offering_is_offering(self):
+        res, sp, pools, its, existing = self._solved()
+        claim = next(c for c in res.new_node_claims if c.pods)
+        # ICE every offering of every surviving option AFTER the solve —
+        # exactly the staleness shape: the packing references capacity
+        # that stocked out between solve and verification
+        iced = frozenset(
+            o.key(it.name)
+            for it in claim.instance_type_options
+            for o in it.offerings
+        )
+        reasons = self._reasons(
+            ResultVerifier(
+                pools, its, existing_nodes=existing,
+                unavailable_offerings=iced,
+            ),
+            res, sp,
+        )
+        assert "offering" in reasons, reasons
+
+    def test_unknown_pod_uid_is_structure(self):
+        res, sp, pools, its, existing = self._solved()
+        claim = next(c for c in res.new_node_claims if c.pods)
+        claim.pods.append(make_pod(cpu=0.1, name="stranger"))
+        reasons = self._reasons(
+            ResultVerifier(pools, its, existing_nodes=existing), res, sp
+        )
+        assert "structure" in reasons, reasons

@@ -503,7 +503,8 @@ class TestDegradation:
             assert sup.alive()
             assert op.solver_client.addr == sup.addr
             assert op.recorder.with_reason("SidecarRestarted")
-            assert m.SOLVER_SIDECAR_RESTARTS.value() >= 1
+            # a kill is a crash-restart; drain restarts label separately
+            assert m.SOLVERD_RESTARTS.value({"cause": "crash"}) >= 1
             assert all(p.node_name for p in op.kube.list_pods())
             # device path resumed: later solves record no new fallbacks
             fallback_after = m.SOLVER_RPC_FALLBACKS.value(
@@ -661,6 +662,630 @@ class TestSchedulerReuse:
         # a different catalog IS a different problem
         daemon.solve(self._request(pods, catalog=self.ALT_CATALOG))
         assert len(daemon._sched_cache) == 2
+
+
+# ---------------------------------------------------------------------------
+# verified solves + crash-only device tier (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+
+class _FixedResponseHandler(BaseHTTPRequestHandler):
+    """Serves pre-baked bytes with a chosen status — the crafted-response
+    seam for corrupt-wire / drain / quarantine client contracts."""
+
+    status = 200
+    payload = b""
+    hits = None  # list shared with the test
+
+    def log_message(self, *args):
+        pass
+
+    def do_POST(self):
+        self.rfile.read(int(self.headers.get("Content-Length", 0) or 0))
+        if self.hits is not None:
+            self.hits.append(self.path)
+        body = self.payload
+        self.send_response(self.status)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("X-Solver-Seconds", "0.001")
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _fixed_server(status, payload, hits=None):
+    handler = type(
+        "Fixed", (_FixedResponseHandler,),
+        {"status": status, "payload": payload, "hits": hits},
+    )
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+def _no_quarantine_client(addr, **kwargs):
+    """A SolverClient whose quarantine never engages (strikes=huge): most
+    degradation tests exercise N failures on ONE problem digest and must
+    not have the quarantine short-circuit the path under test."""
+    from karpenter_core_tpu.solver.fleet import PoisonQuarantine
+
+    kwargs.setdefault("quarantine", PoisonQuarantine(strikes=10_000))
+    return SolverClient(addr, **kwargs)
+
+
+def _solve_problem(n=4):
+    pools = [make_nodepool()]
+    its = {"default": fake_instance_types(4)}
+    pods = [make_pod(cpu=1.0, name=f"v{i}") for i in range(n)]
+    return pools, its, pods
+
+
+def _valid_result_header(pools, its, pods):
+    """A structurally valid solve-result header for mutation: solve the
+    problem in-proc and round-trip the results through the codec."""
+    from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+    res = DeviceScheduler(pools, dict(its), max_slots=32).solve(pods)
+    data = codec.encode_solve_results(res, 0.01)
+    return codec._json_header(data)
+
+
+class TestCorruptWire:
+    """Satellite: RemoteScheduler._materialize hardened against
+    truncated/corrupt result wire — every malformed field takes the
+    NORMAL degradation path (RemoteSolverError -> greedy fallback,
+    breaker charged) instead of a TypeError escaping into the
+    reconciler."""
+
+    def _materialize_corrupt(self, mutate):
+        from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+        pools, its, pods = _solve_problem()
+        res = DeviceScheduler(pools, dict(its), max_slots=32).solve(pods)
+        # decode_solve_results converts requirements; the dict is exactly
+        # what _materialize receives in production
+        wire = codec.decode_solve_results(
+            codec.encode_solve_results(res, 0.01)
+        )
+        assert wire["claims"], "scenario must produce claims"
+        mutate(wire)
+        client = _no_quarantine_client(
+            "127.0.0.1:1", timeout=5, max_retries=0, sleep=lambda s: None
+        )
+        rs = RemoteScheduler(client, pools, its)
+        with pytest.raises(RemoteSolverError) as exc:
+            rs._materialize(wire, pods)
+        assert exc.value.cause == "corrupt", exc.value
+
+    def test_pod_uids_as_string_is_corrupt(self):
+        # the nastiest shape: a string ITERATES (as characters), so the
+        # claim would silently materialize empty without the check
+        self._materialize_corrupt(
+            lambda w: w["claims"][0].__setitem__("pod_uids", "uid-v0")
+        )
+
+    def test_requests_as_list_is_corrupt(self):
+        self._materialize_corrupt(
+            lambda w: w["claims"][0].__setitem__("requests", [1, 2])
+        )
+
+    def test_errors_as_list_is_corrupt(self):
+        self._materialize_corrupt(lambda w: w.__setitem__("errors", []))
+
+    def test_claims_as_dict_is_corrupt(self):
+        self._materialize_corrupt(lambda w: w.__setitem__("claims", {}))
+
+    def test_instance_types_as_ints_is_corrupt(self):
+        self._materialize_corrupt(
+            lambda w: w["claims"][0].__setitem__("instance_types", [1])
+        )
+
+    def test_raw_requirements_is_corrupt(self):
+        # decode_solve_results normally converts these; a payload that
+        # skips the conversion (or a truncated decode) must not land raw
+        # dicts where Requirements algebra is expected
+        self._materialize_corrupt(
+            lambda w: w["claims"][0].__setitem__(
+                "requirements", [{"key": "zone"}]
+            )
+        )
+
+    def test_existing_entry_malformed_is_corrupt(self):
+        self._materialize_corrupt(
+            lambda w: w.__setitem__("existing", [{"node": 7, "pod_uids": []}])
+        )
+
+    def test_nonlist_existing_is_corrupt(self):
+        self._materialize_corrupt(lambda w: w.__setitem__("existing", 3))
+
+    def test_corrupt_content_charges_breaker_and_degrades(self):
+        """End to end over HTTP: a 200 response whose content is malformed
+        (valid npz+json container, corrupt fields) degrades to greedy AND
+        charges the breaker — a sidecar producing garbage should open the
+        circuit like a dead one."""
+        pools, its, pods = _solve_problem()
+        wire = _valid_result_header(pools, its, pods)
+        wire["claims"][0]["pod_uids"] = 12345  # not a list of strings
+        payload = codec._json_payload(wire)
+        srv = _fixed_server(200, payload)
+        try:
+            client = _no_quarantine_client(
+                f"127.0.0.1:{srv.server_address[1]}",
+                timeout=5, max_retries=0, sleep=lambda s: None,
+            )
+            rs = RemoteScheduler(client, pools, its)
+            failures = m.SOLVER_RPC_FAILURES.value({"cause": "corrupt"})
+            res = rs.solve(pods)
+            assert res.all_pods_scheduled()  # greedy fallback placed them
+            assert client.breaker.failures == 1
+            assert m.SOLVER_RPC_FAILURES.value(
+                {"cause": "corrupt"}
+            ) == failures + 1
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_truncated_wire_degrades_via_decode(self):
+        """Bytes damaged below the container level fail in decode (not
+        _materialize) and take the decode-cause degradation path."""
+        from karpenter_core_tpu.chaos import ChaosSchedule, SolverChaos
+
+        pools, its, pods = _solve_problem()
+        wire = _valid_result_header(pools, its, pods)
+        chaos = SolverChaos(ChaosSchedule())
+        payload = chaos.corrupt(codec._json_payload(wire))
+        srv = _fixed_server(200, payload)
+        try:
+            client = _no_quarantine_client(
+                f"127.0.0.1:{srv.server_address[1]}",
+                timeout=5, max_retries=0, sleep=lambda s: None,
+            )
+            rs = RemoteScheduler(client, pools, its)
+            decode_failures = m.SOLVER_RPC_FAILURES.value({"cause": "decode"})
+            res = rs.solve(pods)
+            assert res.all_pods_scheduled()
+            assert m.SOLVER_RPC_FAILURES.value(
+                {"cause": "decode"}
+            ) == decode_failures + 1
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+class TestResultVerificationOverWire:
+    def test_bad_result_rejected_and_degraded(self):
+        """A sidecar returning a structurally valid wire whose CONTENT is
+        wrong (chaos bad_result: one placed pod silently dropped) is
+        caught by the client's ResultVerifier: the solve degrades to
+        greedy, the rejection metric moves, and every pod still lands."""
+        from karpenter_core_tpu.chaos import ChaosSchedule, SolverChaos
+
+        chaos = SolverChaos(ChaosSchedule(
+            script={"solverd.solve": ["bad_result"]}
+        ))
+        daemon = service.SolverDaemon(chaos=chaos)
+        srv = service.serve(0, daemon=daemon)
+        try:
+            pools, its, pods = _solve_problem(6)
+            client = _no_quarantine_client(
+                sidecar_addr(srv), timeout=120,
+            )
+            rs = RemoteScheduler(client, pools, its)
+            rejected = m.SOLVER_RESULT_REJECTED.value(
+                {"reason": "conservation", "path": "sidecar"}
+            )
+            fallbacks = m.SOLVER_RPC_FALLBACKS.value({"endpoint": "solve"})
+            res = rs.solve(pods)
+            assert res.all_pods_scheduled()
+            assert chaos.injected.get("bad_result") == 1
+            assert m.SOLVER_RESULT_REJECTED.value(
+                {"reason": "conservation", "path": "sidecar"}
+            ) == rejected + 1
+            assert m.SOLVER_RPC_FALLBACKS.value(
+                {"endpoint": "solve"}
+            ) == fallbacks + 1
+            # the chaos script is exhausted -> the next solve is healthy
+            # and verification passes silently
+            res = rs.solve(pods)
+            assert res.all_pods_scheduled()
+            assert m.SOLVER_RESULT_REJECTED.value(
+                {"reason": "conservation", "path": "sidecar"}
+            ) == rejected + 1
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+class TestDrainContract:
+    def test_gateway_drain_flushes_queued_tickets(self):
+        from karpenter_core_tpu.solver import fleet
+
+        gw = fleet.FleetGateway(max_depth=8)
+        holder = gw.submit("a")
+        gw.await_grant(holder)  # owns the device
+        outcomes = []
+
+        def queued_request():
+            ticket = gw.submit("b")
+            try:
+                gw.await_grant(ticket)
+                outcomes.append("granted")
+            except fleet.DrainError:
+                outcomes.append("drained")
+
+        t = threading.Thread(target=queued_request, daemon=True)
+        t.start()
+        for _ in range(200):
+            if gw.depth() >= 2:
+                break
+            time.sleep(0.005)
+        assert gw.drain() == 1  # the queued ticket flushed
+        t.join(timeout=5)
+        assert outcomes == ["drained"]
+        with pytest.raises(fleet.DrainError):
+            gw.submit("c")  # admission closed
+        gw.release(holder, 0.01)  # the active step still releases cleanly
+        gw.resume()
+        gw.await_grant(gw.submit("d"))  # re-opened
+
+    def test_client_treats_503_as_degrade_not_fault(self):
+        srv = _fixed_server(503, b'{"error": "draining"}')
+        try:
+            pools, its, pods = _solve_problem()
+            client = _no_quarantine_client(
+                f"127.0.0.1:{srv.server_address[1]}",
+                timeout=5, max_retries=2, sleep=lambda s: None,
+            )
+            rs = RemoteScheduler(client, pools, its)
+            res = rs.solve(pods)
+            assert res.all_pods_scheduled()
+            # drain is an ANSWER: no breaker charge, no retries burned
+            assert client.breaker.failures == 0
+            assert client.breaker.state == STATE_CLOSED
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_drain_endpoint_and_healthz(self):
+        daemon = service.SolverDaemon()
+        state = daemon.drain()
+        assert state == {"draining": True, "flushed": 0, "exiting": False}
+        health = daemon.health()
+        assert health["draining"] is True
+        assert health["ready"] is False
+        from karpenter_core_tpu.solver import fleet
+
+        with pytest.raises(fleet.DrainError):
+            daemon.solve(b"irrelevant")
+        daemon.gateway.resume()
+        assert daemon.health()["draining"] is False
+
+    def test_drain_exit_fn_fires_after_idle(self):
+        exits = []
+        daemon = service.SolverDaemon(exit_fn=exits.append)
+        state = daemon.drain()
+        assert state["exiting"] is True
+        for _ in range(200):
+            if exits:
+                break
+            time.sleep(0.02)
+        from karpenter_core_tpu.solver.supervisor import DRAIN_EXIT_CODE
+
+        assert exits == [DRAIN_EXIT_CODE]
+
+
+class TestWatchdog:
+    def test_unit_trips_on_overrun_only(self):
+        now = [0.0]
+        trips, exits = [], []
+        wd = service.DeviceWatchdog(
+            5.0, on_trip=trips.append, exit_fn=exits.append,
+            time_fn=lambda: now[0], poll_seconds=0,  # no monitor thread
+        )
+        wd.arm("step-1")
+        assert not wd.check()
+        now[0] = 4.9
+        assert not wd.check()
+        now[0] = 5.1
+        assert wd.check()
+        assert trips == ["step-1"] and wd.trips == 1
+        from karpenter_core_tpu.solver.supervisor import WATCHDOG_EXIT_CODE
+
+        assert exits == [WATCHDOG_EXIT_CODE]
+        # disarmed after the trip: no double-fire
+        assert not wd.check()
+
+    def test_disarm_prevents_trip(self):
+        now = [0.0]
+        trips = []
+        wd = service.DeviceWatchdog(
+            1.0, on_trip=trips.append, time_fn=lambda: now[0],
+            poll_seconds=0,
+        )
+        wd.arm()
+        wd.disarm()
+        now[0] = 100.0
+        assert not wd.check()
+        assert trips == []
+
+    def test_wedged_device_step_trips_watchdog_and_drains(self):
+        """The wedge shape end to end (in-thread): a chaos-wedged device
+        step overruns the budget; the watchdog drains the gateway (a
+        queued request answers 503, not silence) and invokes the
+        crash-only exit hook."""
+        from karpenter_core_tpu.chaos import ChaosSchedule, SolverChaos
+        from karpenter_core_tpu.solver import fleet
+        from karpenter_core_tpu.solver.supervisor import WATCHDOG_EXIT_CODE
+
+        exits = []
+        chaos = SolverChaos(ChaosSchedule(
+            script={"solverd.solve": ["wedge:0.8"]}
+        ))
+        daemon = service.SolverDaemon(
+            watchdog_seconds=0.15, chaos=chaos, exit_fn=exits.append,
+        )
+        pools, its, pods = _solve_problem(2)
+        body = codec.encode_solve_request(pools, its, [], [], pods,
+                                          max_slots=16)
+        trips_before = m.SOLVERD_WATCHDOG_TRIPS.value()
+        out, _dt = daemon.solve(body)  # wedged but completes (in-thread)
+        assert codec.decode_solve_results(out)["errors"] == {}
+        assert daemon.watchdog.trips == 1
+        assert m.SOLVERD_WATCHDOG_TRIPS.value() == trips_before + 1
+        assert exits == [WATCHDOG_EXIT_CODE]
+        # the trip drained the gateway: new admissions are refused until
+        # the (in tests, simulated) process restart
+        with pytest.raises(fleet.DrainError):
+            daemon.solve(body)
+        assert daemon.health()["draining"] is True
+        daemon.gateway.resume()
+        # healthy again: the next solve passes and does not re-trip
+        out, _dt = daemon.solve(body)
+        assert daemon.watchdog.trips == 1
+
+
+class TestPoisonQuarantine:
+    def test_strikes_ttl_and_clear(self):
+        from karpenter_core_tpu.solver.fleet import PoisonQuarantine
+
+        now = [0.0]
+        q = PoisonQuarantine(strikes=3, ttl=10.0, time_fn=lambda: now[0])
+        assert not q.strike("fp1")
+        assert not q.strike("fp1")
+        assert not q.quarantined("fp1")
+        assert q.strike("fp1")  # third strike quarantines
+        assert q.quarantined("fp1")
+        assert q.size() == 1
+        now[0] = 10.1  # TTL elapses: fresh chance
+        assert not q.quarantined("fp1")
+        assert q.size() == 0
+        # a success clears the streak
+        assert not q.strike("fp2")
+        q.clear("fp2")
+        assert not q.strike("fp2")
+        assert not q.strike("fp2")
+        assert q.strike("fp2")  # 3 consecutive post-clear
+
+    def test_stale_streaks_forgive(self):
+        from karpenter_core_tpu.solver.fleet import PoisonQuarantine
+
+        now = [0.0]
+        q = PoisonQuarantine(strikes=2, ttl=5.0, time_fn=lambda: now[0])
+        assert not q.strike("fp")
+        now[0] = 6.0  # outside the window: the old strike expired
+        assert not q.strike("fp")
+        assert not q.quarantined("fp")
+
+    def test_poison_is_immediate(self):
+        from karpenter_core_tpu.solver.fleet import PoisonQuarantine
+
+        q = PoisonQuarantine()
+        q.poison("fp")
+        assert q.quarantined("fp")
+
+    def test_cap_bounds_both_maps(self):
+        from karpenter_core_tpu.solver.fleet import PoisonQuarantine
+
+        q = PoisonQuarantine(strikes=1, cap=8)
+        for i in range(50):
+            q.strike(f"fp{i}")
+        assert q.size() <= 8
+
+    def test_journal_recovers_inflight_crash(self, tmp_path):
+        from karpenter_core_tpu.solver.fleet import PoisonQuarantine
+
+        journal = str(tmp_path / "poison.json")
+        # boot->wedge->die cycles: each boot recovers the PREVIOUS boot's
+        # in-flight digest as a strike, so the Nth strike lands on boot N
+        for boot in range(4):
+            q = PoisonQuarantine(
+                strikes=3, journal_path=journal, site="gateway"
+            )
+            if boot == 3:
+                assert q.quarantined("fp-poison")
+                return
+            assert not q.quarantined("fp-poison")
+            q.begin("fp-poison")  # ...and the process "dies" here
+
+    def test_journal_clean_completion_never_strikes(self, tmp_path):
+        from karpenter_core_tpu.solver.fleet import PoisonQuarantine
+
+        journal = str(tmp_path / "poison.json")
+        q = PoisonQuarantine(strikes=1, journal_path=journal)
+        q.begin("fp")
+        q.done("fp")
+        q2 = PoisonQuarantine(strikes=1, journal_path=journal)
+        assert not q2.quarantined("fp")
+
+    def test_daemon_quarantines_crashing_problem(self):
+        """Gateway-side: a problem whose device phase raises N times is
+        refused pre-decode with QuarantinedError (HTTP 422) — it stops
+        burning grants for every tenant."""
+        from karpenter_core_tpu.solver import fleet
+
+        daemon = service.SolverDaemon(
+            quarantine=fleet.PoisonQuarantine(strikes=2, site="gateway"),
+        )
+        pools, its, pods = _solve_problem(2)
+        body = codec.encode_solve_request(pools, its, [], [], pods,
+                                          max_slots=16)
+        fp = codec.decode_solve_request(body)["fingerprint"]
+
+        class _Bomb:
+            def update_topology_context(self, topo):
+                pass
+
+            def solve(self, pods):
+                raise RuntimeError("chaos: poisoned problem")
+
+        daemon._sched_cache.put(fp, _Bomb(), 64)
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                daemon.solve(body)
+        routed = m.SOLVER_QUARANTINE_ROUTED.value({"site": "gateway"})
+        with pytest.raises(fleet.QuarantinedError):
+            daemon.solve(body)
+        assert m.SOLVER_QUARANTINE_ROUTED.value(
+            {"site": "gateway"}
+        ) == routed + 1
+        assert daemon.health()["quarantine_entries"] == 1
+
+    def test_client_mirrors_gateway_422(self):
+        """The 422 contract: the client degrades to greedy WITHOUT
+        charging the breaker, quarantines locally, and the next solve for
+        the same problem never touches the wire."""
+        hits = []
+        srv = _fixed_server(
+            422, b'{"error": "quarantined", "fingerprint": "x"}', hits=hits
+        )
+        try:
+            pools, its, pods = _solve_problem()
+            client = SolverClient(
+                f"127.0.0.1:{srv.server_address[1]}",
+                timeout=5, max_retries=2, sleep=lambda s: None,
+            )
+            rs = RemoteScheduler(client, pools, its)
+            routed = m.SOLVER_QUARANTINE_ROUTED.value({"site": "client"})
+            res = rs.solve(pods)
+            assert res.all_pods_scheduled()
+            assert client.breaker.failures == 0
+            assert len(hits) == 1  # no retries against a refusal
+            res = rs.solve(pods)
+            assert res.all_pods_scheduled()
+            assert len(hits) == 1  # second solve short-circuited locally
+            assert m.SOLVER_QUARANTINE_ROUTED.value(
+                {"site": "client"}
+            ) == routed + 1
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_client_quarantines_repeated_timeouts(self):
+        """Client-side hang shape: N timeouts on one problem digest and
+        the client stops burning RPC budget on it (straight to greedy)."""
+        from karpenter_core_tpu.solver.fleet import PoisonQuarantine
+
+        pools, its, pods = _solve_problem()
+        injector = FaultInjector(["timeout"] * 10)
+        client = SolverClient(
+            "127.0.0.1:1", timeout=0.2, max_retries=0,
+            fault_injector=injector, sleep=lambda s: None,
+            quarantine=PoisonQuarantine(strikes=3, site="client"),
+            # keep the breaker out of the way: with the default threshold
+            # it would open first and hide whether the QUARANTINE stopped
+            # the transport attempts
+            breaker=CircuitBreaker(failure_threshold=100),
+        )
+        rs = RemoteScheduler(client, pools, its)
+        for _ in range(3):
+            assert rs.solve(pods).all_pods_scheduled()
+        calls_before = injector.calls
+        assert rs.solve(pods).all_pods_scheduled()
+        # quarantined: the 4th solve made no transport attempt at all
+        assert injector.calls == calls_before
+
+
+class TestSupervisorDrainExit:
+    HANDSHAKE = "print('listening on 127.0.0.1:1', flush=True); "
+
+    def _sup(self, code, **kwargs):
+        import sys
+
+        from karpenter_core_tpu.solver.supervisor import SolverSupervisor
+
+        return SolverSupervisor(
+            command=[sys.executable, "-u", "-c", code], **kwargs
+        )
+
+    def test_drain_exit_respawns_immediately_without_backoff(self):
+        from karpenter_core_tpu.solver.supervisor import DRAIN_EXIT_CODE
+
+        now = [0.0]
+        events = []
+        sup = self._sup(
+            self.HANDSHAKE + f"raise SystemExit({DRAIN_EXIT_CODE})",
+            backoff_initial=5.0,
+            time_fn=lambda: now[0],
+            on_event=lambda r, msg: events.append(r),
+        )
+        crash_before = m.SOLVERD_RESTARTS.value({"cause": "crash"})
+        drain_before = m.SOLVERD_RESTARTS.value({"cause": "drain"})
+        sup.start()
+        for round_ in range(3):
+            sup.proc.wait(timeout=10)
+            # every drain exit respawns on the NEXT poll, clock untouched:
+            # no growing backoff window, ever
+            assert sup.poll(), f"round {round_} did not respawn"
+        assert sup._delay == 0.0
+        assert m.SOLVERD_RESTARTS.value({"cause": "drain"}) == drain_before + 3
+        assert m.SOLVERD_RESTARTS.value({"cause": "crash"}) == crash_before
+        assert "SidecarDrained" in events
+        assert "SidecarUnavailable" not in events
+        sup.stop()
+
+    def test_crash_exit_still_charges_backoff(self):
+        now = [0.0]
+        sup = self._sup(
+            self.HANDSHAKE + "raise SystemExit(3)",
+            backoff_initial=2.0,
+            time_fn=lambda: now[0],
+        )
+        sup.start()
+        sup.proc.wait(timeout=10)
+        assert sup.poll()  # first crash respawn is immediate...
+        sup.proc.wait(timeout=10)
+        assert not sup.poll()  # ...the second waits out the 2s window
+        assert sup._delay > 0
+        sup.stop()
+
+    def test_drain_method_against_real_sidecar(self):
+        """The full lifecycle: POST /drain to a REAL spawned solverd, the
+        child flushes and exits with DRAIN_EXIT_CODE, the supervisor
+        respawns it immediately as cause=drain, and the device path
+        serves again from the fresh process."""
+        op = new_operator("sidecar")
+        try:
+            sup = op.solver_supervisor
+            drain_before = m.SOLVERD_RESTARTS.value({"cause": "drain"})
+            assert sup.drain(timeout=30)
+            assert not sup.alive()
+            assert sup.poll()  # immediate respawn, no backoff window
+            assert sup.alive()
+            assert m.SOLVERD_RESTARTS.value(
+                {"cause": "drain"}
+            ) == drain_before + 1
+            op.solver_client.set_addr(sup.addr)
+            op.kube.create(make_nodepool())
+            op.kube.create(replicated(make_pod(cpu=1.0, name="dr0")))
+            fallbacks = m.SOLVER_RPC_FALLBACKS.value({"endpoint": "solve"})
+            op.run_until_idle(disrupt=False)
+            assert all(p.node_name for p in op.kube.list_pods())
+            # served by the RESPAWNED device path, not greedy fallback
+            assert m.SOLVER_RPC_FALLBACKS.value(
+                {"endpoint": "solve"}
+            ) == fallbacks
+        finally:
+            op.shutdown()
 
 
 class TestProfileToggle:
