@@ -15,6 +15,16 @@ Secondary lines (reported in `detail`):
                   concurrent, shed rate + greedy-fallback parity, cache
                   evictions under a deliberately undersized bound, and
                   aggregate pods/sec across the fleet
+  cfg10_batch     continuous cross-tenant batching: 32 tenants of SMALL
+                  problems (the many-small-solves traffic shape) through
+                  ONE sidecar, serialized (max_batch=1, the cfg7-shaped
+                  baseline) vs coalesced (the gateway dispatches
+                  compatible queued problems as one vmapped device
+                  batch); records aggregate pods/sec both ways, the
+                  speedup (target >=2x), mean batch size, batch-axis
+                  padding ratio, and per-tenant p99 queue-wait (must be
+                  no worse batched). A tiny version runs under
+                  BENCH_FAST=1 so tier-1 smokes the batched path
   cfg9_verified   the verification trust anchor's cost: the primary
                   config runs with the ResultVerifier ON (the production
                   default — every config above already pays it), and this
@@ -799,6 +809,215 @@ def _fleet_bench(n_tenants=8, n_pods=1000, n_types=200, repeats=3):
         srv.server_close()
 
 
+def _batch_bench(n_tenants=32, n_pods=120, n_types=60, repeats=3):
+    """cfg10_batch: continuous cross-tenant solve batching (ISSUE 9).
+
+    The many-small-solves traffic shape: N tenants, each with a SMALL
+    problem (distinct fingerprint — tenant-named pool — but identical
+    catalog/pod SHAPES, so every tenant lands in the same compile-shape
+    bucket), hammering one sidecar concurrently. Two phases over the same
+    problems:
+
+    * serialized — max_batch=1: the cfg7-shaped baseline, one exclusive
+      device grant per request;
+    * batched — the production defaults (max_batch=8, a few-ms window):
+      a granted leader coalesces compatible queued problems into one
+      vmapped multi-problem device dispatch.
+
+    Records aggregate pods/sec both ways (speedup target >=2x), the mean
+    batch size and batch-axis padding ratio actually achieved, and
+    per-tenant p99 queue wait (batched must be no worse than serialized:
+    coalescing must AMORTIZE device time, not starve anyone)."""
+    import threading
+
+    from karpenter_core_tpu.cloudprovider.kwok import bench_catalog
+    from karpenter_core_tpu.metrics import wiring as m
+    from karpenter_core_tpu.solver import fleet, remote, service
+
+    catalog = bench_catalog(n_types)
+    tenants = [f"bt{i:02d}" for i in range(n_tenants)]
+    problems = {
+        tenant: {
+            "pools": [_pool(tenant)],
+            "its": {tenant: list(catalog)},
+            # identical shape grid for every tenant: same pod-count bucket
+            # and catalog cardinality -> same problem_bucket, which is
+            # exactly the production fleet shape batching targets
+            "pods": _plain_pods(n_pods, shapes=(6, 4)),
+        }
+        for tenant in tenants
+    }
+
+    def run_phase(max_batch, window_s):
+        gateway = fleet.FleetGateway(
+            # deep enough that nothing sheds: this config measures
+            # throughput and wait, cfg7 owns overload behavior
+            max_depth=2 * n_tenants + 4,
+            max_batch=max_batch,
+            batch_window=window_s,
+        )
+        cache = fleet.BoundedSchedulerCache(max_entries=n_tenants + 2)
+        daemon = service.SolverDaemon(gateway=gateway, sched_cache=cache)
+        srv = service.serve(0, daemon=daemon)
+        try:
+            addr = f"127.0.0.1:{srv.server_address[1]}"
+
+            def scheduler_for(tenant):
+                p = problems[tenant]
+                client = remote.SolverClient(addr, timeout=600, tenant=tenant)
+                return remote.RemoteScheduler(
+                    client, p["pools"], p["its"],
+                    device_scheduler_opts={"max_slots": 256},
+                    verify=not NO_VERIFY,
+                )
+
+            errors = []
+            counts = {t: 0 for t in tenants}
+
+            def hammer(tenant, rounds, count=False):
+                try:
+                    rs = scheduler_for(tenant)
+                    for _ in range(rounds):
+                        res = rs.solve(problems[tenant]["pods"])
+                        assert res.all_pods_scheduled(), res.pod_errors
+                        if count:
+                            counts[tenant] += 1
+                except Exception as e:  # surfaced after join
+                    errors.append((tenant, repr(e)))
+
+            # warm-up 1: the batched jit entries compile per padded batch
+            # size (1, 2, 4, ... — the power-of-two batch-axis pad), so
+            # warm each size DETERMINISTICALLY with in-process
+            # solve_batch calls at the exact problem shapes the timed
+            # phase produces (the jit cache is process-global; the
+            # concurrent warm rounds below cannot guarantee which batch
+            # sizes they hit)
+            if max_batch > 1:
+                import copy as _copy
+
+                from karpenter_core_tpu.models.provisioner import (
+                    DeviceScheduler,
+                    solve_batch,
+                )
+
+                size = 2
+                while size <= max_batch:
+                    entries = []
+                    for j in range(size):
+                        p = problems[tenants[j % n_tenants]]
+                        entries.append((
+                            DeviceScheduler(
+                                p["pools"], p["its"], max_slots=256,
+                                verify=False,
+                            ),
+                            _copy.deepcopy(p["pods"]),
+                        ))
+                    outcomes, _stats = solve_batch(entries)
+                    assert all(st == "ok" for st, _ in outcomes)
+                    size *= 2
+            # warm-up 2: two untimed concurrent rounds through the real
+            # transport warm the scheduler cache and the remaining cliffs
+            for _ in range(2):
+                ws = [
+                    threading.Thread(
+                        target=hammer, args=(t, 1), daemon=True
+                    )
+                    for t in tenants
+                ]
+                for w in ws:
+                    w.start()
+                for w in ws:
+                    w.join()
+            assert not errors, errors[:3]
+
+            gateway.snapshot(reset=True)
+            pad_sum0 = sum(m.SOLVERD_BATCH_PADDING.sums.values())
+            pad_n0 = sum(m.SOLVERD_BATCH_PADDING.totals.values())
+            threads = [
+                threading.Thread(
+                    target=hammer, args=(t, repeats, True), daemon=True
+                )
+                for t in tenants
+            ]
+            wall0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - wall0
+            assert not errors, errors[:3]
+            snap = gateway.snapshot()
+            solves = sum(counts.values())
+            pad_n = sum(m.SOLVERD_BATCH_PADDING.totals.values()) - pad_n0
+            pad_sum = sum(m.SOLVERD_BATCH_PADDING.sums.values()) - pad_sum0
+            waits = {
+                t: snap["tenants"].get(t, {}).get("wait_p99_s", 0.0)
+                for t in tenants
+            }
+            return {
+                "aggregate_pods_per_sec": round(solves * n_pods / wall, 1),
+                "wall_s": round(wall, 3),
+                "solves": solves,
+                "device_p50_s": snap["device_p50_s"],
+                "grants": snap["grants"],
+                "mean_batch_size": snap["batch"]["mean_size"],
+                "coalesced": snap["batch"]["coalesced"],
+                "padding_ratio": round(pad_sum / pad_n, 4) if pad_n else 0.0,
+                "wait_p99_max_s": round(max(waits.values()), 6),
+                "wait_p99_mean_s": round(
+                    sum(waits.values()) / len(waits), 6
+                ),
+            }
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    serialized = run_phase(1, 0.0)
+    batched = run_phase(
+        fleet.DEFAULT_MAX_BATCH, fleet.DEFAULT_BATCH_WINDOW_MS / 1000.0
+    )
+    speedup = batched["aggregate_pods_per_sec"] / max(
+        serialized["aggregate_pods_per_sec"], 1e-9
+    )
+    import jax
+
+    backend = jax.default_backend()
+    out = {
+        "tenants": n_tenants,
+        "pods_per_tenant": n_pods,
+        "repeats": repeats,
+        "backend": backend,
+        "serialized": serialized,
+        "batched": batched,
+        "speedup": round(speedup, 2),
+        "speedup_ok": speedup >= 2.0,
+        # the coalescer itself must demonstrably engage regardless of
+        # backend: grants served >1 problem on average under contention
+        "coalesce_ok": batched["mean_batch_size"] >= 1.5,
+        # no-worse bound on the per-tenant tail: coalescing must not buy
+        # throughput by starving someone (small epsilon absorbs timer
+        # noise on near-zero waits)
+        "queue_wait_ok": (
+            batched["wait_p99_max_s"]
+            <= serialized["wait_p99_max_s"] + 0.010
+        ),
+        "mean_batch_size": batched["mean_batch_size"],
+        "padding_ratio": batched["padding_ratio"],
+    }
+    if backend == "cpu":
+        # cfg8_multidev precedent: the amortization target is an
+        # ACCELERATOR property — a vmapped batch on the CPU backend
+        # competes with the sequential kernels for the same cores, so
+        # the >=2x judgment belongs to the TPU bench box; the CPU run
+        # still proves coalescing, fairness shares, and wait behavior
+        out["speedup_note"] = (
+            "cpu backend: batched kernels share the serial cores the"
+            " solo kernels used; >=2x aggregate pods/sec is judged on"
+            " the accelerator bench run"
+        )
+    return out
+
+
 def _multidev_bench(repeats=3) -> dict:
     """cfg8_multidev: the primary config sharded over the local slice
     (DeviceScheduler(devices=all) — the pjit-over-ICI production path,
@@ -1047,7 +1266,15 @@ def main():
         detail["cfg6_ice_storm"] = _ice_storm_bench()
         detail["cfg7_fleet"] = _fleet_bench()
         detail["cfg8_multidev"] = _multidev_bench()
+        detail["cfg10_batch"] = _batch_bench()
         detail["restart"] = _run_restart_probe()
+    else:
+        # tier-1 fast-bench smoke: a tiny cfg10 proves the coalescer +
+        # vmapped batch path end-to-end (serialized-vs-batched schema
+        # included) without the full 32-tenant cost
+        detail["cfg10_batch"] = _batch_bench(
+            n_tenants=4, n_pods=24, n_types=12, repeats=2
+        )
 
     pods_per_sec = primary["pods_per_sec"]
     budget_ok = primary["p50_solve_s"] <= 1.0

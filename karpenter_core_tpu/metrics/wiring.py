@@ -271,3 +271,31 @@ SOLVERD_SCHED_CACHE_BYTES = REGISTRY.gauge(
     "Approximate bytes pinned by cached DeviceSchedulers (encoded-request"
     " size proxy per entry, never exceeds the configured bound)",
 )
+
+# -- continuous cross-tenant solve batching (solver/fleet.py coalescer) ----
+
+SOLVERD_BATCH_SIZE = REGISTRY.histogram(
+    "solverd_batch_size",
+    "Problems per exclusive device grant: 1 = a solo grant, >1 = the"
+    " coalescer dispatched N compatible queued problems as one vmapped"
+    " device batch — the continuous-batching amortization signal",
+)
+SOLVERD_BATCH_COALESCED = REGISTRY.counter(
+    "solverd_batch_coalesced_total",
+    "Problems that rode another problem's device grant instead of waiting"
+    " for their own (batch members beyond the leader) — each one is a"
+    " whole device window the fleet did not serialize",
+)
+SOLVERD_BATCH_WINDOW_WAIT = REGISTRY.histogram(
+    "solverd_batch_window_wait_seconds",
+    "Time the grant leader held the device idle inside the batching"
+    " window waiting for decoding requests to reach the queue — the"
+    " bounded latency cost of coalescing (--batch-window-ms, 0 = off)",
+)
+SOLVERD_BATCH_PADDING = REGISTRY.histogram(
+    "solverd_batch_padding_ratio",
+    "Fraction of the padded problem axis occupied by inert pad rows per"
+    " vmapped dispatch (the batch axis pads to a power of two to bound"
+    " jit-cache growth) — sustained high ratios mean the max batch size"
+    " or the traffic shape wastes device work on padding",
+)

@@ -80,7 +80,9 @@ from karpenter_core_tpu.ops.ffd import (
     FFDStatics,
     SlotState,
     aggregate_takes,
+    aggregate_takes_batched,
     ffd_solve,
+    ffd_solve_batched_donated,
     ffd_solve_donated,
 )
 from karpenter_core_tpu.scheduling import Requirement, Requirements, Taints
@@ -220,6 +222,255 @@ class _Prepared:
     n_classes_padded: int = 8
     _batch: dict = field(default_factory=dict)
     step_class: object = None
+
+
+# ---------------------------------------------------------------------------
+# the kernel-dispatch seam (continuous cross-tenant batching, ISSUE 9)
+#
+# DeviceScheduler.solve runs as a generator that YIELDS one _KernelRequest
+# per device dispatch; a driver answers each request with (final SlotState,
+# takes-by-class, unplaced-by-class). The solo driver (_drive_solo) answers
+# with the donating single-problem kernels — byte-for-byte the old solve
+# path. The batch driver (solve_batch) interleaves N problems' generators,
+# groups their outstanding requests by exact compile shape, and answers
+# whole groups from ONE vmapped dispatch (ops/ffd.ffd_solve_batched) — the
+# scheduler-gateway analogue of continuous batching in LLM serving.
+
+
+@dataclass
+class _KernelRequest:
+    """One device dispatch, reified so a driver outside the generator can
+    answer it — solo, or stacked into a multi-problem vmapped batch."""
+
+    init_state: SlotState
+    steps: ClassStep
+    statics: FFDStatics
+    level_iters: int
+    step_class: object  # [Jp] int32 step -> class index
+    num_classes: int  # Cp, the bucketed class axis (static)
+    devices: int
+    n_slots: int
+
+    def shape_key(self) -> tuple:
+        """Exact compile-shape identity: requests with equal keys ride one
+        vmapped dispatch (and equal-key dispatches at the same padded
+        batch size share one jit entry). Every tensor axis is padded to a
+        power-of-two bucket upstream (_bucket), so cross-tenant collisions
+        are the common case by construction, not luck."""
+        leaves = jax.tree.leaves((self.init_state, self.steps, self.statics))
+        return (
+            tuple((tuple(x.shape), str(x.dtype)) for x in leaves),
+            self.level_iters,
+            self.num_classes,
+            self.devices,
+        )
+
+
+def _run_kernel_solo(req: _KernelRequest):
+    """Answer one request with the donating single-problem kernels. The
+    trailing element is this problem's kernel-dispatch seconds — the
+    driver owns dispatch timing because a timer held open across the
+    generator's yield would charge batch-mates' work to this problem."""
+    t0 = time.perf_counter()
+    state, takes, unplaced = ffd_solve_donated(
+        req.init_state, req.steps, req.statics, level_iters=req.level_iters
+    )
+    takes_bc, unplaced_bc = aggregate_takes(
+        takes, unplaced, req.step_class, num_classes=req.num_classes
+    )
+    return state, takes_bc, unplaced_bc, time.perf_counter() - t0
+
+
+def _drive_solo(gen):
+    """Run one problem's solve generator to completion with direct
+    (donating) kernel dispatches — the single-problem production path."""
+    out = None
+    while True:
+        try:
+            req = gen.send(out)
+        except StopIteration as stop:
+            return stop.value
+        out = _run_kernel_solo(req)
+
+
+def _stack_trees(trees):
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+# batch-axis pad floor: padded batch sizes are powers of two (1, 2, 4, ...)
+# so the jit cache holds at most log2(max_batch) entries per shape bucket
+_BATCH_PAD_LO = 1
+
+
+def _run_kernel_batched(reqs: List[_KernelRequest]):
+    """Answer N equal-shape requests from ONE vmapped device dispatch.
+
+    The problem axis pads to a power of two with copies of the first
+    request's arrays (inert — their outputs are sliced off before anyone
+    reads them), bounding jit-cache growth across arbitrary batch sizes.
+    Returns (per-request (state, takes_bc, unplaced_bc) list, padded B).
+    """
+    head = reqs[0]
+    B = len(reqs)
+    t0 = time.perf_counter()
+    Bp = _bucket(B, lo=_BATCH_PAD_LO)
+    reqs_p = list(reqs) + [head] * (Bp - B)
+    state = _stack_trees([r.init_state for r in reqs_p])
+    steps = _stack_trees([r.steps for r in reqs_p])
+    statics = _stack_trees([r.statics for r in reqs_p])
+    step_class = jnp.stack([r.step_class for r in reqs_p])
+    if head.devices > 1:
+        # re-commit the stacked trees to the slot mesh: problem axis
+        # replicated, slot axis sharded (parallel/mesh batched specs) — a
+        # bare stack of per-problem sharded planes would leave the layout
+        # to XLA's whim per dispatch, breaking the PR 6 SPMD contract
+        mesh = pmesh.slot_mesh(head.devices)
+        repl = pmesh.replicated(mesh)
+        state = jax.device_put(
+            state, pmesh.batched_slot_shardings(mesh, state, head.n_slots)
+        )
+        steps = jax.device_put(
+            steps, pmesh.batched_step_shardings(mesh, steps, head.n_slots)
+        )
+        statics = jax.device_put(statics, jax.tree.map(lambda _: repl, statics))
+        step_class = jax.device_put(step_class, repl)
+    state_b, takes_b, unplaced_b = ffd_solve_batched_donated(
+        state, steps, statics, level_iters=head.level_iters
+    )
+    takes_bc, unplaced_bc = aggregate_takes_batched(
+        takes_b, unplaced_b, step_class, num_classes=head.num_classes
+    )
+    # each member's kernel share is an equal split of the batched
+    # dispatch wall (the vmapped scan does the same work per row)
+    share = (time.perf_counter() - t0) / B
+    outs = [
+        (
+            jax.tree.map(lambda x: x[b], state_b),  # noqa: B023
+            takes_bc[b],
+            unplaced_bc[b],
+            share,
+        )
+        for b in range(B)
+    ]
+    return outs, Bp
+
+
+def solve_batch(entries):
+    """Solve N independent problems under ONE exclusive device window,
+    coalescing compatible kernel dispatches into vmapped batches.
+
+    ``entries``: ``[(scheduler, pods), ...]`` — one DISTINCT
+    DeviceScheduler per problem (a scheduler carries per-solve mutable
+    state and is not reentrant; the fleet gateway guarantees distinct
+    problem fingerprints per batch, which maps to distinct cache entries).
+
+    Every problem runs the identical per-problem pipeline as
+    ``scheduler.solve(pods)`` — same host prepare, same decode, same
+    relaxation loop, same verification — only equal-shape device
+    dispatches are answered together. Problems whose shapes diverge
+    (different buckets, or one needs an overflow-retry round the others
+    don't) simply fall back to solo dispatches inside the same window.
+
+    Failure is per-problem: a member whose dispatch or decode raises gets
+    an ("error", exc) outcome while its batch-mates complete ("ok",
+    Results). A failing VMAPPED dispatch (which cannot attribute blame)
+    is retried solo per member, so the poisoned problem fails alone.
+
+    Returns (outcomes, stats): outcomes aligned with entries; stats counts
+    dispatches, batched problems, and batch-axis padding for the gateway's
+    batch metrics.
+    """
+    if len({id(s) for s, _ in entries}) != len(entries):
+        raise ValueError(
+            "solve_batch requires a distinct DeviceScheduler per problem"
+            " (schedulers are single-solve stateful)"
+        )
+    def _gen_for(scheduler, pods):
+        if hasattr(scheduler, "_solve_gen"):
+            return scheduler._solve_gen(pods)
+
+        # duck-typed scheduler (test fakes, alternate backends): no kernel
+        # seam to interleave, so it runs whole at its batch slot — a
+        # zero-yield generator keeps the driver uniform
+        def _compat():
+            return scheduler.solve(pods)
+            yield  # unreachable; makes _compat a generator
+
+        return _compat()
+
+    gens = []
+    outcomes: List[Optional[tuple]] = [None] * len(entries)
+    pending: Dict[int, _KernelRequest] = {}
+    for i, (scheduler, pods) in enumerate(entries):
+        gen = _gen_for(scheduler, pods)
+        gens.append(gen)
+        try:
+            pending[i] = gen.send(None)
+        except StopIteration as stop:
+            outcomes[i] = ("ok", stop.value)
+        except Exception as e:  # per-problem isolation
+            outcomes[i] = ("error", e)
+    stats = {
+        "problems": len(entries),
+        "dispatches": 0,
+        "batched_dispatches": 0,
+        "batched_problems": 0,
+        "padded_rows": 0,
+        "padded_total_rows": 0,
+    }
+    while pending:
+        groups: Dict[tuple, List[int]] = {}
+        for i in sorted(pending):
+            groups.setdefault(pending[i].shape_key(), []).append(i)
+        answers: Dict[int, tuple] = {}
+        for idxs in groups.values():
+            if len(idxs) == 1:
+                i = idxs[0]
+                stats["dispatches"] += 1
+                try:
+                    answers[i] = ("ok", _run_kernel_solo(pending[i]))
+                except Exception as e:
+                    answers[i] = ("error", e)
+                continue
+            stats["dispatches"] += 1
+            try:
+                outs, padded = _run_kernel_batched(
+                    [pending[i] for i in idxs]
+                )
+            except Exception:
+                # the vmapped dispatch failed as a unit — blame is
+                # unattributable, so re-run each member solo INSIDE the
+                # same device window: the poison fails alone, the rest
+                # still solve
+                for i in idxs:
+                    stats["dispatches"] += 1
+                    try:
+                        answers[i] = ("ok", _run_kernel_solo(pending[i]))
+                    except Exception as e:
+                        answers[i] = ("error", e)
+            else:
+                stats["batched_dispatches"] += 1
+                stats["batched_problems"] += len(idxs)
+                stats["padded_rows"] += padded - len(idxs)
+                stats["padded_total_rows"] += padded
+                for i, out in zip(idxs, outs):
+                    answers[i] = ("ok", out)
+        nxt: Dict[int, _KernelRequest] = {}
+        for i, (status, out) in answers.items():
+            gen = gens[i]
+            try:
+                if status == "ok":
+                    nxt[i] = gen.send(out)
+                else:
+                    # surface the kernel failure INSIDE the generator so
+                    # its cleanup runs and the error lands per-problem
+                    nxt[i] = gen.throw(out)
+            except StopIteration as stop:
+                outcomes[i] = ("ok", stop.value)
+            except Exception as e:
+                outcomes[i] = ("error", e)
+        pending = nxt
+    return outcomes, stats
 
 
 class DeviceScheduler:
@@ -434,7 +685,16 @@ class DeviceScheduler:
         Each relaxation round re-solves the FULL pod set (relaxations mutate
         only previously-failed pods' specs), so placements from earlier rounds
         are never dropped — the same world-re-solve the reference reaches via
-        requeue-on-relax (scheduler.go:251-258)."""
+        requeue-on-relax (scheduler.go:251-258).
+
+        Implemented as a driven generator (_solve_gen): the generator runs
+        every host phase and YIELDS at each kernel dispatch, so the solo
+        path here and the cross-problem batch driver (solve_batch) execute
+        the identical per-problem pipeline — only the kernel runner
+        differs (direct dispatch vs a vmapped multi-problem batch)."""
+        return _drive_solo(self._solve_gen(pods))
+
+    def _solve_gen(self, pods: List[Pod]):
         all_pods = list(pods)
         errors: Dict[str, str] = {}
         claims: List[InFlightNodeClaim] = []
@@ -487,8 +747,19 @@ class DeviceScheduler:
             first_round = False
             stats["rounds"] += 1
             stats["slots"] = max_slots
-            with m.SOLVER_SOLVE_DURATION.time():
-                result = self._solve_once(all_pods, max_slots)
+            # per-round solve duration = this round's OWN phase work
+            # (plan/prepare/kernel/decode deltas), not wall across the
+            # yield — under solve_batch the generator suspends at the
+            # dispatch while batch-mates run, and a wall timer would
+            # charge their work to this problem's histogram
+            r0 = {
+                k: stats[k]
+                for k in ("plan_s", "prepare_s", "kernel_s", "decode_s")
+            }
+            result = yield from self._solve_once_gen(all_pods, max_slots)
+            m.SOLVER_SOLVE_DURATION.observe(
+                sum(stats[k] - r0[k] for k in r0)
+            )
             if result is None:  # slot overflow — retry larger
                 if max_slots >= _SLOT_HARD_CAP:
                     errors = {
@@ -574,9 +845,11 @@ class DeviceScheduler:
 
     # ------------------------------------------------------------------
 
-    def _solve_once(
-        self, pods: List[Pod], max_slots: int
-    ) -> Optional[Tuple[List[InFlightNodeClaim], List[ExistingNodeSim], list]]:
+    def _solve_once_gen(self, pods: List[Pod], max_slots: int):
+        """One solve round as a generator: host prepare, then a single
+        ``yield`` of a _KernelRequest at the device dispatch (the driver
+        sends back (state, takes_bc, unplaced_bc)), then fetch + decode.
+        Returns None on slot overflow (caller retries larger)."""
         if not self.templates and not self.existing_nodes:
             # no viable templates and no existing capacity: everything fails
             return [], [], [(p, "no nodepool matched pod") for p in pods]
@@ -625,33 +898,39 @@ class DeviceScheduler:
         stats["h2d_bytes"] += self._h2d_bytes
         stats["h2d_dev_bytes"] += self._h2d_dev_bytes
 
-        t0 = time.perf_counter()
-        kernel_timer = m.SOLVER_KERNEL_DURATION.time()
-        kernel_timer.__enter__()
-        # the donating twin consumes init_state's buffers in place (HBM
-        # churn); _Prepared rebuilds them per round, so mark them spent
-        state, takes, unplaced = ffd_solve_donated(
-            prep.init_state,
-            steps,
-            prep.statics,
+        # the device dispatch is the generator's yield point: the solo
+        # driver answers with ffd_solve_donated + aggregate_takes, the
+        # batch driver stacks compatible requests and answers from one
+        # vmapped dispatch — the rest of the round is identical. The
+        # donating solo twin consumes init_state's buffers in place (HBM
+        # churn); _Prepared rebuilds them per round, so mark them spent.
+        # The driver reports this problem's kernel-dispatch share (a
+        # timer held open across the yield would bill batch-mates' work
+        # to this problem's histogram); the fetches below are ours.
+        state, takes_bc, unplaced_bc, kernel_share_s = yield _KernelRequest(
+            init_state=prep.init_state,
+            steps=steps,
+            statics=prep.statics,
             level_iters=prep.level_iters,
+            step_class=prep.step_class,
+            num_classes=prep.n_classes_padded,
+            devices=self.devices,
+            n_slots=prep.n_slots,
         )
         prep.init_state = None
-        # fuse the per-step takes down to per-class decision planes on
-        # device, then fetch the tiny head scalars to learn how many slots
-        # the solve actually touched — every remaining plane is sliced to
-        # that bucketed window before the single bulk fetch, so the
-        # device->host transfer scales with nodes PACKED, not max_slots
-        Cp = prep.n_classes_padded
-        takes_bc, unplaced_bc = aggregate_takes(
-            takes, unplaced, prep.step_class, num_classes=Cp
-        )
+        t0 = time.perf_counter()
+        # the per-step takes were fused down to per-class decision planes
+        # on device by the driver; fetch the tiny head scalars to learn how
+        # many slots the solve actually touched — every remaining plane is
+        # sliced to that bucketed window before the single bulk fetch, so
+        # the device->host transfer scales with nodes PACKED, not max_slots
         head = jax.device_get(
             {"overflow": state.overflow, "next_free": state.next_free}
         )
         if bool(head["overflow"]):
-            kernel_timer.__exit__(None, None, None)
-            stats["kernel_s"] += time.perf_counter() - t0
+            kdt = kernel_share_s + (time.perf_counter() - t0)
+            m.SOLVER_KERNEL_DURATION.observe(kdt)
+            stats["kernel_s"] += kdt
             return None
         N = prep.n_slots
         used = max(int(head["next_free"]), len(prep.existing_sims), 1)
@@ -692,8 +971,9 @@ class DeviceScheduler:
                 n = -(-n // self.devices)
             fetched_dev += n
         out = jax.device_get(fetch)
-        kernel_timer.__exit__(None, None, None)
-        stats["kernel_s"] += time.perf_counter() - t0
+        kdt = kernel_share_s + (time.perf_counter() - t0)
+        m.SOLVER_KERNEL_DURATION.observe(kdt)
+        stats["kernel_s"] += kdt
         fetched = sum(np.asarray(v).nbytes for v in out.values()) + 16
         stats["fetch_bytes"] += fetched  # + the head scalars
         stats["fetch_dev_bytes"] += fetched_dev

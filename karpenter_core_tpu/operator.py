@@ -47,6 +47,10 @@ from karpenter_core_tpu.controllers.nodepool.controllers import (
 from karpenter_core_tpu.controllers.provisioning.provisioner import Provisioner
 from karpenter_core_tpu.events import Recorder
 from karpenter_core_tpu.kube.store import KubeStore
+from karpenter_core_tpu.solver.fleet import (
+    DEFAULT_BATCH_WINDOW_MS,
+    DEFAULT_MAX_BATCH,
+)
 from karpenter_core_tpu.state.cluster import Cluster
 from karpenter_core_tpu.utils import pod as podutil
 from karpenter_core_tpu.utils.clock import Clock
@@ -119,6 +123,16 @@ class Options:
     # 429 sheds, and 'tenant=weight,...' fair-share weights
     solver_queue_depth: int = 16
     solver_tenant_weights: str = ""
+    # continuous cross-tenant batching at the spawned sidecar's gateway:
+    # max compatible queued problems one device grant may solve as a
+    # single vmapped batch (1 disables coalescing), and the few-ms window
+    # a grant leader may hold the device for still-decoding requests
+    # (0 = coalesce only what is already queued). The solverd defaults
+    # (solver/fleet.py), single-sourced so operator-spawned and
+    # externally-launched sidecars can never diverge on a default bump;
+    # an external --solver-addr sidecar configures its own.
+    solver_max_batch: int = DEFAULT_MAX_BATCH
+    solver_batch_window_ms: float = DEFAULT_BATCH_WINDOW_MS
     batch_max_duration: float = 10.0
     batch_idle_duration: float = 1.0
     log_level: str = "info"
@@ -169,6 +183,14 @@ class Options:
             "--solver-tenant-weights",
             "KARPENTER_SOLVER_TENANT_WEIGHTS",
             str,
+        ),
+        "solver_max_batch": (
+            "--solver-max-batch", "KARPENTER_SOLVER_MAX_BATCH", int,
+        ),
+        "solver_batch_window_ms": (
+            "--solver-batch-window-ms",
+            "KARPENTER_SOLVER_BATCH_WINDOW_MS",
+            float,
         ),
         "batch_max_duration": (
             "--batch-max-duration", "KARPENTER_BATCH_MAX_DURATION", float,
@@ -245,6 +267,16 @@ class Options:
             raise ValueError(
                 "--solver-watchdog-seconds must be >= 0 (0 disables),"
                 f" got {opts.solver_watchdog_seconds}"
+            )
+        if opts.solver_max_batch < 1:
+            raise ValueError(
+                "--solver-max-batch must be >= 1 (1 disables coalescing),"
+                f" got {opts.solver_max_batch}"
+            )
+        if opts.solver_batch_window_ms < 0:
+            raise ValueError(
+                "--solver-batch-window-ms must be >= 0 (0 = never wait),"
+                f" got {opts.solver_batch_window_ms}"
             )
         # malformed weights must fail at the flag surface, not inside a
         # respawned sidecar's argparse three failures deep
@@ -337,6 +369,9 @@ class Operator:
                     # --solver-addr sidecar configures its own)
                     queue_depth=self.options.solver_queue_depth,
                     tenant_weights=self.options.solver_tenant_weights,
+                    # continuous-batching shape for the child's gateway
+                    max_batch=self.options.solver_max_batch,
+                    batch_window_ms=self.options.solver_batch_window_ms,
                     # only a non-default device count rides the argv, so a
                     # respawned child re-reads the operator's choice
                     devices=(

@@ -701,6 +701,12 @@ def ffd_solve_donated(state: SlotState, classes: ClassStep,
     return _donated_impl(state, classes, statics, level_iters=level_iters)
 
 
+def _aggregate_takes_impl(takes, unplaced, step_class, num_classes: int):
+    tbc = jax.ops.segment_sum(takes, step_class, num_segments=num_classes)
+    ubc = jax.ops.segment_sum(unplaced, step_class, num_segments=num_classes)
+    return tbc, ubc
+
+
 @partial(jax.jit, static_argnames=("num_classes",))
 def aggregate_takes(takes, unplaced, step_class, num_classes: int):
     """Fuse the per-step scan outputs down to per-CLASS decision planes on
@@ -713,6 +719,71 @@ def aggregate_takes(takes, unplaced, step_class, num_classes: int):
     it runs in one fused dispatch and the fetch shrinks to the class axis.
     Pad steps are inert (zero takes/unplaced), so routing them to segment 0
     is harmless."""
-    tbc = jax.ops.segment_sum(takes, step_class, num_segments=num_classes)
-    ubc = jax.ops.segment_sum(unplaced, step_class, num_segments=num_classes)
-    return tbc, ubc
+    return _aggregate_takes_impl(takes, unplaced, step_class, num_classes)
+
+
+# ---------------------------------------------------------------------------
+# the problem batch axis (continuous cross-tenant batching, ISSUE 9)
+#
+# One device dispatch solves B independent problems at once: every leaf of
+# SlotState / ClassStep / FFDStatics gains a leading problem axis and the
+# whole scan runs under vmap. Compatible problems share their bucketed
+# compile shapes by construction (models/provisioner pads every tensor
+# axis to power-of-two buckets), so the gateway's coalescer only has to
+# find problems in the same shape bucket — per-problem class counts pad to
+# the bucket max with inert classes, per-problem slot planes stack. The
+# batch axis REPLICATES over the slot mesh (each device holds every
+# problem's shard of the slot axis — parallel/mesh.batched_slot_shardings)
+# so the vmap composes with the PR 6 pjit-over-slots path unchanged.
+
+
+def _ffd_solve_batched_impl(state: SlotState, classes: ClassStep,
+                            statics: FFDStatics,
+                            level_iters: int = LEVEL_ITERS):
+    return jax.vmap(
+        lambda s, c, st: _ffd_solve_impl(s, c, st, level_iters)
+    )(state, classes, statics)
+
+
+# Batched scan over stacked problems; returns (final states [B, ...],
+# takes [B, J, N], unplaced [B, J]).
+# graftlint: disable=GL103 -- deliberately non-donating: the batched
+# parity tests re-drive the same stacked state, and the production batch
+# driver (models/provisioner.solve_batch) uses the donating twin below
+ffd_solve_batched = partial(jax.jit, static_argnames=("level_iters",))(
+    _ffd_solve_batched_impl
+)
+
+# Donating twin for the production batch path, mirroring ffd_solve_donated:
+# the stacked [B, ...] SlotState is a per-dispatch copy (jnp.stack of the
+# per-problem planes) that can never be reused, so its HBM is donated on a
+# real accelerator. CPU aliases the non-donating entry so the virtual test
+# mesh doesn't warn per compile; the backend probe is lazy (first call),
+# never at import.
+_batched_donated_impl = None
+
+
+def ffd_solve_batched_donated(state: SlotState, classes: ClassStep,
+                              statics: FFDStatics,
+                              level_iters: int = LEVEL_ITERS):
+    global _batched_donated_impl
+    if _batched_donated_impl is None:
+        if jax.default_backend() != "cpu":
+            _batched_donated_impl = partial(
+                jax.jit, static_argnames=("level_iters",), donate_argnums=(0,)
+            )(_ffd_solve_batched_impl)
+        else:
+            _batched_donated_impl = ffd_solve_batched
+    return _batched_donated_impl(state, classes, statics,
+                                 level_iters=level_iters)
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def aggregate_takes_batched(takes, unplaced, step_class, num_classes: int):
+    """aggregate_takes over a leading problem axis: takes [B, J, N],
+    unplaced [B, J], step_class [B, J] (each problem carries its OWN
+    step->class index — water-fill sub-step expansion differs per problem
+    even at equal padded step counts) -> ([B, Cp, N], [B, Cp])."""
+    return jax.vmap(
+        lambda t, u, sc: _aggregate_takes_impl(t, u, sc, num_classes)
+    )(takes, unplaced, step_class)

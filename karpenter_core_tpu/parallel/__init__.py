@@ -14,9 +14,12 @@ This is the PRODUCTION scale axis, not a dry-run helper: a
 jit'd kernels compile SPMD from the argument shardings.
 """
 from karpenter_core_tpu.parallel.mesh import (
+    CLASS_STEP_SPECS,
     SLOT_STATE_SPECS,
     axis_sharding,
     batch_sharding,
+    batched_slot_shardings,
+    batched_step_shardings,
     pad_to_devices,
     replicated,
     resolve_devices,
@@ -25,9 +28,12 @@ from karpenter_core_tpu.parallel.mesh import (
 )
 
 __all__ = [
+    "CLASS_STEP_SPECS",
     "SLOT_STATE_SPECS",
     "axis_sharding",
     "batch_sharding",
+    "batched_slot_shardings",
+    "batched_step_shardings",
     "pad_to_devices",
     "replicated",
     "resolve_devices",

@@ -113,6 +113,59 @@ def batch_sharding(mesh: Mesh, ndim: int, axis: str = "slots") -> NamedSharding:
     return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
 
 
+# The one ClassStep field carrying a slot axis (its unbatched dim index):
+# exist_taint_ok is the scanned [J, N] per-class taint-tolerance plane;
+# every other field is per-class metadata and replicates. Kept here beside
+# SLOT_STATE_SPECS so the batched placement below classifies BOTH scanned
+# pytrees by field name instead of shape guessing.
+CLASS_STEP_SPECS = {"exist_taint_ok": 1}
+
+
+def _batched_specs(mesh: Mesh, tree, table: dict, n_slots: int, axis: str):
+    """Shardings for a problem-batched NamedTuple [B, ...]: the batch axis
+    replicates (each device holds every problem's shard — the vmap then
+    composes with the slot-axis pjit unchanged), slot dims shift +1 past
+    the leading batch axis, everything else replicates. Same refuse-to-
+    guess contract as slot_shardings: an unclassified field raises."""
+    unknown = [f for f in tree._fields if f not in table]
+    if unknown:
+        raise ValueError(
+            f"batched specs: unclassified {type(tree).__name__} field(s)"
+            f" {unknown}; annotate them in parallel.mesh"
+        )
+    specs = {}
+    for f in tree._fields:
+        leaf = getattr(tree, f)
+        dim = table[f]
+        if dim is None:
+            specs[f] = replicated(mesh)
+        else:
+            bdim = dim + 1  # past the leading problem axis
+            if leaf.shape[bdim] != n_slots:
+                raise ValueError(
+                    f"batched specs: {f} has shape {leaf.shape}, expected"
+                    f" dim {bdim} == n_slots ({n_slots})"
+                )
+            specs[f] = axis_sharding(mesh, leaf.ndim, bdim, axis)
+    return type(tree)(**specs)
+
+
+def batched_slot_shardings(mesh: Mesh, state, n_slots: int,
+                           axis: str = "slots"):
+    """Shardings for a problem-batched SlotState ([B, N, ...] leaves):
+    batch axis replicated, slot axis sharded over the mesh — the batched
+    twin of slot_shardings, classified by the same SLOT_STATE_SPECS."""
+    return _batched_specs(mesh, state, SLOT_STATE_SPECS, n_slots, axis)
+
+
+def batched_step_shardings(mesh: Mesh, steps, n_slots: int,
+                           axis: str = "slots"):
+    """Shardings for a problem-batched ClassStep ([B, J, ...] leaves):
+    only exist_taint_ok carries the slot axis (dim 2 once batched)."""
+    table = {f: CLASS_STEP_SPECS.get(f) for f in steps._fields}
+    return _batched_specs(mesh, steps, table, n_slots, axis)
+
+
 def resolve_devices(requested) -> int:
     """Resolve a device-count request against the local platform.
 

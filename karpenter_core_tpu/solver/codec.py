@@ -490,9 +490,46 @@ def problem_fingerprint(header: dict) -> str:
     ).hexdigest()
 
 
+def _pow2_bucket(n: int, lo: int = 8) -> int:
+    """Next power of two >= lo — the same axis-bucketing rule the device
+    planes use (models/provisioner._bucket), duplicated here so the wire
+    layer stays import-light."""
+    return max(lo, 1 << max(n - 1, 1).bit_length())
+
+
+def problem_bucket(header: dict) -> str:
+    """Shape-bucket key for cross-tenant solve coalescing (fleet gateway).
+
+    Two requests in the same bucket are PREDICTED to compile to the same
+    padded kernel shapes, so the gateway may dispatch them as one vmapped
+    multi-problem device batch. Derived from the problem_fingerprint
+    components that drive compile shapes — catalog/nodepool/existing-node/
+    daemonset cardinalities, the slot ceiling, the pod-count bucket, and
+    topology presence — NOT from their content: two tenants with
+    different catalogs of the same shape share a bucket (that is the whole
+    point), while the exact-shape check lives one layer down
+    (models/provisioner.solve_batch groups by real compile shapes and
+    splits any batch the predictor got wrong, so a bucket collision can
+    cost a missed coalesce but never a wrong result)."""
+    import hashlib
+
+    parts = (
+        SOLVE_WIRE_VERSION,
+        _pow2_bucket(len(header.get("it_table", ())), lo=1),
+        len(header.get("nodepools", ())),
+        _pow2_bucket(len(header.get("existing_nodes", ())) + 1, lo=1),
+        _pow2_bucket(len(header.get("daemonset_pods", ())) + 1, lo=1),
+        _pow2_bucket(len(header.get("pods", ())), lo=8),
+        header.get("max_slots", 0),
+        bool(header.get("topology")),
+    )
+    return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
+
+
 def decode_solve_request(data: bytes) -> dict:
     """Inverse of encode_solve_request; returns a kwargs-style dict (plus
-    ``fingerprint``, the problem-half content hash for scheduler reuse)."""
+    ``fingerprint``, the problem-half content hash for scheduler reuse,
+    and ``bucket``, the coalescing shape-bucket key)."""
     from karpenter_core_tpu.kube import serial
 
     h = _json_header(data)
@@ -502,6 +539,7 @@ def decode_solve_request(data: bytes) -> dict:
 
     return {
         "fingerprint": problem_fingerprint(h),
+        "bucket": problem_bucket(h),
         "nodepools": [serial.decode(d) for d in h["nodepools"]],
         "instance_types": _decode_it_table(h["it_table"], h["it_pools"]),
         "existing_nodes": [_decode_sim_node(d) for d in h["existing_nodes"]],

@@ -21,7 +21,17 @@ serving that stays fair under many concurrent tenants):
   the encode/decode of request B overlaps the device phase of request A;
 * ``BoundedSchedulerCache`` — an LRU bound (entries + approximate
   bytes) with eviction metrics on the per-fingerprint DeviceScheduler
-  cache, so a fleet of heterogeneous clusters cannot OOM the sidecar.
+  cache, so a fleet of heterogeneous clusters cannot OOM the sidecar;
+* the continuous-batching coalescer — a granted solve (the batch
+  LEADER) collects up to ``max_batch - 1`` queued problems in the same
+  compile-shape bucket (``collect_batch``; distinct fingerprints, fair
+  vtime scan order) and solves them all under ONE exclusive device grant
+  as a vmapped multi-problem batch (models/provisioner.solve_batch), the
+  scheduler-gateway analogue of continuous batching in LLM serving.
+  ``release_batch`` charges each tenant its pod-weighted share of the
+  grant's device seconds so the WFQ vclock stays honest, and the shed
+  estimator divides the backlog by the observed problems-per-grant so
+  admission doesn't over-shed once batching raises throughput.
 
 The gateway never creates threads: it sequences the caller's own handler
 threads (ThreadingHTTPServer hands every request its own thread) with one
@@ -50,6 +60,13 @@ _LANES = (LANE_SOLVE, LANE_SWEEP)
 DEFAULT_QUEUE_DEPTH = 16
 DEFAULT_CACHE_ENTRIES = 4
 DEFAULT_CACHE_BYTES = 256 << 20
+# continuous-batching defaults FOR THE SOLVERD FLAGS (the FleetGateway
+# constructor itself defaults to max_batch=1/window=0 — batching off — so
+# every pre-batching embedder keeps its exact semantics): one grant may
+# coalesce up to 8 compatible problems, and a leader waits at most a few
+# ms for still-decoding requests to reach the queue
+DEFAULT_MAX_BATCH = 8
+DEFAULT_BATCH_WINDOW_MS = 2.0
 # distinct tenants the gateway keeps state for (vtime, wait samples): the
 # id is client-supplied, so on a long-lived shared sidecar a client that
 # varies it (a template interpolating a run id) must hit a bound, not a
@@ -122,6 +139,13 @@ class Ticket:
     __slots__ = (
         "tenant", "lane", "submitted_at", "deadline_at",
         "ready_at", "granted_at", "event", "state",
+        # continuous batching: the shape-bucket key + problem fingerprint
+        # (set by the daemon after its host-phase decode, BEFORE
+        # await_grant), the decoded payload a batch leader solves on the
+        # member's behalf, and the result handoff (leader publishes,
+        # member's handler thread encodes)
+        "bucket", "fingerprint", "payload", "result", "error", "done",
+        "batched_member",
     )
 
     def __init__(self, tenant: str, lane: str, submitted_at: float,
@@ -133,7 +157,20 @@ class Ticket:
         self.ready_at: Optional[float] = None
         self.granted_at: Optional[float] = None
         self.event = threading.Event()
-        self.state = "pending"  # pending | queued | granted | shed | done
+        # pending | queued | granted | batched | shed | drained | done
+        self.state = "pending"
+        # ONE-WAY marker set by collect_batch: the daemon branches member
+        # vs leader on THIS, not on the mutable `state` — release_batch
+        # overwrites a member's state to "done" while its handler thread
+        # may still be waking, and a member that raced past that overwrite
+        # on a state check would take the leader path without a grant
+        self.batched_member = False
+        self.bucket: Optional[str] = None
+        self.fingerprint: Optional[str] = None
+        self.payload = None
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
 
 
 class FleetGateway:
@@ -166,12 +203,28 @@ class FleetGateway:
         p50_boot: float = DEVICE_P50_BOOT,
         window: int = 64,
         time_fn=time.monotonic,
+        max_batch: int = 1,
+        batch_window: float = 0.0,
     ):
         if max_depth <= 0:
             raise ValueError(f"max_depth must be positive, got {max_depth}")
+        if max_batch <= 0:
+            raise ValueError(f"max_batch must be positive, got {max_batch}")
+        if batch_window < 0:
+            raise ValueError(
+                f"batch_window must be >= 0, got {batch_window}"
+            )
         self.max_depth = max_depth
         self.weights = dict(weights or {})
         self.default_weight = default_weight
+        # continuous batching: a granted solve may collect up to
+        # max_batch-1 compatible queued problems (same shape bucket,
+        # distinct fingerprints) to ride its device grant as one vmapped
+        # batch; batch_window (seconds) bounds how long the leader may
+        # hold the device idle waiting for still-decoding requests to
+        # reach the queue. max_batch=1 is the pre-batching gateway.
+        self.max_batch = max_batch
+        self.batch_window = batch_window
         self.time_fn = time_fn
         # RLock on purpose: the _locked helpers re-acquire it so every
         # shared-state write is syntactically inside a `with self._lock`
@@ -190,6 +243,17 @@ class FleetGateway:
         self._wait_samples: Dict[str, deque] = {}
         self._shed_counts: Dict[str, int] = {}
         self._grant_count = 0
+        # batch accounting: per-grant problem counts (the shed estimator's
+        # amortization factor), members currently riding a leader's grant,
+        # lifetime coalesced-problem count
+        self._batch_sizes: deque = deque(maxlen=window)
+        self._batched_inflight = 0
+        self._coalesced = 0
+        # per-lane count of tickets still in state "pending" (submitted,
+        # host decode running, not yet queued): what the batching window
+        # consults — only a mid-decode SOLVE request can coalesce, so a
+        # leader must not hold the device idle for sweep traffic
+        self._preparing_counts = {lane: 0 for lane in _LANES}
         # drain mode: admission closed, queue flushed with 503s ahead of a
         # clean (supervisor-respawned) process exit
         self._draining = False
@@ -201,10 +265,22 @@ class FleetGateway:
             return self._device_p50_locked()
 
     def _device_p50_locked(self) -> float:
+        """Observed per-GRANT device p50. One observation is recorded per
+        exclusive device grant (release_batch), NOT per request — with
+        batching on, one grant serves several requests, and an estimator
+        that multiplied the backlog by a per-request time would over-shed
+        exactly when batching raises effective throughput."""
         if not self._device_times:
             return self._p50_boot
         ts = sorted(self._device_times)
         return ts[len(ts) // 2]
+
+    def _avg_batch_locked(self) -> float:
+        """Observed mean problems-per-grant (>= 1): the amortization
+        factor the expected-wait model divides the backlog by."""
+        if not self._batch_sizes:
+            return 1.0
+        return max(sum(self._batch_sizes) / len(self._batch_sizes), 1.0)
 
     def submit(
         self,
@@ -224,33 +300,43 @@ class FleetGateway:
                 raise DrainError()
             now = self.time_fn()
             p50 = self._device_p50_locked()
+            batch = self._avg_batch_locked()
             if self._pending >= self.max_depth:
-                # one slot frees roughly every p50 device seconds; the
-                # whole backlog must drain before a retry is admitted
-                retry_after = max(self._pending * p50, p50)
+                # the backlog drains one GRANT (~avg_batch requests) per
+                # ~p50 device seconds; the whole backlog must clear
+                # before a retry is admitted
+                grants_left = -(-self._pending // max(int(batch), 1))
+                retry_after = max(grants_left * p50, p50)
                 self._count_shed_locked(tenant, "capacity")
                 raise ShedError(
                     "capacity", retry_after,
                     f"admission queue full ({self._pending}/{self.max_depth})",
                 )
             if deadline is not None:
-                # everyone already admitted holds the device ~p50 each,
-                # then this request needs its own p50 on device
-                estimate = (self._pending + 1) * p50
+                # expected wait = grants needed to serve everyone ahead
+                # plus this request, at the observed per-grant p50 and the
+                # observed batch amortization (avg problems per grant) —
+                # NOT one grant per pending request, which would over-shed
+                # whenever batching raises effective throughput
+                grants_needed = max(
+                    (self._pending + 1) / batch, 1.0
+                )
+                estimate = grants_needed * p50
                 if deadline < estimate:
                     retry_after = max(estimate - deadline, p50)
                     self._count_shed_locked(tenant, "deadline")
                     raise ShedError(
                         "deadline", retry_after,
                         f"deadline {deadline:.3f}s cannot cover estimated"
-                        f" {estimate:.3f}s (p50 device {p50:.3f}s,"
-                        f" {self._pending} ahead)",
+                        f" {estimate:.3f}s (p50 device/grant {p50:.3f}s,"
+                        f" avg batch {batch:.2f}, {self._pending} ahead)",
                     )
             self._pending += 1
             ticket = Ticket(
                 tenant, lane, now,
                 None if deadline is None else now + deadline,
             )
+            self._preparing_counts[lane] += 1
             self._export_depth_locked()
             return ticket
 
@@ -274,10 +360,12 @@ class FleetGateway:
             if self._draining:
                 ticket.state = "drained"
                 self._pending -= 1
+                self._preparing_counts[ticket.lane] -= 1
                 self._export_depth_locked()
                 raise DrainError()
             ticket.ready_at = self.time_fn()
             ticket.state = "queued"
+            self._preparing_counts[ticket.lane] -= 1
             lanes = self._queued.get(ticket.tenant)
             if lanes is None:
                 lanes = self._queued[ticket.tenant] = {
@@ -332,6 +420,16 @@ class FleetGateway:
                 self._vclock, self._vtime.get(ticket.tenant, 0.0)
             )
             self._grant_count += 1
+            self._record_wait_locked(ticket, now)
+            ticket.event.set()
+
+    def _record_wait_locked(self, ticket: Ticket, now: float) -> None:
+        """Grant-time queue-wait bookkeeping, shared by the dispatcher and
+        the batch coalescer: the per-tenant p99 the shed estimator, bench,
+        and snapshot() read must see EVERY way off the queue identically."""
+        with self._lock:
+            from karpenter_core_tpu.metrics import wiring as m
+
             wait = now - (ticket.ready_at or now)
             m.SOLVERD_QUEUE_WAIT.observe(wait, {"tenant": ticket.tenant})
             samples = self._wait_samples.get(ticket.tenant)
@@ -340,7 +438,6 @@ class FleetGateway:
                     maxlen=512
                 )
             samples.append(wait)
-            ticket.event.set()
 
     def _pick_locked(self) -> Optional[Ticket]:
         """Smallest-virtual-time backlogged tenant; the solve lane drains
@@ -360,19 +457,151 @@ class FleetGateway:
 
     def release(self, ticket: Ticket, device_seconds: float) -> None:
         """Device phase over: record the observation, charge the tenant's
-        virtual time, and grant the next ticket."""
+        virtual time, and grant the next ticket (the single-problem
+        wrapper over release_batch — a solo grant IS a batch of one)."""
+        self.release_batch([(ticket, 1.0)], device_seconds)
+
+    # -- continuous batching (coalesce compatible queued problems) ---------
+
+    def collect_batch(self, leader: Ticket, limit: int = None) -> List[Ticket]:
+        """Pop up to ``limit`` queued solve-lane tickets compatible with
+        the GRANTED leader — same shape bucket, DISTINCT problem
+        fingerprints (a fingerprint maps to one cached DeviceScheduler,
+        which is single-solve stateful) — to ride its device grant as one
+        vmapped multi-problem batch. Their handler threads wake with
+        state="batched" and block in await_batched for the leader's
+        per-problem outcome; expired tickets found on the way shed exactly
+        as the dispatcher would. Tenants are scanned in virtual-time order
+        so coalescing cannot become a side door around fair queueing."""
+        if limit is None:
+            limit = self.max_batch - 1
+        members: List[Ticket] = []
+        if limit <= 0 or leader.bucket is None:
+            return members
         with self._lock:
-            self._device_times.append(max(device_seconds, 0.0))
-            weight = max(
-                self.weights.get(ticket.tenant, self.default_weight), 1e-9
-            )
-            self._vtime[ticket.tenant] = (
-                self._vtime.get(ticket.tenant, 0.0)
-                + max(device_seconds, 0.0) / weight
-            )
-            ticket.state = "done"
+            if self._active is not leader:
+                return members
+            now = self.time_fn()
+            seen = {leader.fingerprint}
+            for tenant in sorted(
+                self._queued, key=lambda t: (self._vtime.get(t, 0.0), t)
+            ):
+                if len(members) >= limit:
+                    break
+                q = self._queued[tenant][LANE_SOLVE]
+                kept: deque = deque()
+                while q and len(members) < limit:
+                    t = q.popleft()
+                    if (
+                        t.bucket is None
+                        or t.bucket != leader.bucket
+                        or t.fingerprint in seen
+                    ):
+                        kept.append(t)
+                        continue
+                    if t.deadline_at is not None and now > t.deadline_at:
+                        t.state = "shed"
+                        self._pending -= 1
+                        self._count_shed_locked(t.tenant, "expired")
+                        t.event.set()
+                        continue
+                    t.batched_member = True
+                    t.state = "batched"
+                    t.granted_at = now
+                    seen.add(t.fingerprint)
+                    self._record_wait_locked(t, now)
+                    members.append(t)
+                    t.event.set()
+                while q:  # preserve FIFO order for everything skipped
+                    kept.append(q.popleft())
+                self._queued[tenant][LANE_SOLVE] = kept
+            self._batched_inflight += len(members)
+            self._export_depth_locked()
+            return members
+
+    def compatible_queued(self, leader: Ticket) -> int:
+        """How many queued solve-lane tickets collect_batch could pop for
+        this leader RIGHT NOW (same shape bucket, distinct fingerprints).
+        The batching window's short-circuit: a leader whose batch is
+        already fillable from the queue must not hold the device idle
+        waiting for more."""
+        if leader.bucket is None:
+            return 0
+        with self._lock:
+            seen = {leader.fingerprint}
+            n = 0
+            for lanes in self._queued.values():
+                for t in lanes[LANE_SOLVE]:
+                    if t.bucket == leader.bucket and t.fingerprint not in seen:
+                        seen.add(t.fingerprint)
+                        n += 1
+            return n
+
+    def preparing(self, lane: str = LANE_SOLVE) -> int:
+        """Tickets in the given lane submitted but not yet queued —
+        requests still in their host decode phase. The batching window
+        only pays off when one of these could reach the queue before the
+        leader dispatches, so the daemon consults this before holding the
+        device idle for the window; it is per-lane because only a
+        mid-decode SOLVE request can ever coalesce onto a solve grant —
+        sweep traffic must not buy device idle."""
+        with self._lock:
+            return self._preparing_counts.get(lane, 0)
+
+    def finish_batched(self, ticket: Ticket, result=None,
+                       error: BaseException = None) -> None:
+        """Leader -> member handoff: publish one member's per-problem
+        outcome and wake its handler thread (which encodes its own
+        response — the host fan-out stays off the device window)."""
+        ticket.result = result
+        ticket.error = error
+        ticket.done.set()
+
+    def await_batched(self, ticket: Ticket):
+        """Member side: block until the batch leader publishes this
+        problem's outcome; re-raise its ISOLATED error (one poisoned
+        batch member fails alone) or return the result."""
+        ticket.done.wait()
+        if ticket.error is not None:
+            raise ticket.error
+        return ticket.result
+
+    def release_batch(
+        self, shares: List[tuple], device_seconds: float
+    ) -> None:
+        """One device grant finished having served ``len(shares)``
+        problems: record ONE per-grant device-time observation (the
+        admission estimator's unit is the grant, not the request), charge
+        each tenant its share of the batch's device seconds (the daemon
+        weights shares by problem pod count), and grant the next ticket.
+
+        ``shares``: ``[(ticket, weight), ...]`` — leader first, then the
+        collected members; weights are normalized here."""
+        from karpenter_core_tpu.metrics import wiring as m
+
+        with self._lock:
+            dt = max(device_seconds, 0.0)
+            self._device_times.append(dt)
+            self._batch_sizes.append(len(shares))
+            m.SOLVERD_BATCH_SIZE.observe(float(len(shares)))
+            if len(shares) > 1:
+                self._coalesced += len(shares) - 1
+                m.SOLVERD_BATCH_COALESCED.inc(by=len(shares) - 1)
+            total = sum(max(s, 0.0) for _, s in shares) or 1.0
+            for ticket, share in shares:
+                weight = max(
+                    self.weights.get(ticket.tenant, self.default_weight),
+                    1e-9,
+                )
+                self._vtime[ticket.tenant] = (
+                    self._vtime.get(ticket.tenant, 0.0)
+                    + dt * (max(share, 0.0) / total) / weight
+                )
+                if ticket.state == "batched":
+                    self._batched_inflight -= 1
+                ticket.state = "done"
+                self._pending -= 1
             self._active = None
-            self._pending -= 1
             self._export_depth_locked()
             self._dispatch_locked()
             self._prune_locked()
@@ -427,7 +656,11 @@ class FleetGateway:
                             pass
             if ticket.state == "granted" and self._active is ticket:
                 self._active = None
-            if ticket.state in ("pending", "queued", "granted"):
+            if ticket.state in ("pending", "queued", "granted", "batched"):
+                if ticket.state == "batched":
+                    self._batched_inflight -= 1
+                if ticket.state == "pending":
+                    self._preparing_counts[ticket.lane] -= 1
                 ticket.state = "done"
                 self._pending -= 1
                 self._export_depth_locked()
@@ -464,6 +697,20 @@ class FleetGateway:
     def draining(self) -> bool:
         with self._lock:
             return self._draining
+
+    def batch_stats(self) -> dict:
+        """Lightweight batch telemetry for /healthz (snapshot() computes
+        percentiles — too heavy for a probe path)."""
+        with self._lock:
+            return {
+                "max_batch": self.max_batch,
+                "window_s": self.batch_window,
+                "coalesced": self._coalesced,
+                "mean_size": round(self._avg_batch_locked(), 3),
+                # members riding a leader's grant RIGHT NOW — nonzero
+                # while a coalesced batch is on the device
+                "inflight_members": self._batched_inflight,
+            }
 
     # -- observability -----------------------------------------------------
 
@@ -506,11 +753,19 @@ class FleetGateway:
                 "grants": self._grant_count,
                 "depth": self._pending,
                 "device_p50_s": round(self._device_p50_locked(), 6),
+                "batch": {
+                    "max_batch": self.max_batch,
+                    "window_s": self.batch_window,
+                    "coalesced": self._coalesced,
+                    "mean_size": round(self._avg_batch_locked(), 3),
+                },
             }
             if reset:
                 self._wait_samples = {}
                 self._shed_counts = {}
                 self._grant_count = 0
+                self._batch_sizes.clear()
+                self._coalesced = 0
             return out
 
 

@@ -44,6 +44,15 @@ fair queueing with provisioning prioritized over consolidation sweeps, and
 only the device phase of a request is exclusive — request B's codec
 decode/encode overlaps request A's device time.
 
+With continuous batching on (``--max-batch`` > 1), a granted solve also
+COALESCES: it collects compatible queued problems (same compile-shape
+bucket via ``codec.problem_bucket``, distinct fingerprints) and solves
+them all in one vmapped multi-problem device dispatch
+(models/provisioner.solve_batch) under its single grant — many small
+tenant solves amortize one device window instead of serializing, while
+each problem's decode/verify/encode stays per-request on its own handler
+thread and a poisoned batch member fails alone.
+
 Responses carry ``X-Solver-Seconds`` (device solve wall time) so the client
 can split its RPC histogram into transit vs kernel. Boot enables the
 persistent XLA compile cache and optionally pre-warms the common class-count
@@ -307,9 +316,18 @@ class SolverDaemon:
 
         ``tenant`` is the transport-level identity (the X-Solver-Tenant
         header) and wins when present; a direct-drive caller that passes
-        none is accounted to the tenant on the wire."""
+        none is accounted to the tenant on the wire.
+
+        With batching enabled (gateway max_batch > 1), a granted request
+        becomes the batch LEADER: it collects compatible queued problems
+        (same shape bucket, distinct fingerprints) and solves them all
+        under its one device grant as a vmapped multi-problem batch
+        (models/provisioner.solve_batch). Collected members wake with
+        state="batched", wait for their ISOLATED per-problem outcome, and
+        encode their own responses on their own handler threads — so the
+        per-problem decode/verify/encode fan-out stays in the host phases
+        and one corrupt or poisoned problem in a batch fails alone."""
         from karpenter_core_tpu.metrics import wiring as m
-        from karpenter_core_tpu.models.provisioner import DeviceScheduler
 
         # the poison key is the request-body digest (canonical wire bytes,
         # PR 4), computed pre-decode: the decode itself may be the crash
@@ -326,96 +344,261 @@ class SolverDaemon:
             problem = self._decode_solve(body)
             if tenant is None:
                 ticket.tenant = problem["tenant"]
+            # the coalescer's compatibility key: the decoded problem's
+            # compile-shape bucket (codec.problem_bucket) scoped to this
+            # daemon's device count; the fingerprint keeps two requests
+            # for the SAME problem off one grant (a cached DeviceScheduler
+            # is single-solve stateful)
+            ticket.bucket = f"{problem['bucket']}|d{self.devices}"
+            ticket.fingerprint = problem["fingerprint"]
+            ticket.payload = (body, problem, digest)
         except BaseException:
             self.gateway.abandon(ticket)
             raise
         self.gateway.await_grant(ticket)  # may raise Shed/DrainError
+        if ticket.batched_member:
+            # a leader collected this request onto its grant (the one-way
+            # marker, NOT the mutable state — release_batch may have
+            # already flipped state to "done" before this thread woke,
+            # and racing past that onto the leader path would run a solve
+            # without holding the grant): wait for the per-problem
+            # outcome (an isolated failure re-raises here and answers
+            # alone), then encode on THIS handler thread — host fan-out,
+            # the device is already on to the next grant
+            results, dt = self.gateway.await_batched(ticket)
+            self.quarantine.clear(digest)
+            m.SOLVERD_TENANT_SOLVES.inc(
+                {"tenant": ticket.tenant, "endpoint": "solve"}
+            )
+            return codec.encode_solve_results(results, dt), dt
+        return self._solve_as_leader(ticket)
+
+    def _scheduler_for(self, problem: dict, approx_bytes: int):
+        """Fingerprint-keyed DeviceScheduler acquisition (cache hit or
+        construction) — per problem, inside the device window, exactly as
+        the pre-batching path charged it."""
+        from karpenter_core_tpu.metrics import wiring as m
+        from karpenter_core_tpu.models.provisioner import DeviceScheduler
+
+        scheduler = self._sched_cache.get(problem["fingerprint"])
+        if scheduler is None:
+            m.SOLVERD_SCHED_CACHE.inc({"outcome": "miss"})
+            scheduler = DeviceScheduler(
+                problem["nodepools"],
+                problem["instance_types"],
+                existing_nodes=problem["existing_nodes"],
+                daemonset_pods=problem["daemonset_pods"],
+                max_slots=problem["max_slots"],
+                topology=problem["topology"],
+                unavailable_offerings=problem["unavailable_offerings"],
+                devices=self.devices,
+                # the CLIENT verifies (solver/remote.py): it must not
+                # trust the wire anyway, so a sidecar-side check would
+                # double the overhead yet still miss wire corruption —
+                # and a silent in-sidecar greedy degrade would hide
+                # the rejection signal from the fleet's operators
+                verify=False,
+            )
+            # the encoded request size is the entry's weight proxy: it
+            # tracks catalog/node scale without walking device buffers
+            self._sched_cache.put(
+                problem["fingerprint"], scheduler, approx_bytes
+            )
+        else:
+            m.SOLVERD_SCHED_CACHE.inc({"outcome": "hit"})
+            # the fingerprint ignores the pod-derived excluded-uid
+            # list; hand the cached scheduler this request's live
+            # topology context so exclusions are never stale
+            scheduler.update_topology_context(problem["topology"])
+        return scheduler
+
+    def _solve_as_leader(self, ticket):
+        """The granted request's device phase: optionally wait the batch
+        window, collect compatible queued problems, solve the whole batch
+        under this one grant, distribute per-problem outcomes, encode our
+        own. A batch of one is byte-for-byte the pre-batching solo path
+        (solve_batch drives the same per-problem pipeline with the same
+        donating kernels)."""
+        from karpenter_core_tpu.metrics import wiring as m
+        from karpenter_core_tpu.models import provisioner as prov
+
         # chaos draws AFTER the grant: a request that admission refused
         # (shed/drain/quarantine) must not consume a scripted fault it
-        # will never execute — a consumed entry always fires
+        # will never execute — a consumed entry always fires. The fault
+        # targets the LEADER's problem only, so the chaos tests exercise
+        # the batch-isolation contract end-to-end.
         fault = self.chaos.next_fault() if self.chaos is not None else "ok"
-        dt = 0.0
         grant_t0 = time.perf_counter()
-        self.quarantine.begin(digest)  # crash-only journal breadcrumb
-        if self.watchdog is not None:
-            self.watchdog.arm(f"solve tenant={ticket.tenant}")
+        members = []
+        if self.gateway.max_batch > 1:
+            window = self.gateway.batch_window
+            limit = self.gateway.max_batch - 1
+            if (
+                window > 0
+                and self.gateway.preparing() > 0
+                and self.gateway.compatible_queued(ticket) < limit
+            ):
+                # solve requests are mid-decode on their handler threads
+                # AND the batch is not already fillable from the queue:
+                # hold the grant for the (few-ms, bounded) window so they
+                # can reach the queue and coalesce instead of
+                # serializing — waking EARLY the moment the decodes land
+                # or the batch fills, so the window is a ceiling on
+                # device idle, not a tax every grant pays in full
+                w0 = time.perf_counter()
+                deadline = w0 + window
+                while True:
+                    left = deadline - time.perf_counter()
+                    if left <= 0:
+                        break
+                    time.sleep(min(left, window / 8))
+                    if (
+                        self.gateway.preparing() == 0
+                        or self.gateway.compatible_queued(ticket) >= limit
+                    ):
+                        break
+                m.SOLVERD_BATCH_WINDOW_WAIT.observe(
+                    time.perf_counter() - w0
+                )
+            members = self.gateway.collect_batch(ticket)
+        batch = [ticket] + members
+        digests = [t.payload[2] for t in batch]
+        outcomes = [None] * len(batch)
+        solve_wall = 0.0
+        # pod-weighted fairness shares: a tenant whose problem brings 10x
+        # the pods pays 10x the share of this grant's device seconds
+        weights = [max(len(t.payload[1]["pods"]), 1) for t in batch]
+        total_w = float(sum(weights))
         try:
-            # device phase: the only exclusive section
-            scheduler = self._sched_cache.get(problem["fingerprint"])
-            if scheduler is None:
-                m.SOLVERD_SCHED_CACHE.inc({"outcome": "miss"})
-                scheduler = DeviceScheduler(
-                    problem["nodepools"],
-                    problem["instance_types"],
-                    existing_nodes=problem["existing_nodes"],
-                    daemonset_pods=problem["daemonset_pods"],
-                    max_slots=problem["max_slots"],
-                    topology=problem["topology"],
-                    unavailable_offerings=problem["unavailable_offerings"],
-                    devices=self.devices,
-                    # the CLIENT verifies (solver/remote.py): it must not
-                    # trust the wire anyway, so a sidecar-side check would
-                    # double the overhead yet still miss wire corruption —
-                    # and a silent in-sidecar greedy degrade would hide
-                    # the rejection signal from the fleet's operators
-                    verify=False,
+            try:
+                # journal breadcrumbs + watchdog INSIDE the try: begin()
+                # does file I/O per digest, and a raise here with members
+                # already collected but release never reached would wedge
+                # the gateway (_active stuck) and hang every member's
+                # done.wait() forever; in here, the finallys below
+                # guarantee release_batch and the member drain sweep
+                # (done()/disarm() are no-ops for digests never begun)
+                for d in digests:
+                    self.quarantine.begin(d)
+                if self.watchdog is not None:
+                    self.watchdog.arm(
+                        f"solve tenant={ticket.tenant} batch={len(batch)}"
+                    )
+                if fault.startswith("wedge"):
+                    self.chaos.wedge(fault)  # holds the grant; watchdog trips
+                entries, entry_idx = [], []
+                for i, t in enumerate(batch):
+                    if i == 0 and fault == "crash":
+                        # device-phase raise -> poison strike, leader only
+                        try:
+                            self.chaos.crash()
+                        except Exception as e:
+                            outcomes[i] = ("error", e)
+                            continue
+                    body_i, problem_i, _d = t.payload
+                    try:
+                        scheduler = self._scheduler_for(
+                            problem_i, len(body_i)
+                        )
+                    except Exception as e:
+                        outcomes[i] = ("error", e)
+                        continue
+                    entries.append((scheduler, problem_i["pods"]))
+                    entry_idx.append(i)
+                if entries:
+                    t0 = time.perf_counter()
+                    with self._maybe_profile():
+                        solved, bstats = prov.solve_batch(entries)
+                    solve_wall = time.perf_counter() - t0
+                    for i, outcome in zip(entry_idx, solved):
+                        outcomes[i] = outcome
+                    if bstats["padded_total_rows"]:
+                        m.SOLVERD_BATCH_PADDING.observe(
+                            bstats["padded_rows"]
+                            / bstats["padded_total_rows"]
+                        )
+                # count COMPLETED solves only (the pre-batching counter's
+                # meaning — an errored problem never counted); handler
+                # threads run concurrently, so a bare += would race
+                ok_count = sum(
+                    1 for o in outcomes if o is not None and o[0] == "ok"
                 )
-                # the encoded request size is the entry's weight proxy: it
-                # tracks catalog/node scale without walking device buffers
-                self._sched_cache.put(
-                    problem["fingerprint"], scheduler, len(body)
+                with self._state_lock:
+                    self.solves += ok_count
+            finally:
+                if self.watchdog is not None:
+                    self.watchdog.disarm()
+                # charge the FULL exclusive occupancy — window wait,
+                # cache-miss scheduler construction, and the elapsed time
+                # even when a solve raised: fairness and the admission
+                # per-grant p50 must see what the device actually lost.
+                # Each tenant pays its pod-weighted share of the grant; a
+                # solo grant goes through the release() seam unchanged
+                # (it IS a batch of one, and tests instrument that seam).
+                occupancy = time.perf_counter() - grant_t0
+                if len(batch) == 1:
+                    self.gateway.release(ticket, occupancy)
+                else:
+                    self.gateway.release_batch(
+                        [
+                            (t, w / total_w)
+                            for t, w in zip(batch, weights)
+                        ],
+                        occupancy,
+                    )
+                # journal bookkeeping AFTER release: done() rewrites the
+                # journal file, and file I/O must never ride the
+                # exclusive device window
+                for d in digests:
+                    self.quarantine.done(d)
+            # per-problem epilogue (host phase): strikes for isolated
+            # device failures, success bookkeeping, member handoff — the
+            # member threads do their own encodes
+            for i, t in enumerate(batch):
+                st, val = outcomes[i] or (
+                    "error", RuntimeError("batch solve aborted"),
                 )
-            else:
-                m.SOLVERD_SCHED_CACHE.inc({"outcome": "hit"})
-                # the fingerprint ignores the pod-derived excluded-uid
-                # list; hand the cached scheduler this request's live
-                # topology context so exclusions are never stale
-                scheduler.update_topology_context(problem["topology"])
-            if fault.startswith("wedge"):
-                self.chaos.wedge(fault)  # holds the grant; watchdog trips
-            elif fault == "crash":
-                self.chaos.crash()  # device-phase raise -> poison strike
-            t0 = time.perf_counter()
-            with self._maybe_profile():
-                results = scheduler.solve(problem["pods"])
-            dt = time.perf_counter() - t0
-            # handler threads run concurrently; a bare += is a lost update
-            with self._state_lock:
-                self.solves += 1
-        except BaseException:
-            # a device-phase exception is a poison strike: N of them
-            # inside the TTL and this digest is refused fleet-wide
-            self.quarantine.strike(digest, "crash")
-            raise
+                # per-problem device share of the batch wall, so every
+                # response's X-Solver-Seconds sums to the real device time
+                dt_i = solve_wall * weights[i] / total_w
+                if st == "error":
+                    # a device-phase failure is a poison strike against
+                    # THAT problem's digest only — batch-mates unaffected
+                    self.quarantine.strike(t.payload[2], "crash")
+                    if i > 0:
+                        self.gateway.finish_batched(t, error=val)
+                elif i > 0:
+                    self.gateway.finish_batched(t, result=(val, dt_i))
+            st, val = outcomes[0] or (
+                "error", RuntimeError("batch solve aborted"),
+            )
+            if st == "error":
+                raise val
+            results = val
+            leader_dt = solve_wall * weights[0] / total_w
+            self.quarantine.clear(ticket.payload[2])
+            m.SOLVERD_TENANT_SOLVES.inc(
+                {"tenant": ticket.tenant, "endpoint": "solve"}
+            )
+            # host phase again: encode outside the grant, the next
+            # tenant's device phase is already running
+            if fault == "bad_result":
+                self.chaos.sabotage(results)  # verification-failing result
+            out = codec.encode_solve_results(results, leader_dt)
+            if fault == "corrupt_wire":
+                out = self.chaos.corrupt(out)
+            return out, leader_dt
         finally:
-            if self.watchdog is not None:
-                self.watchdog.disarm()
-            # charge the FULL exclusive occupancy — cache-miss scheduler
-            # construction/prepare included, and the elapsed time even
-            # when the solve raised. Fairness and the admission p50 must
-            # see what the device actually lost; charging only the kernel
-            # would let cache-churning tenants under-pay and a raising
-            # solve would drag the p50 estimator toward zero. The kernel
-            # time alone (dt) still rides X-Solver-Seconds so the client's
-            # transit/kernel histogram split stays honest.
-            self.gateway.release(ticket, time.perf_counter() - grant_t0)
-            # journal bookkeeping AFTER release: done() rewrites the
-            # journal file, and file I/O must never ride the exclusive
-            # device window (the digest only needs to stay journaled
-            # until the device phase ends — this IS that moment)
-            self.quarantine.done(digest)
-        self.quarantine.clear(digest)
-        m.SOLVERD_TENANT_SOLVES.inc(
-            {"tenant": ticket.tenant, "endpoint": "solve"}
-        )
-        # host phase again: encode outside the grant, the next tenant's
-        # device phase is already running
-        if fault == "bad_result":
-            self.chaos.sabotage(results)  # verification-failing result
-        out = codec.encode_solve_results(results, dt)
-        if fault == "corrupt_wire":
-            out = self.chaos.corrupt(out)
-        return out, dt
+            # no member handler may wait forever: whatever path got here
+            # (watchdog drain, an unexpected raise above), any member not
+            # yet answered gets the drain contract (503 — the client
+            # degrades to greedy WITHOUT charging its breaker; the member
+            # request did not fail on its own problem)
+            for t in batch[1:]:
+                if not t.done.is_set():
+                    self.gateway.finish_batched(
+                        t, error=fleet.DrainError("batch leader aborted")
+                    )
 
     def _decode_solve(self, body: bytes) -> dict:
         """The solve request's host-phase decode — a named seam so chaos
@@ -537,6 +720,10 @@ class SolverDaemon:
             "watchdog_trips": (
                 self.watchdog.trips if self.watchdog is not None else 0
             ),
+            # continuous-batching stats: how much device serialization the
+            # coalescer is currently buying back (mean problems per grant,
+            # lifetime coalesced count, the configured window/size bounds)
+            "batch": self.gateway.batch_stats(),
         }
 
     # -- boot warm-up ------------------------------------------------------
@@ -746,6 +933,20 @@ def main() -> int:
         " (encoded-request-size proxy per entry)",
     )
     ap.add_argument(
+        "--max-batch", type=int, default=fleet.DEFAULT_MAX_BATCH,
+        help="continuous batching: max compatible queued problems one"
+        " device grant may solve as a single vmapped batch (1 disables"
+        " coalescing — every problem gets its own exclusive grant)",
+    )
+    ap.add_argument(
+        "--batch-window-ms", type=float,
+        default=fleet.DEFAULT_BATCH_WINDOW_MS,
+        help="continuous batching: max milliseconds a grant leader holds"
+        " the device waiting for still-decoding requests to reach the"
+        " queue (bounds the latency cost of coalescing; 0 = never wait,"
+        " coalesce only what is already queued)",
+    )
+    ap.add_argument(
         "--devices", type=int, default=1,
         help="shard every solve/sweep over the first N local devices"
         " (pjit over the slot axis; 0 = all local devices, 1 ="
@@ -780,12 +981,18 @@ def main() -> int:
         ap.error("--devices must be >= 0 (0 = all local devices)")
     if args.watchdog_seconds < 0:
         ap.error("--watchdog-seconds must be >= 0 (0 disables)")
+    if args.max_batch < 1:
+        ap.error("--max-batch must be >= 1 (1 disables coalescing)")
+    if args.batch_window_ms < 0:
+        ap.error("--batch-window-ms must be >= 0 (0 = never wait)")
 
     daemon = SolverDaemon(
         profile_dir=args.profile_dir,
         gateway=fleet.FleetGateway(
             max_depth=args.queue_depth,
             weights=fleet.parse_tenant_weights(args.tenant_weights),
+            max_batch=args.max_batch,
+            batch_window=args.batch_window_ms / 1000.0,
         ),
         sched_cache=fleet.BoundedSchedulerCache(
             max_entries=args.cache_entries,

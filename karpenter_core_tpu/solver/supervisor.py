@@ -51,6 +51,8 @@ def default_command(
     tenant_weights: str = "",
     cache_entries: Optional[int] = None,
     cache_mib: Optional[int] = None,
+    max_batch: Optional[int] = None,
+    batch_window_ms: Optional[float] = None,
     devices: Optional[int] = None,
     watchdog_seconds: Optional[float] = None,
     quarantine_journal: Optional[str] = None,
@@ -79,6 +81,13 @@ def default_command(
         cmd.extend(["--cache-entries", str(cache_entries)])
     if cache_mib is not None:
         cmd.extend(["--cache-mib", str(cache_mib)])
+    # continuous-batching shape for the child's gateway (solverd
+    # --max-batch / --batch-window-ms): rides the argv so a respawned
+    # sidecar keeps the operator's coalescing policy
+    if max_batch is not None:
+        cmd.extend(["--max-batch", str(max_batch)])
+    if batch_window_ms is not None:
+        cmd.extend(["--batch-window-ms", str(batch_window_ms)])
     # the child owns the chips: the operator's --solver-devices rides the
     # spawn command so a respawned sidecar re-shards over the same slice
     if devices is not None:
@@ -104,6 +113,8 @@ class SolverSupervisor:
         tenant_weights: str = "",
         cache_entries: Optional[int] = None,
         cache_mib: Optional[int] = None,
+        max_batch: Optional[int] = None,
+        batch_window_ms: Optional[float] = None,
         devices: Optional[int] = None,
         watchdog_seconds: Optional[float] = None,
         quarantine_journal: Optional[str] = None,
@@ -120,6 +131,8 @@ class SolverSupervisor:
             tenant_weights=tenant_weights,
             cache_entries=cache_entries,
             cache_mib=cache_mib,
+            max_batch=max_batch,
+            batch_window_ms=batch_window_ms,
             devices=devices,
             watchdog_seconds=watchdog_seconds,
             quarantine_journal=quarantine_journal,

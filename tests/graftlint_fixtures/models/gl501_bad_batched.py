@@ -1,0 +1,24 @@
+# GL501 bad (batched entry): a DeviceScheduler-shaped batch driver builds
+# a problem-stacked [B, ...] SlotState straight from host numpy and hands
+# it to the batched SlotState jit entry — nothing in its dataflow ever
+# routed through parallel.mesh placement (batched_slot_shardings /
+# batched_step_shardings or an explicit device_put sharding), so on a
+# multi-device mesh the vmapped solve compiles against absent shardings
+# and the batch axis silently stops composing with the slot-axis pjit.
+# Lint corpus only — never imported.
+import numpy as np
+
+from karpenter_core_tpu.ops.ffd import SlotState, ffd_solve_batched
+
+
+class DeviceScheduler:
+    def _make_stacked_state(self, n_problems, n_slots, k, v):
+        # every plane is host numpy: provenance {host}, never placed
+        return SlotState(
+            valmask=np.ones((n_problems, n_slots, k, v), dtype=bool),
+            kind=np.zeros((n_problems, n_slots), dtype=np.int8),
+        )
+
+    def solve_batch(self, steps, statics, n_slots, k, v, n_problems):
+        state = self._make_stacked_state(n_problems, n_slots, k, v)
+        return ffd_solve_batched(state, steps, statics)  # GL501

@@ -1,0 +1,35 @@
+# GL501 good (batched entry): the sanctioned routing for the
+# continuous-batching driver — the stacked [B, ...] SlotState is
+# re-committed to the slot mesh through parallel.mesh's batched specs
+# (batch axis replicated, slot axis sharded) before it reaches the
+# batched SlotState jit entry, so the vmapped solve composes with the
+# slot-axis pjit path by construction. Lint corpus only — never imported.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from karpenter_core_tpu.ops.ffd import SlotState, ffd_solve_batched
+from karpenter_core_tpu.parallel import mesh as pmesh
+
+
+class DeviceScheduler:
+    def __init__(self, mesh, n_slots):
+        self._mesh = mesh
+        self._n_slots = n_slots
+
+    def _make_init_state(self, n_slots, k, v):
+        return SlotState(
+            valmask=np.ones((n_slots, k, v), dtype=bool),
+            kind=np.zeros((n_slots,), dtype=np.int8),
+        )
+
+    def solve_batch(self, steps, statics, n_slots, k, v, n_problems):
+        trees = [
+            self._make_init_state(n_slots, k, v) for _ in range(n_problems)
+        ]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+        state = jax.device_put(
+            stacked,
+            pmesh.batched_slot_shardings(self._mesh, stacked, self._n_slots),
+        )
+        return ffd_solve_batched(state, steps, statics)

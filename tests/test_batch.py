@@ -1,0 +1,635 @@
+"""Continuous cross-tenant solve batching (ISSUE 9).
+
+Five layers of proof:
+
+* batched-vs-solo parity battery: every problem in a mixed batch yields
+  BYTE-IDENTICAL result wire vs solving it alone — on the single-device
+  path and on the conftest-forced 8-device virtual mesh (the batch axis
+  replicates over the slot mesh, so vmap must compose with the PR 6
+  pjit-over-slots path without perturbing a single placement);
+* per-problem isolation: a poisoned batch member fails alone (solve_batch
+  outcome isolation, and end-to-end through the daemon where the chaos
+  crash strikes only the leader's digest while its batch-mates succeed);
+* gateway coalescer units (fake clock): bucket/fingerprint matching, fair
+  scan order, expired-member shedding, pod-weighted fairness shares via
+  release_batch, batch stats;
+* the shed-estimator regression (ISSUE 9 satellite): admission divides
+  the backlog by the observed problems-per-GRANT, so a gateway that
+  batches 4-deep admits deadlines the per-request model would shed;
+* jit-cache bounds: a soak of randomly-sized problems through solve_batch
+  compiles a bounded set of batched kernels (power-of-two batch pad x
+  bucketed tensor shapes), asserted via jax.monitoring.
+"""
+from __future__ import annotations
+
+import copy
+import threading
+import time
+
+import jax
+import pytest
+
+from tests.helpers import make_nodepool, make_pod
+
+from karpenter_core_tpu.cloudprovider.fake import fake_instance_types
+from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+from karpenter_core_tpu.metrics import wiring as m
+from karpenter_core_tpu.models.provisioner import (
+    DeviceScheduler,
+    solve_batch,
+)
+from karpenter_core_tpu.solver import codec, fleet, service
+from karpenter_core_tpu.solver.fleet import FleetGateway
+
+
+def _catalog():
+    return build_catalog()[:16]
+
+
+def _problem(name, n_pods, cpu_step=0.25, spread=False):
+    """One tenant's problem: a distinct pool name (distinct fingerprint)
+    over a same-shaped catalog — the fleet traffic shape batching
+    targets."""
+    pool = make_nodepool(name=name)
+    pods = []
+    for i in range(n_pods):
+        if spread and i % 3 == 0:
+            pods.append(
+                make_pod(cpu=cpu_step, name=f"{name}-{i}",
+                         spread_hostname=True, labels={"app": name})
+            )
+        else:
+            pods.append(
+                make_pod(cpu=cpu_step * (1 + i % 4),
+                         memory_gib=0.5 * (1 + i % 3),
+                         name=f"{name}-{i}")
+            )
+    return pool, pods
+
+
+def _scheduler(pool, name, devices=1, max_slots=64):
+    return DeviceScheduler(
+        [pool], {name: list(_catalog())}, max_slots=max_slots,
+        devices=devices,
+    )
+
+
+def _wire(results):
+    # solve_seconds is timing, not packing: pin it so wire comparison is
+    # exact over the decision content
+    return codec.encode_solve_results(results, 0.0)
+
+
+class TestBatchedSolveParity:
+    def test_mixed_batch_byte_identical_wire(self):
+        """Three distinct problems coalesced into one vmapped dispatch
+        produce, per problem, the byte-identical result wire of a solo
+        solve."""
+        specs = [("pa", 20, 0.25), ("pb", 24, 0.3), ("pc", 20, 0.2)]
+        probs = {n: _problem(n, k, c) for n, k, c in specs}
+        solo = {}
+        for n, _k, _c in specs:
+            pool, pods = probs[n]
+            res = _scheduler(pool, n).solve(copy.deepcopy(pods))
+            assert res.all_pods_scheduled(), res.pod_errors
+            solo[n] = _wire(res)
+
+        entries = [
+            (_scheduler(probs[n][0], n), copy.deepcopy(probs[n][1]))
+            for n, _k, _c in specs
+        ]
+        outcomes, stats = solve_batch(entries)
+        # all three shared ONE vmapped dispatch (equal shape buckets)
+        assert stats["batched_dispatches"] == 1
+        assert stats["batched_problems"] == 3
+        assert stats["padded_rows"] == 1  # 3 -> padded 4
+        for (n, _k, _c), (status, res) in zip(specs, outcomes):
+            assert status == "ok", res
+            assert res.all_pods_scheduled(), res.pod_errors
+            assert _wire(res) == solo[n]
+
+    def test_topology_member_and_shape_split(self):
+        """A topology-spread problem batches with a plain one only when
+        shapes agree; when they diverge the driver splits into solo
+        dispatches — either way every member's wire matches its solo
+        twin."""
+        pool_t, pods_t = _problem("pt", 18, spread=True)
+        pool_p, pods_p = _problem("pp", 18)
+        solo_t = _wire(_scheduler(pool_t, "pt").solve(copy.deepcopy(pods_t)))
+        solo_p = _wire(_scheduler(pool_p, "pp").solve(copy.deepcopy(pods_p)))
+        outcomes, stats = solve_batch([
+            (_scheduler(pool_t, "pt"), copy.deepcopy(pods_t)),
+            (_scheduler(pool_p, "pp"), copy.deepcopy(pods_p)),
+        ])
+        assert [s for s, _ in outcomes] == ["ok", "ok"]
+        assert _wire(outcomes[0][1]) == solo_t
+        assert _wire(outcomes[1][1]) == solo_p
+        # every dispatch was answered, batched or split
+        assert stats["dispatches"] >= 1
+
+    def test_sharded_mesh_batch_vs_single_device(self):
+        """The batched path on the forced 8-device virtual mesh (batch
+        axis replicated, slot axis sharded) reproduces the single-device
+        solo wire byte-for-byte."""
+        specs = [("sa", 22), ("sb", 26), ("sc", 22)]
+        probs = {n: _problem(n, k) for n, k in specs}
+        solo = {
+            n: _wire(_scheduler(probs[n][0], n).solve(
+                copy.deepcopy(probs[n][1])
+            ))
+            for n, _k in specs
+        }
+        entries = [
+            (
+                _scheduler(probs[n][0], n, devices=8),
+                copy.deepcopy(probs[n][1]),
+            )
+            for n, _k in specs
+        ]
+        outcomes, stats = solve_batch(entries)
+        assert stats["batched_problems"] == 3
+        for (n, _k), (status, res) in zip(specs, outcomes):
+            assert status == "ok", res
+            assert _wire(res) == solo[n]
+
+    def test_batch_of_one_matches_solo(self):
+        """solve_batch([single]) IS the solo path (same generator, same
+        donating kernels) — the daemon routes every grant through it."""
+        pool, pods = _problem("one", 16)
+        solo = _wire(_scheduler(pool, "one").solve(copy.deepcopy(pods)))
+        outcomes, stats = solve_batch(
+            [(_scheduler(pool, "one"), copy.deepcopy(pods))]
+        )
+        assert outcomes[0][0] == "ok"
+        assert _wire(outcomes[0][1]) == solo
+        assert stats["batched_dispatches"] == 0
+
+    def test_distinct_scheduler_instances_required(self):
+        pool, pods = _problem("dup", 8)
+        sched = _scheduler(pool, "dup")
+        with pytest.raises(ValueError, match="distinct"):
+            solve_batch([(sched, list(pods)), (sched, list(pods))])
+
+    def test_poisoned_member_fails_alone(self):
+        """A member whose device-side prepare blows up gets an isolated
+        ("error", exc) outcome; its batch-mates complete with solo-parity
+        results."""
+
+        class _Poisoned(DeviceScheduler):
+            def _class_steps(self, prep):
+                raise RuntimeError("poisoned problem")
+
+        pool_a, pods_a = _problem("ia", 20)
+        pool_b, pods_b = _problem("ib", 20)
+        pool_x, pods_x = _problem("ix", 20)
+        solo_a = _wire(_scheduler(pool_a, "ia").solve(copy.deepcopy(pods_a)))
+        solo_b = _wire(_scheduler(pool_b, "ib").solve(copy.deepcopy(pods_b)))
+        poisoned = _Poisoned(
+            [pool_x], {"ix": list(_catalog())}, max_slots=64
+        )
+        outcomes, _stats = solve_batch([
+            (_scheduler(pool_a, "ia"), copy.deepcopy(pods_a)),
+            (poisoned, copy.deepcopy(pods_x)),
+            (_scheduler(pool_b, "ib"), copy.deepcopy(pods_b)),
+        ])
+        assert outcomes[0][0] == "ok" and _wire(outcomes[0][1]) == solo_a
+        assert outcomes[2][0] == "ok" and _wire(outcomes[2][1]) == solo_b
+        status, err = outcomes[1]
+        assert status == "error"
+        assert "poisoned problem" in repr(err)
+
+
+class TestBatchedJitCacheBounded:
+    def test_soak_of_random_sizes_compiles_bounded(self):
+        """Randomly-sized problems through solve_batch: after the warm-up
+        sweep, repeat batches inside the same shape buckets compile ZERO
+        new kernels (power-of-two batch pad x bucketed tensor axes keep
+        the jit key space finite)."""
+        import random
+
+        rng = random.Random(7)
+
+        def entry(i, n_pods):
+            name = f"soak{i}"
+            pool, pods = _problem(name, n_pods)
+            return (_scheduler(pool, name), pods)
+
+        def batch(tag, sizes):
+            return [
+                entry(f"{tag}{j}", n) for j, n in enumerate(sizes)
+            ]
+
+        # warm: batch sizes 2 and 3 (both pad shapes), pod counts across
+        # the 17..31 class/level bucket window
+        for tag, sizes in (("w0", [20, 24]), ("w1", [18, 22, 26])):
+            outcomes, _ = solve_batch(batch(tag, sizes))
+            assert all(s == "ok" for s, _ in outcomes)
+
+        from karpenter_core_tpu.ops.ffd import ffd_solve_batched
+
+        compiles = []
+
+        def listener(name, **kw):
+            if name == "/jax/compilation_cache/compile_requests_use_cache":
+                compiles.append(name)
+
+        jax.monitoring.register_event_listener(listener)
+        try:
+            cache_before = ffd_solve_batched._cache_size()
+            for i in range(4):
+                sizes = [rng.randrange(18, 28) for _ in range(rng.choice((2, 3)))]
+                outcomes, stats = solve_batch(batch(f"s{i}", sizes))
+                assert all(s == "ok" for s, _ in outcomes)
+                assert stats["batched_problems"] == len(sizes)
+            assert ffd_solve_batched._cache_size() == cache_before
+            assert compiles == [], (
+                f"{len(compiles)} new compilations across the soak"
+            )
+        finally:
+            from jax._src import monitoring as _monitoring
+
+            _monitoring._unregister_event_listener_by_callback(listener)
+
+
+# ---------------------------------------------------------------------------
+# gateway coalescer units
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def tick(self, dt):
+        self.now += dt
+
+
+def _ready_ticket(gw, tenant, bucket="bk", fp=None, deadline=None):
+    """Submit + queue a ticket from a worker thread (await_grant blocks
+    while another ticket holds the device)."""
+    t = gw.submit(tenant, fleet.LANE_SOLVE, deadline)
+    t.bucket = bucket
+    t.fingerprint = fp or f"fp-{tenant}"
+    t.payload = (b"", {"pods": [None] * 4}, f"dg-{tenant}")
+    th = threading.Thread(target=lambda: _swallow(gw, t), daemon=True)
+    th.start()
+    for _ in range(200):
+        if t.state in ("queued", "batched", "shed", "drained"):
+            break
+        time.sleep(0.005)
+    return t
+
+
+def _swallow(gw, ticket):
+    try:
+        gw.await_grant(ticket)
+    except Exception:
+        pass
+
+
+class TestGatewayCoalescer:
+    def test_collect_batch_same_bucket_distinct_fingerprints(self):
+        clock = FakeClock()
+        gw = FleetGateway(max_depth=16, time_fn=clock, max_batch=8)
+        leader = gw.submit("lead")
+        leader.bucket, leader.fingerprint = "bk", "fp-lead"
+        gw.await_grant(leader)  # device free: granted immediately
+        t_match = _ready_ticket(gw, "ta")
+        t_dup = _ready_ticket(gw, "tb", fp="fp-lead")  # leader's problem
+        t_other = _ready_ticket(gw, "tc", bucket="other")
+        members = gw.collect_batch(leader)
+        assert members == [t_match]
+        assert t_match.state == "batched"
+        # the non-matching tickets stay queued for their own grants
+        assert t_dup.state == "queued"
+        assert t_other.state == "queued"
+        gw.release_batch([(leader, 0.5), (t_match, 0.5)], 0.1)
+        assert gw.batch_stats()["coalesced"] == 1
+
+    def test_collect_batch_sheds_expired_members(self):
+        clock = FakeClock()
+        gw = FleetGateway(max_depth=16, time_fn=clock, max_batch=8)
+        leader = gw.submit("lead")
+        leader.bucket, leader.fingerprint = "bk", "fp-lead"
+        gw.await_grant(leader)
+        t_dead = _ready_ticket(gw, "ta", deadline=1.0)
+        clock.tick(5.0)  # its deadline lapses while queued
+        t_live = _ready_ticket(gw, "tb")
+        members = gw.collect_batch(leader)
+        assert members == [t_live]
+        assert t_dead.state == "shed"
+        gw.release_batch([(leader, 1.0), (t_live, 1.0)], 0.1)
+
+    def test_release_batch_charges_pod_weighted_shares(self):
+        clock = FakeClock()
+        gw = FleetGateway(max_depth=16, time_fn=clock, max_batch=8)
+        leader = gw.submit("big")
+        leader.bucket, leader.fingerprint = "bk", "fp-big"
+        gw.await_grant(leader)
+        member = _ready_ticket(gw, "small")
+        assert gw.collect_batch(leader) == [member]
+        # 3:1 pod weighting of a 2.0s grant -> 1.5s vs 0.5s of vclock
+        gw.release_batch([(leader, 3.0), (member, 1.0)], 2.0)
+        assert gw._vtime["big"] == pytest.approx(1.5)
+        assert gw._vtime["small"] == pytest.approx(0.5)
+        # ONE per-grant observation, not one per problem
+        assert gw.device_p50() == pytest.approx(2.0)
+        assert gw.depth() == 0
+
+    def test_collect_batch_respects_limit_and_lane(self):
+        clock = FakeClock()
+        gw = FleetGateway(max_depth=16, time_fn=clock, max_batch=3)
+        leader = gw.submit("lead")
+        leader.bucket, leader.fingerprint = "bk", "fp-lead"
+        gw.await_grant(leader)
+        ts = [_ready_ticket(gw, f"t{i}") for i in range(4)]
+        sweep = gw.submit("sw", fleet.LANE_SWEEP)
+        sweep.bucket, sweep.fingerprint = "bk", "fp-sw"
+        members = gw.collect_batch(leader)  # max_batch=3 -> 2 members
+        assert len(members) == 2
+        assert all(t.state == "batched" for t in members)
+        assert sum(t.state == "queued" for t in ts) == 2
+        gw.release_batch(
+            [(leader, 1.0)] + [(t, 1.0) for t in members], 0.1
+        )
+        for t in ts:
+            gw.abandon(t)
+        gw.abandon(sweep)
+
+    def test_compatible_queued_counts_fillable_batch(self):
+        """The window short-circuit: same-bucket distinct-fingerprint
+        queued tickets count; the leader's own fingerprint, duplicates,
+        and other buckets do not."""
+        clock = FakeClock()
+        gw = FleetGateway(max_depth=16, time_fn=clock, max_batch=8)
+        leader = gw.submit("lead")
+        leader.bucket, leader.fingerprint = "bk", "fp-lead"
+        gw.await_grant(leader)
+        assert gw.compatible_queued(leader) == 0
+        _ready_ticket(gw, "ta")
+        _ready_ticket(gw, "tb", fp="fp-lead")  # leader's own problem
+        _ready_ticket(gw, "tc", bucket="other")
+        _ready_ticket(gw, "td", fp="fp-ta")  # duplicate of ta's problem
+        _ready_ticket(gw, "te")
+        assert gw.compatible_queued(leader) == 2  # ta + te
+        nobucket = fleet.Ticket("x", fleet.LANE_SOLVE, 0.0, None)
+        assert gw.compatible_queued(nobucket) == 0
+        gw.release(leader, 0.01)
+
+    def test_member_outcome_handoff(self):
+        gw = FleetGateway(max_depth=4)
+        t = gw.submit("x")
+        gw.finish_batched(t, result=("res", 0.1))
+        assert gw.await_batched(t) == ("res", 0.1)
+        t2 = gw.submit("y")
+        gw.finish_batched(t2, error=RuntimeError("isolated"))
+        with pytest.raises(RuntimeError, match="isolated"):
+            gw.await_batched(t2)
+        gw.abandon(t)
+        gw.abandon(t2)
+
+
+class TestShedEstimatorBatchAware:
+    """ISSUE 9 satellite: admission divides the backlog by the observed
+    problems-per-grant. A gateway whose grants each served 4 problems in
+    1s must ADMIT a deadline the one-grant-per-request model would shed —
+    over-shedding while batching raises effective throughput was the
+    regression this pins."""
+
+    def _seed_history(self, gw, batch_size, grants=6, seconds=1.0):
+        for _ in range(grants):
+            ts = [gw.submit(f"h{i}") for i in range(batch_size)]
+            gw.await_grant(ts[0])
+            gw.release_batch([(t, 1.0) for t in ts], seconds)
+
+    def test_batched_history_admits_what_serial_model_sheds(self):
+        clock = FakeClock()
+        gw = FleetGateway(max_depth=32, time_fn=clock, max_batch=8)
+        self._seed_history(gw, batch_size=4)
+        assert gw.device_p50() == pytest.approx(1.0)
+        # 8 requests pending; per-request model says (8+1)*1.0 = 9s
+        backlog = [gw.submit(f"b{i}") for i in range(8)]
+        # deadline 4s: per-grant model (9/4 grants ~ 2.25s) admits
+        probe = gw.submit("probe", deadline=4.0)
+        assert probe.state == "pending"
+        for t in [probe] + backlog:
+            gw.abandon(t)
+
+    def test_serial_history_still_sheds(self):
+        """Negative control: identical load, identical deadline, but the
+        observed history is one problem per grant — the shed must still
+        fire (the fix must not simply loosen admission)."""
+        clock = FakeClock()
+        gw = FleetGateway(max_depth=32, time_fn=clock)
+        self._seed_history(gw, batch_size=1)
+        backlog = [gw.submit(f"b{i}") for i in range(8)]
+        with pytest.raises(fleet.ShedError) as ei:
+            gw.submit("probe", deadline=4.0)
+        assert ei.value.reason == "deadline"
+        for t in backlog:
+            gw.abandon(t)
+
+
+# ---------------------------------------------------------------------------
+# daemon end-to-end
+
+
+def _solve_body(tenant, n_pods=6):
+    pods = [
+        make_pod(cpu=0.5 * (1 + i % 2), name=f"{tenant}-{i}")
+        for i in range(n_pods)
+    ]
+    return codec.encode_solve_request(
+        [make_nodepool(name=tenant)],
+        {tenant: fake_instance_types(3)},
+        [], [], pods, max_slots=32, tenant=tenant,
+    )
+
+
+def _decoded_minus_timing(out_bytes):
+    d = codec.decode_solve_results(out_bytes)
+    d.pop("solve_seconds", None)
+    return d
+
+
+def _run_coalesced(daemon, gw, bodies):
+    """Deterministic coalescing: park the device, queue every request,
+    release the park so one leader collects the rest."""
+    park = gw.submit("zzz-park", fleet.LANE_SOLVE)
+    gw.await_grant(park)
+    outs, errs = {}, {}
+
+    def run(tn, b):
+        try:
+            outs[tn] = daemon.solve(b)[0]
+        except Exception as e:  # surfaced by the caller
+            errs[tn] = e
+
+    threads = [
+        threading.Thread(target=run, args=(tn, b), daemon=True)
+        for tn, b in bodies.items()
+    ]
+    for t in threads:
+        t.start()
+    for _ in range(400):
+        if gw.preparing() == 0 and gw.depth() == len(bodies) + 1:
+            break
+        time.sleep(0.005)
+    gw.release(park, 0.01)
+    for t in threads:
+        t.join(120)
+    return outs, errs
+
+
+class TestDaemonBatchedE2E:
+    def test_coalesced_results_match_unbatched_daemon(self):
+        bodies = {tn: _solve_body(tn) for tn in ("ea", "eb", "ec")}
+        # reference: a batching-disabled daemon (the PR 5 serialized path)
+        solo_daemon = service.SolverDaemon(gateway=FleetGateway(max_depth=8))
+        solo = {
+            tn: _decoded_minus_timing(solo_daemon.solve(b)[0])
+            for tn, b in bodies.items()
+        }
+
+        gw = FleetGateway(max_depth=8, max_batch=4)
+        daemon = service.SolverDaemon(gateway=gw)
+        size_before = sum(m.SOLVERD_BATCH_SIZE.totals.values())
+        outs, errs = _run_coalesced(daemon, gw, bodies)
+        assert not errs, errs
+        assert gw.batch_stats()["coalesced"] == 2
+        # the grant's batch size histogram moved (3-problem grant)
+        assert sum(m.SOLVERD_BATCH_SIZE.totals.values()) > size_before
+        for tn, out in outs.items():
+            assert _decoded_minus_timing(out) == solo[tn]
+        # healthz surfaces the batch stats
+        health = daemon.health()
+        assert health["batch"]["coalesced"] == 2
+        assert health["batch"]["max_batch"] == 4
+
+    def test_chaos_crash_fails_leader_alone(self):
+        """The device-tier chaos crash targets the leader's problem: the
+        leader answers its 500 and takes the poison strike; its collected
+        batch-mates still solve and answer clean — the batch-isolated
+        failure contract, end to end."""
+        from karpenter_core_tpu.chaos import ChaosSchedule, SolverChaos
+
+        schedule = ChaosSchedule(
+            script={"solverd.solve": ["crash", "ok", "ok"]}
+        )
+        chaos = SolverChaos(schedule)
+        gw = FleetGateway(max_depth=8, max_batch=4)
+        daemon = service.SolverDaemon(gateway=gw, chaos=chaos)
+        # tenants sort by vtime then name: "ca" leads deterministically
+        bodies = {tn: _solve_body(tn) for tn in ("ca", "cb", "cc")}
+        digests = {
+            tn: __import__("hashlib").sha256(b).hexdigest()
+            for tn, b in bodies.items()
+        }
+        outs, errs = _run_coalesced(daemon, gw, bodies)
+        assert set(errs) == {"ca"}, (errs, list(outs))
+        assert "chaos" in repr(errs["ca"])
+        for tn in ("cb", "cc"):
+            assert _decoded_minus_timing(outs[tn])["errors"] == {}
+        # the poison strike landed on the leader's digest ONLY
+        assert digests["ca"] in daemon.quarantine._strike_counts
+        for tn in ("cb", "cc"):
+            assert digests[tn] not in daemon.quarantine._strike_counts
+
+    def test_preparing_counts_decoding_requests(self):
+        gw = FleetGateway(max_depth=4, max_batch=4)
+        t = gw.submit("p0")
+        assert gw.preparing() == 1  # submitted, not yet queued
+        gw.await_grant(t)
+        assert gw.preparing() == 0  # granted
+        gw.release(t, 0.01)
+
+    def test_preparing_is_lane_scoped(self):
+        """A mid-decode SWEEP request must not make a solve leader hold
+        the device idle for the batching window: preparing() counts only
+        the solve lane by default."""
+        gw = FleetGateway(max_depth=8, max_batch=4)
+        sweep = gw.submit("sw", fleet.LANE_SWEEP)
+        assert gw.preparing() == 0
+        assert gw.preparing(fleet.LANE_SWEEP) == 1
+        solve = gw.submit("so")
+        assert gw.preparing() == 1
+        gw.abandon(sweep)
+        gw.abandon(solve)
+        assert gw.preparing() == 0
+        assert gw.preparing(fleet.LANE_SWEEP) == 0
+
+    def test_member_marker_survives_release_overwrite(self):
+        """The daemon branches member-vs-leader on the ONE-WAY
+        batched_member marker: release_batch flips a member's state to
+        "done" possibly before its handler thread wakes, and a state
+        check racing past that overwrite would take the leader path
+        without holding the grant."""
+        clock = FakeClock()
+        gw = FleetGateway(max_depth=8, time_fn=clock, max_batch=4)
+        leader = gw.submit("lead")
+        leader.bucket, leader.fingerprint = "bk", "fp-lead"
+        gw.await_grant(leader)
+        member = _ready_ticket(gw, "mm")
+        assert gw.collect_batch(leader) == [member]
+        assert member.batched_member is True
+        gw.finish_batched(member, result=("r", 0.0))
+        gw.release_batch([(leader, 1.0), (member, 1.0)], 0.1)
+        assert member.state == "done"  # overwritten by release_batch...
+        assert member.batched_member is True  # ...the marker survives
+
+    def test_batch_disabled_gateway_never_coalesces(self):
+        """max_batch=1 (the FleetGateway default): the leader path must
+        not collect anyone — PR 5 semantics exactly."""
+        bodies = {tn: _solve_body(tn) for tn in ("da", "db")}
+        gw = FleetGateway(max_depth=8)  # defaults: batching off
+        daemon = service.SolverDaemon(gateway=gw)
+        outs, errs = _run_coalesced(daemon, gw, bodies)
+        assert not errs, errs
+        assert gw.batch_stats()["coalesced"] == 0
+        assert gw.batch_stats()["mean_size"] == 1.0
+
+
+class TestBatchFlagPlumbing:
+    def test_operator_flags_parse_and_validate(self):
+        from karpenter_core_tpu.operator import Options
+
+        opts = Options.parse([])
+        assert opts.solver_max_batch == fleet.DEFAULT_MAX_BATCH
+        assert opts.solver_batch_window_ms == fleet.DEFAULT_BATCH_WINDOW_MS
+        opts = Options.parse(
+            ["--solver-max-batch", "4", "--solver-batch-window-ms", "0"]
+        )
+        assert opts.solver_max_batch == 4
+        assert opts.solver_batch_window_ms == 0.0
+        assert Options.parse(
+            [], env={"KARPENTER_SOLVER_MAX_BATCH": "16"}
+        ).solver_max_batch == 16
+        with pytest.raises(ValueError, match="solver-max-batch"):
+            Options.parse(["--solver-max-batch", "0"])
+        with pytest.raises(ValueError, match="batch-window-ms"):
+            Options.parse(["--solver-batch-window-ms", "-1"])
+
+    def test_supervisor_spawn_argv_carries_batching(self):
+        from karpenter_core_tpu.solver.supervisor import default_command
+
+        cmd = default_command(0, max_batch=4, batch_window_ms=1.5)
+        assert cmd[cmd.index("--max-batch") + 1] == "4"
+        assert cmd[cmd.index("--batch-window-ms") + 1] == "1.5"
+        bare = default_command(0)
+        assert "--max-batch" not in bare
+        assert "--batch-window-ms" not in bare
+
+
+class TestProblemBucket:
+    def test_same_shape_different_content_share_bucket(self):
+        """Two tenants with different catalogs/pools of the SAME shape
+        land in one bucket (the cross-tenant coalescing predicate), while
+        a materially different problem shape does not."""
+        d1 = codec.decode_solve_request(_solve_body("ba"))
+        d2 = codec.decode_solve_request(_solve_body("bb"))
+        assert d1["fingerprint"] != d2["fingerprint"]
+        assert d1["bucket"] == d2["bucket"]
+        d3 = codec.decode_solve_request(_solve_body("bc", n_pods=40))
+        assert d3["bucket"] != d1["bucket"]  # pod-count bucket differs

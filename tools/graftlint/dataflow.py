@@ -59,8 +59,12 @@ SHARD = "sharded"
 PLACED = frozenset({REPL, SHARD})
 
 # the sanctioned placement API (parallel/mesh.py): call tails that mint a
-# slot-axis sharding / an explicit replication
-_MESH_SHARDERS = {"slot_shardings", "axis_sharding", "batch_sharding"}
+# slot-axis sharding / an explicit replication (the batched_* twins mint
+# the problem-batched specs for the continuous-batching vmapped solve)
+_MESH_SHARDERS = {
+    "slot_shardings", "axis_sharding", "batch_sharding",
+    "batched_slot_shardings", "batched_step_shardings",
+}
 _MESH_REPLICATORS = {"replicated"}
 
 _NP_PREFIXES = ("np.", "numpy.", "onp.")

@@ -43,10 +43,15 @@ from tools.graftlint.engine import ParsedFile, Rule, dotted_name, register
 # SlotState jit entries: defined in ops/ffd.py (plus the consolidation
 # sweep's _prefix_scan), consumed by models/ and the harnesses. One list,
 # shared by GL501 (routing) and GL503 (the bare-device_put precondition
-# inherited from the retired GL104).
+# inherited from the retired GL104). The batched twins (ISSUE 9) consume
+# a problem-STACKED SlotState — batch-stacked state must still route
+# through parallel.mesh placement (batched_slot_shardings /
+# batched_step_shardings), so they carry the same contract.
 SLOTSTATE_JIT_ENTRIES = {
     "ffd_solve",
     "ffd_solve_donated",
+    "ffd_solve_batched",
+    "ffd_solve_batched_donated",
     "_prefix_scan",
 }
 
