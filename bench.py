@@ -25,6 +25,15 @@ Secondary lines (reported in `detail`):
                   padding ratio, and per-tenant p99 queue-wait (must be
                   no worse batched). A tiny version runs under
                   BENCH_FAST=1 so tier-1 smokes the batched path
+  cfg11_gangs     mixed-priority churn with gangs (ISSUE 10): 20k pods —
+                  10% system-critical sized past the largest fresh
+                  instance (admit only via preemption on the existing
+                  fleet), 15% in 8-pod all-or-nothing gangs, the rest
+                  plain — recording preemption count, the
+                  evicted-per-admitted-cpu minimality proxy, gang
+                  atomicity violations (MUST be 0), and the p50 ratio vs
+                  the plain cfg1 shape. A tiny version runs under
+                  BENCH_FAST=1 so tier-1 smokes the gangsched path
   cfg9_verified   the verification trust anchor's cost: the primary
                   config runs with the ResultVerifier ON (the production
                   default — every config above already pays it), and this
@@ -1143,6 +1152,195 @@ def _run_multidev_probe() -> dict:
     return {"error": proc.stderr.strip()[-300:] or "no output"}
 
 
+def _gangs_bench(n_pods=20000, n_existing=None, repeats=3,
+                 cfg1_p50=None) -> dict:
+    """cfg11_gangs: mixed-priority churn with gangs (ISSUE 10).
+
+    The gangsched workload shape at scale: ~75% default-tier plain pods,
+    10% system-critical pods SIZED PAST the largest fresh instance (the
+    preemption traffic — they admit only by evicting strictly-lower-tier
+    bound pods on the existing fleet), and 15% of pods in 8-pod gangs
+    (all-or-nothing placement). Records:
+
+    * preemption_count — victims named by the final solve's eviction
+      claims (the drain-before-bind work the operator would execute);
+    * eviction_minimality — evicted-cpu per admitted-cpu on preempted
+      nodes, the minimality proxy: the kernel claims the cheapest
+      sufficient PREFIX per node, so the ratio must stay near 1 (bounded
+      by one victim's worth of overshoot per node, never a whole node's
+      population for one pod);
+    * gang_atomicity_violations — gangs left partially materialized
+      (placed count in (0, min)); MUST be 0, and verification is ON so a
+      forged packing would already have degraded;
+    * p50_vs_cfg1 — the priority/gang machinery's price over the plain
+      cfg1-shaped solve at the same scale (the off-by-default contract
+      says plain problems pay nothing; THIS config pays the gang scan +
+      preemption pass and records how much).
+    """
+    from karpenter_core_tpu.api.objects import ObjectMeta, Pod
+    from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+    from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import (
+        EvictablePod,
+        SimNode,
+    )
+    from karpenter_core_tpu.models.provisioner import DeviceScheduler
+    from karpenter_core_tpu.solver.gangs import (
+        GANG_ANNOTATION,
+        gang_min_count,
+        pod_gang_sig,
+    )
+    from karpenter_core_tpu.utils.disruption import priority_tier
+
+    catalog = build_catalog(cpu_grid=[1, 2, 4])  # fresh tops out at 4 cpu
+    if n_existing is None:
+        n_existing = max(4, n_pods // 250)
+    existing = [
+        SimNode(
+            name=f"exist-{i}",
+            labels={
+                "topology.kubernetes.io/zone": "zone-a",
+                "kubernetes.io/hostname": f"exist-{i}",
+                "kubernetes.io/os": "linux",
+                "kubernetes.io/arch": "amd64",
+                "karpenter.sh/capacity-type": "on-demand",
+                "karpenter.sh/nodepool": "default",
+            },
+            taints=[],
+            available={"cpu": 0.5, "memory": 8 * GIB, "pods": 100.0},
+            capacity={"cpu": 16.0, "memory": 16 * GIB, "pods": 110.0},
+            initialized=True,
+            evictable=tuple(
+                EvictablePod(
+                    uid=f"victim-{i}-{j}", priority=0,
+                    requests={"cpu": 3.0, "memory": 0.5 * GIB},
+                    cost=1.0 + 0.01 * j,
+                )
+                for j in range(4)
+            ),
+        )
+        for i in range(n_existing)
+    ]
+
+    n_gang = int(n_pods * 0.15) // 8 * 8
+    n_crit = int(n_pods * 0.10)
+    pods = []
+    for i in range(n_gang):
+        p = Pod(
+            metadata=ObjectMeta(
+                name=f"g{i}",
+                annotations={GANG_ANNOTATION: f"gang-{i // 8}"},
+            ),
+            resource_requests={
+                "cpu": 0.5 * (1 + (i // 8) % 3),
+                "memory": 0.25 * GIB * (1 + (i // 8) % 4),
+            },
+        )
+        pods.append(p)
+    for i in range(n_crit):
+        # past the 4-cpu fresh ceiling: admits only via preemption; 16
+        # memory shapes split the demand into classes so the bounded
+        # per-class node fan-out (ops/gangsched.NODE_ROUNDS) spreads over
+        # the fleet instead of serializing on one class
+        p = Pod(
+            metadata=ObjectMeta(name=f"c{i}"),
+            resource_requests={
+                "cpu": 6.0,
+                "memory": 0.25 * GIB * (1 + i % 16),
+            },
+            priority=2_000_000_000,
+        )
+        pods.append(p)
+    plain = _plain_pods(n_pods - len(pods))
+    for p in plain:
+        p.metadata.name = f"pl-{p.metadata.name}"
+    pods.extend(plain)
+
+    sched = DeviceScheduler(
+        [_pool()], {"default": list(catalog)},
+        existing_nodes=existing, max_slots=4096, verify=not NO_VERIFY,
+    )
+    t0 = time.perf_counter()
+    res = sched.solve(pods)
+    cold = time.perf_counter() - t0
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        res = sched.solve(pods)
+        times.append(time.perf_counter() - t0)
+
+    preemption_count = sum(len(uids) for uids in res.evictions.values())
+    # minimality proxy: evicted cpu per admitted cpu on preempted nodes,
+    # resolved from the claimed uids' actual requests so re-sizing the
+    # synthetic victims keeps the gate honest
+    victim_cpu = {
+        e.uid: e.requests.get("cpu", 0.0)
+        for n in existing
+        for e in n.evictable
+    }
+    evicted_cpu = sum(
+        victim_cpu.get(uid, 0.0)
+        for uids in res.evictions.values()
+        for uid in uids
+    )
+    # denominator: preemption-ADMITTED cpu only. The preempt pass serves
+    # positive tiers exclusively, so tier-0 plain pods that the main scan
+    # packed into a claimed node's ordinary free capacity must not
+    # inflate the ratio and mask an over-evicting regression.
+    admitted_cpu = 0.0
+    for sim in res.existing_nodes:
+        if sim.name in res.evictions:
+            admitted_cpu += sum(
+                p.resource_requests.get("cpu", 0.0)
+                for p in sim.pods
+                if priority_tier(p.priority) > 0
+            )
+    minimality = (
+        round(evicted_cpu / admitted_cpu, 3) if admitted_cpu else None
+    )
+    # gang atomicity over the final results: placed in (0, min) = violation
+    placed_uids = {
+        p.uid
+        for c in res.new_node_claims
+        for p in c.pods
+    } | {p.uid for s in res.existing_nodes for p in s.pods}
+    by_gang = {}
+    for p in pods:
+        g = pod_gang_sig(p)
+        if g is not None:
+            by_gang.setdefault(g[0], []).append(p)
+    violations = 0
+    gangs_placed = 0
+    for name, mpods in by_gang.items():
+        n_placed = sum(1 for p in mpods if p.uid in placed_uids)
+        if n_placed >= gang_min_count(mpods):
+            gangs_placed += 1
+        elif n_placed > 0:
+            violations += 1
+
+    out = _spread(times)
+    p50_raw = sorted(times)[len(times) // 2]
+    out.update({
+        "cold_solve_s": round(cold, 3),
+        "pods": len(pods),
+        "pods_per_sec": round(len(pods) / p50_raw, 1),
+        "preemption_count": preemption_count,
+        "eviction_minimality": minimality,
+        # one 6-cpu admit needs 5.5 freed = 2 victims (6.0): per-node
+        # overshoot is bounded by one victim, so the fleet-wide ratio must
+        # stay under ~1.2 when anything preempted at all
+        "eviction_minimality_ok": minimality is None or minimality <= 1.2,
+        "gangs": len(by_gang),
+        "gangs_placed": gangs_placed,
+        "gang_atomicity_violations": violations,
+        "gang_atomicity_ok": violations == 0,
+        "unschedulable": len(res.pod_errors),
+        "phases": _phase_breakdown(sched),
+    })
+    if cfg1_p50:
+        out["p50_vs_cfg1"] = round(p50_raw / cfg1_p50, 2)
+    return out
+
+
 def _restart_probe() -> None:
     """Child mode: a FRESH process (persistent compile cache on disk warm
     from the parent's solves) boots a DeviceScheduler, pre-warms the shape
@@ -1267,13 +1465,22 @@ def main():
         detail["cfg7_fleet"] = _fleet_bench()
         detail["cfg8_multidev"] = _multidev_bench()
         detail["cfg10_batch"] = _batch_bench()
+        detail["cfg11_gangs"] = _gangs_bench(
+            cfg1_p50=detail["cfg1_5k400"]["p50_solve_s"]
+        )
         detail["restart"] = _run_restart_probe()
     else:
         # tier-1 fast-bench smoke: a tiny cfg10 proves the coalescer +
         # vmapped batch path end-to-end (serialized-vs-batched schema
-        # included) without the full 32-tenant cost
+        # included) without the full 32-tenant cost, and a tiny cfg11
+        # proves the gangsched path (preemption claims + gang atomicity)
+        # the same way
         detail["cfg10_batch"] = _batch_bench(
             n_tenants=4, n_pods=24, n_types=12, repeats=2
+        )
+        detail["cfg11_gangs"] = _gangs_bench(
+            n_pods=200, n_existing=4, repeats=2,
+            cfg1_p50=primary["p50_solve_s"],
         )
 
     pods_per_sec = primary["pods_per_sec"]

@@ -293,6 +293,12 @@ class Provisioner:
         results, _ = self.schedule()
         nominations: Dict[str, str] = {}
 
+        # eviction claims FIRST (drain-before-bind, gangsched ISSUE 10):
+        # preempted placements assume the victims' freed capacity, so the
+        # victims are evicted before their nodes are nominated — the
+        # binder's capacity view converges as the drains complete
+        self._execute_evictions(results)
+
         for sim in results.existing_nodes:
             for p in sim.pods:
                 nominations[p.key()] = sim.name
@@ -356,6 +362,42 @@ class Provisioner:
             for p in claim.pods:
                 nominations[p.key()] = nc.name
         return nominations
+
+    def _execute_evictions(self, results: Results) -> None:
+        """Turn verified eviction claims into API evictions. Claims were
+        verified legal by solver/verify.py (every victim strictly lower
+        tier than a pod its capacity admitted) before the result reached
+        this reconciler; a victim that vanished since the snapshot is a
+        no-op (its capacity is already free)."""
+        evictions = getattr(results, "evictions", None)
+        if not evictions:
+            return
+        from karpenter_core_tpu.metrics import wiring as m
+
+        for node_name, uids in sorted(evictions.items()):
+            # claims name the victim's node: resolve uids against THAT
+            # node's bound pods only, not a cluster-wide scan
+            by_uid = {
+                p.uid: p for p in self.cluster.pods_on_node(node_name)
+            }
+            for uid in uids:
+                victim = by_uid.get(uid)
+                if victim is None:
+                    continue
+                self.kube.evict(victim)
+                m.SOLVER_PREEMPTION_EVICTIONS.inc()
+                if self.recorder is not None:
+                    from karpenter_core_tpu.events import Event
+
+                    self.recorder.publish(Event(
+                        involved_object=f"Pod/{victim.key()}",
+                        type="Normal",
+                        reason="Preempted",
+                        message=(
+                            f"evicted from {node_name} to admit a"
+                            " higher-priority pod (drain-before-bind)"
+                        ),
+                    ))
 
     def _usage_by_nodepool(self) -> Dict[str, dict]:
         """In-use capacity per pool (the nodepool.counter aggregation,

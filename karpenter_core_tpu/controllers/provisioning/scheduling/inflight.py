@@ -204,6 +204,23 @@ class InFlightNodeClaim:
         self.requirements.pop(apilabels.LABEL_HOSTNAME, None)
 
 
+@dataclass(frozen=True)
+class EvictablePod:
+    """One bound pod a preemptive solve may evict (gangsched, ISSUE 10).
+
+    A capacity view, not an API object: uid names the victim for the
+    eviction claim, requests is the capacity its eviction frees, priority
+    feeds the tier-legality rule (only strictly-lower tiers are evictable,
+    utils/disruption.priority_tier), and cost is the victim-selection
+    ordering (utils/disruption.eviction_cost, computed by whoever builds
+    the SimNode — the kernel and the host fallback both sort by it)."""
+
+    uid: str
+    priority: int
+    requests: dict
+    cost: float
+
+
 @dataclass
 class SimNode:
     """Minimal view of an existing/in-flight real node for simulation; the
@@ -221,6 +238,10 @@ class SimNode:
     # CSI attach-limit state (volumeusage.go): filled by the provisioner
     # from the node's CSINode + bound pods; None = no volume tracking
     volume_usage: Optional[object] = None
+    # bound pods a priority-preemptive solve may treat as evictable
+    # capacity (ops/gangsched.preempt_pass); empty = nothing evictable,
+    # which is also the pre-gangsched wire default
+    evictable: tuple = ()
 
 
 class ExistingNodeSim:

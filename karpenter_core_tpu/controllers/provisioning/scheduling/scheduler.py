@@ -49,6 +49,12 @@ class Results:
     new_node_claims: List[InFlightNodeClaim]
     existing_nodes: List[ExistingNodeSim]
     pod_errors: Dict[str, str]  # pod uid -> error
+    # eviction claims (gangsched, ISSUE 10): node name -> bound-pod uids a
+    # preemptive solve selected as victims. The placements on that node
+    # assume the freed capacity, so the operator drains these BEFORE
+    # binding (drain-before-bind); empty for every non-preemptive solve,
+    # which is also the byte-parity wire default
+    evictions: Dict[str, List[str]] = field(default_factory=dict)
 
     def all_pods_scheduled(self) -> bool:
         return not self.pod_errors

@@ -213,6 +213,20 @@ SOLVER_RESULT_REJECTED = REGISTRY.counter(
     " every rejection degrades that solve to the greedy path — a moving"
     " counter means the device tier is producing untrustworthy packings",
 )
+SOLVER_PREEMPTION_EVICTIONS = REGISTRY.counter(
+    "solver_preemption_evictions_total",
+    "Bound pods evicted to admit strictly-higher-tier pending pods"
+    " (gangsched eviction claims executed by the operator as"
+    " drain-before-bind) — each eviction was verified legal (victim"
+    " strictly lower tier than a pod its freed capacity admitted)",
+)
+SOLVER_GANG_UNSCHEDULABLE = REGISTRY.counter(
+    "solver_gang_unschedulable_total",
+    "Pod groups reported whole-gang unschedulable (placed count below the"
+    " gang's min-count → the kernel rolled the partial placement back, or"
+    " the host backstop stripped it) — atomicity holding, not failing;"
+    " partial materialization is a VERIFIER rejection, never a counter",
+)
 SOLVER_QUARANTINE_ENTRIES = REGISTRY.gauge(
     "solverd_quarantine_entries",
     "Problem fingerprints currently quarantined as poison pills, by site"

@@ -70,11 +70,13 @@ from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
     domain_universe,
     has_topology_constraints,
 )
+from karpenter_core_tpu.ops import gangsched
 from karpenter_core_tpu.ops import masks as mops
 from karpenter_core_tpu.ops import topoplan
 from karpenter_core_tpu.parallel import mesh as pmesh
 from karpenter_core_tpu.ops.ffd import (
     BIG,
+    BIGI,
     RANK_NONE,
     ClassStep,
     FFDStatics,
@@ -86,6 +88,7 @@ from karpenter_core_tpu.ops.ffd import (
     ffd_solve_donated,
 )
 from karpenter_core_tpu.scheduling import Requirement, Requirements, Taints
+from karpenter_core_tpu.solver import gangs as gangmod
 from karpenter_core_tpu.solver.snapshot import PodClass, group_pods
 from karpenter_core_tpu.solver.vocab import (
     EntityMasks,
@@ -161,6 +164,26 @@ def _pad(a: np.ndarray, targets: dict, fill) -> np.ndarray:
     return np.pad(a, widths, constant_values=fill)
 
 
+def _same_template_gang_ids(classes, Cp: int):
+    """[Cp] int32 gang index per class for gangs declaring same-template
+    co-location (-1 outside any), plus the gang count — the gang_id input
+    of ops/masks.gang_joint_templates. The flag ORs across members
+    (solver/gangs.collect_gangs contract: any member asking binds the
+    gang), so an unflagged class of a flagged gang is constrained too."""
+    flagged = {
+        g[0]
+        for cls in classes
+        if (g := getattr(cls, "gang", None)) is not None and g[3]
+    }
+    by_name: Dict[str, int] = {}
+    gid = np.full((Cp,), -1, dtype=np.int32)
+    for ci, cls in enumerate(classes):
+        g = getattr(cls, "gang", None)
+        if g is not None and g[0] in flagged:
+            gid[ci] = by_name.setdefault(g[0], len(by_name))
+    return gid, len(by_name)
+
+
 class _SlotOverflow(Exception):
     """More slots needed than max_slots — caller doubles and retries."""
 
@@ -222,6 +245,21 @@ class _Prepared:
     n_classes_padded: int = 8
     _batch: dict = field(default_factory=dict)
     step_class: object = None
+    # gangsched (ISSUE 10) — all None/empty for plain problems, so the
+    # dispatch gate below them stays byte-parity with the pre-gang path.
+    # gangs: GangSpecs fully on the device path (kernel-enforced); a gang
+    # spanning a fallback class is excluded here and relies on the host
+    # backstop (solver/gangs.enforce_atomicity). step_tier/step_gang are
+    # device [Jp] rows aligned with the scanned ClassStep; gang_min is the
+    # device [Gp] per-gang min-count; ev/ev_uids/ev_freed carry the
+    # evictable-capacity planes and their host-side uid/request tables.
+    gangs: list = field(default_factory=list)
+    step_tier: object = None
+    step_gang: object = None
+    gang_min: object = None
+    ev: object = None
+    ev_uids: list = field(default_factory=list)
+    ev_freed: list = field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------
@@ -240,7 +278,13 @@ class _Prepared:
 @dataclass
 class _KernelRequest:
     """One device dispatch, reified so a driver outside the generator can
-    answer it — solo, or stacked into a multi-problem vmapped batch."""
+    answer it — solo, or stacked into a multi-problem vmapped batch.
+
+    ``kind`` selects the kernel family: ``"solve"`` (the FFD scan — the
+    gang-atomic twin dispatches when gang_of_step is set) answered with
+    (final state, takes_bc, unplaced_bc, seconds); ``"preempt"`` (the
+    gangsched eviction pass over a FINISHED solve's state) answered with
+    (extra_takes_bc, unplaced_bc', evicted [N, P], seconds)."""
 
     init_state: SlotState
     steps: ClassStep
@@ -250,19 +294,40 @@ class _KernelRequest:
     num_classes: int  # Cp, the bucketed class axis (static)
     devices: int
     n_slots: int
+    kind: str = "solve"
+    # gang-atomic solve (both None for plain problems — same kernels,
+    # same jit entries, byte-identical results as pre-gang)
+    gang_of_step: object = None  # [Jp] int32 gang step index (-1 gang-free, -2 host-enforced gang)
+    gang_min: object = None  # [Gp] int32 per-gang min-count
+    # preemption pass inputs (kind == "preempt")
+    step_tier: object = None  # [Jp] int32
+    step_gang: object = None  # [Jp] int32
+    unplaced: object = None  # [Jp] int32 still-unplaced per step
+    ev: object = None  # ops/gangsched.EvPlanes
+    node_rounds: int = gangsched.NODE_ROUNDS
 
     def shape_key(self) -> tuple:
         """Exact compile-shape identity: requests with equal keys ride one
         vmapped dispatch (and equal-key dispatches at the same padded
         batch size share one jit entry). Every tensor axis is padded to a
         power-of-two bucket upstream (_bucket), so cross-tenant collisions
-        are the common case by construction, not luck."""
-        leaves = jax.tree.leaves((self.init_state, self.steps, self.statics))
+        are the common case by construction, not luck. The gang/preempt
+        tensors join the leaf walk, so a gang problem can never coalesce
+        into a plain problem's vmapped batch (their keys differ by the
+        extra leaves even at equal state shapes) — the kernel-seam half of
+        the codec.problem_bucket gang components."""
+        leaves = jax.tree.leaves((
+            self.init_state, self.steps, self.statics,
+            self.gang_of_step, self.gang_min,
+            self.step_tier, self.step_gang, self.unplaced, self.ev,
+        ))
         return (
+            self.kind,
             tuple((tuple(x.shape), str(x.dtype)) for x in leaves),
             self.level_iters,
             self.num_classes,
             self.devices,
+            self.node_rounds,
         )
 
 
@@ -272,9 +337,26 @@ def _run_kernel_solo(req: _KernelRequest):
     driver owns dispatch timing because a timer held open across the
     generator's yield would charge batch-mates' work to this problem."""
     t0 = time.perf_counter()
-    state, takes, unplaced = ffd_solve_donated(
-        req.init_state, req.steps, req.statics, level_iters=req.level_iters
-    )
+    if req.kind == "preempt":
+        extra, m_left, evicted = gangsched.preempt_pass(
+            req.init_state, req.steps, req.statics,
+            req.step_tier, req.step_gang, req.unplaced, req.ev,
+            node_rounds=req.node_rounds,
+        )
+        extra_bc, mleft_bc = aggregate_takes(
+            extra, m_left, req.step_class, num_classes=req.num_classes
+        )
+        return extra_bc, mleft_bc, evicted, time.perf_counter() - t0
+    if req.gang_of_step is not None:
+        state, takes, unplaced = gangsched.gang_solve_donated(
+            req.init_state, req.steps, req.statics,
+            req.gang_of_step, req.gang_min, level_iters=req.level_iters,
+        )
+    else:
+        state, takes, unplaced = ffd_solve_donated(
+            req.init_state, req.steps, req.statics,
+            level_iters=req.level_iters,
+        )
     takes_bc, unplaced_bc = aggregate_takes(
         takes, unplaced, req.step_class, num_classes=req.num_classes
     )
@@ -319,6 +401,7 @@ def _run_kernel_batched(reqs: List[_KernelRequest]):
     steps = _stack_trees([r.steps for r in reqs_p])
     statics = _stack_trees([r.statics for r in reqs_p])
     step_class = jnp.stack([r.step_class for r in reqs_p])
+    mesh = repl = None
     if head.devices > 1:
         # re-commit the stacked trees to the slot mesh: problem axis
         # replicated, slot axis sharded (parallel/mesh batched specs) — a
@@ -334,9 +417,45 @@ def _run_kernel_batched(reqs: List[_KernelRequest]):
         )
         statics = jax.device_put(statics, jax.tree.map(lambda _: repl, statics))
         step_class = jax.device_put(step_class, repl)
-    state_b, takes_b, unplaced_b = ffd_solve_batched_donated(
-        state, steps, statics, level_iters=head.level_iters
-    )
+    if head.kind == "preempt":
+        step_tier = jnp.stack([r.step_tier for r in reqs_p])
+        step_gang = jnp.stack([r.step_gang for r in reqs_p])
+        unplaced0 = jnp.stack([r.unplaced for r in reqs_p])
+        ev = _stack_trees([r.ev for r in reqs_p])
+        if mesh is not None:
+            step_tier = jax.device_put(step_tier, repl)
+            step_gang = jax.device_put(step_gang, repl)
+            unplaced0 = jax.device_put(unplaced0, repl)
+            ev = jax.device_put(
+                ev,
+                pmesh.batched_gang_plane_shardings(mesh, ev, head.n_slots),
+            )
+        extra_b, mleft_b, evicted_b = gangsched.preempt_pass_batched(
+            state, steps, statics, step_tier, step_gang, unplaced0, ev,
+            node_rounds=head.node_rounds,
+        )
+        extra_bc, mleft_bc = aggregate_takes_batched(
+            extra_b, mleft_b, step_class, num_classes=head.num_classes
+        )
+        share = (time.perf_counter() - t0) / B
+        return [
+            (extra_bc[b], mleft_bc[b], evicted_b[b], share)
+            for b in range(B)
+        ], Bp
+    if head.gang_of_step is not None:
+        gang_of_step = jnp.stack([r.gang_of_step for r in reqs_p])
+        gang_min = jnp.stack([r.gang_min for r in reqs_p])
+        if mesh is not None:
+            gang_of_step = jax.device_put(gang_of_step, repl)
+            gang_min = jax.device_put(gang_min, repl)
+        state_b, takes_b, unplaced_b = gangsched.gang_solve_batched_donated(
+            state, steps, statics, gang_of_step, gang_min,
+            level_iters=head.level_iters,
+        )
+    else:
+        state_b, takes_b, unplaced_b = ffd_solve_batched_donated(
+            state, steps, statics, level_iters=head.level_iters
+        )
     takes_bc, unplaced_bc = aggregate_takes_batched(
         takes_b, unplaced_b, step_class, num_classes=head.num_classes
     )
@@ -696,6 +815,10 @@ class DeviceScheduler:
 
     def _solve_gen(self, pods: List[Pod]):
         all_pods = list(pods)
+        # refreshed by _sorted_classes each round; False covers the
+        # degenerate no-template/no-existing early return, where nothing
+        # places and the gang backstop has nothing to strip
+        self._gangsched_engaged = False
         errors: Dict[str, str] = {}
         claims: List[InFlightNodeClaim] = []
         # fresh per-solve copy: place_pod subtracts from it as fallback
@@ -777,7 +900,7 @@ class DeviceScheduler:
                 else:
                     max_slots *= 2
                 continue
-            claims, existing_sims, failed = result
+            claims, existing_sims, failed, evictions = result
             errors = {p.uid: msg for p, msg in failed}
             if not failed:
                 break
@@ -800,7 +923,22 @@ class DeviceScheduler:
             new_node_claims=claims,
             existing_nodes=existing_sims,
             pod_errors=errors,
+            evictions=evictions,
         )
+        if self._gangsched_engaged:
+            # the decode-seam atomicity backstop (the kernel already rolled
+            # failed gangs back on device; this catches host-repair
+            # divergence) — it MUST run before verification, which treats a
+            # partially materialized gang as a hard violation
+            gangmod.enforce_atomicity(results, all_pods)
+            gangmod.prune_evictions(results)
+            whole = sum(
+                1
+                for mpods in gangmod.gang_members(all_pods).values()
+                if mpods and all(p.uid in results.pod_errors for p in mpods)
+            )
+            if whole:
+                m.SOLVER_GANG_UNSCHEDULABLE.inc(by=whole)
         if self.verify:
             from karpenter_core_tpu.solver import verify as verifymod
 
@@ -829,19 +967,27 @@ class DeviceScheduler:
         """A device result failed verification: re-solve on the host
         greedy path over the same inputs (the RemoteScheduler degradation
         twin, one layer down). Correctness beats speed exactly once — the
-        rejection metric says the device tier needs attention."""
+        rejection metric says the device tier needs attention. Problems
+        carrying priorities/gangs degrade through the tiered-greedy-with-
+        preemption wrapper (solver/gangs.host_gang_solve), so degraded
+        means slower, never semantically different."""
         from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
             Scheduler,
         )
 
-        return Scheduler(
-            self.nodepools,
-            self.instance_types,
-            existing_nodes=self.existing_nodes,
-            daemonset_pods=self.daemonset_pods,
-            topology=self._topology_context,
-            unavailable_offerings=self.unavailable_offerings,
-        ).solve(pods)
+        def make_scheduler():
+            return Scheduler(
+                self.nodepools,
+                self.instance_types,
+                existing_nodes=self.existing_nodes,
+                daemonset_pods=self.daemonset_pods,
+                topology=self._topology_context,
+                unavailable_offerings=self.unavailable_offerings,
+            )
+
+        return gangmod.degraded_solve(
+            make_scheduler, pods, self.existing_nodes
+        )
 
     # ------------------------------------------------------------------
 
@@ -852,7 +998,7 @@ class DeviceScheduler:
         Returns None on slot overflow (caller retries larger)."""
         if not self.templates and not self.existing_nodes:
             # no viable templates and no existing capacity: everything fails
-            return [], [], [(p, "no nodepool matched pod") for p in pods]
+            return [], [], [(p, "no nodepool matched pod") for p in pods], {}
 
         stats = self.last_phase_stats
         self._h2d_bytes = 0
@@ -916,6 +1062,12 @@ class DeviceScheduler:
             num_classes=prep.n_classes_padded,
             devices=self.devices,
             n_slots=prep.n_slots,
+            # gang-atomic kernels only when kernel-enforced gangs exist;
+            # None keeps the exact pre-gang jit entries (byte parity)
+            gang_of_step=(
+                prep.step_gang if prep.gang_min is not None else None
+            ),
+            gang_min=prep.gang_min,
         )
         prep.init_state = None
         t0 = time.perf_counter()
@@ -932,6 +1084,61 @@ class DeviceScheduler:
             m.SOLVER_KERNEL_DURATION.observe(kdt)
             stats["kernel_s"] += kdt
             return None
+
+        # -- preemption pass (gangsched, ISSUE 10) -------------------------
+        # Still-unplaced positive-tier gang-free classes get one more
+        # device dispatch against the evictable-capacity planes; the
+        # selected eviction set comes back as claims and the freed
+        # capacity inflates the victims' sims so decode accepts the
+        # preempted placements (drain-before-bind makes it real).
+        evictions: Dict[str, List[str]] = {}
+        C = len(prep.classes)
+        if prep.ev is not None and prep.step_tier is not None and C:
+            u_host = np.asarray(jax.device_get(unplaced_bc))[:C]
+            goc = prep._batch["gang_of_class"][:C]
+            toc = prep._batch["tier_of_class"][:C]
+            if bool(((u_host > 0) & (toc > 0) & (goc == -1)).any()):
+                J = len(plan.steps)
+                Jp = int(prep.step_class.shape[0])
+                u_step = jnp.where(
+                    jnp.arange(Jp) < J,
+                    unplaced_bc[prep.step_class], 0
+                ).astype(jnp.int32)
+                extra_bc, mleft_bc, evicted, pdt = yield _KernelRequest(
+                    init_state=state,
+                    steps=steps,
+                    statics=prep.statics,
+                    level_iters=prep.level_iters,
+                    step_class=prep.step_class,
+                    num_classes=prep.n_classes_padded,
+                    devices=self.devices,
+                    n_slots=prep.n_slots,
+                    kind="preempt",
+                    step_tier=prep.step_tier,
+                    step_gang=prep.step_gang,
+                    unplaced=u_step,
+                    ev=prep.ev,
+                )
+                kernel_share_s += pdt
+                takes_bc = takes_bc + extra_bc
+                unplaced_bc = mleft_bc
+                ev_host = np.asarray(jax.device_get(evicted))
+                for ei, uids in enumerate(prep.ev_uids):
+                    hits = np.nonzero(ev_host[ei, : len(uids)])[0]
+                    if not len(hits):
+                        continue
+                    sim = prep.existing_sims[ei]
+                    evictions[sim.name] = [uids[j] for j in hits]
+                    freed = resutil.merge(
+                        *(prep.ev_freed[ei][j] for j in hits)
+                    )
+                    # the victims' capacity is credited to the sim so the
+                    # decode adds (and only they) see it; the operator
+                    # drains the victims before binding
+                    sim.cached_available = resutil.merge(
+                        sim.cached_available, freed
+                    )
+
         N = prep.n_slots
         used = max(int(head["next_free"]), len(prep.existing_sims), 1)
         stats["used_slots"] = max(stats["used_slots"], used)
@@ -1017,7 +1224,7 @@ class DeviceScheduler:
             if err is not None:
                 failed.append((p, err))
         stats["decode_s"] += time.perf_counter() - t0
-        return claims, existing_sims, failed
+        return claims, existing_sims, failed, evictions
 
     # ------------------------------------------------------------------
 
@@ -1072,6 +1279,28 @@ class DeviceScheduler:
                 return best
 
             classes.sort(key=rank)
+        # O(classes) gangsched gate, stashed so the per-solve result
+        # post-processing (_solve_gen) doesn't re-derive it with an
+        # O(pods) annotation rescan at 50k pods
+        self._gangsched_engaged = any(
+            c.tier != 0 or c.gang is not None for c in classes
+        )
+        if self._gangsched_engaged:
+            # gangsched (ISSUE 10): priority tier is the PRIMARY order —
+            # the scan claims capacity in class order, so tier-descending
+            # is what makes "a lower tier can never starve a higher one"
+            # true by construction. Within a tier, gang members pull
+            # adjacent (anchored at the gang's first member) so the
+            # co-location state their joint masks narrow is warm when the
+            # next member scans. The sort is stable, so plain problems
+            # never enter this branch and keep the exact pre-gang order
+            # (byte parity). Shares solver/gangs.gang_adjacent_order with
+            # the host fallback's pod sort — one ordering, two layers.
+            classes = gangmod.gang_adjacent_order(
+                classes,
+                lambda c: c.tier,
+                lambda c: None if c.gang is None else c.gang[0],
+            )
         return classes
 
     def _prepare(
@@ -1711,6 +1940,18 @@ class DeviceScheduler:
                 tmpl_compat_dev,
                 ((0, 0), (0, Sp - tmpl_compat_dev.shape[1])),
             )
+            # same-node-template gang co-location (gangsched, ISSUE 10):
+            # AND-reduce template viability within each such gang BEFORE
+            # fresh_viability's first-template-wins choice, so every
+            # member resolves to the same template by construction. The
+            # n_tmpl_gangs == 0 gate keeps plain problems off the extra
+            # kernel entirely (byte parity).
+            tmpl_gang_id, n_tmpl_gangs = _same_template_gang_ids(classes, Cp)
+            if n_tmpl_gangs:
+                tmpl_ok_b = mops.gang_joint_templates(
+                    tmpl_ok_b, self._dev(tmpl_gang_id),
+                    num_gangs=n_tmpl_gangs,
+                )
             new_template, kstar = mops.fresh_viability(
                 class_it_b,
                 tmpl_ok_b,
@@ -1935,7 +2176,7 @@ class DeviceScheduler:
         # bucket to a multiple of 4 so drifting pod counts share jit cache
         level_iters = -(-max(math.ceil(math.log2(count_bound)), 4) // 4) * 4
 
-        return _Prepared(
+        prep = _Prepared(
             vocab=frozen,
             resource_names=resource_names,
             catalog=catalog,
@@ -1969,6 +2210,141 @@ class DeviceScheduler:
             n_classes_padded=batch["Cp"],
             _batch=batch,
         )
+        self._prepare_gangsched(prep, plan, entry, N)
+        return prep
+
+    def _prepare_gangsched(
+        self, prep: _Prepared, plan: topoplan.TopoPlan, entry: dict, N: int
+    ) -> None:
+        """Attach the gangsched structures (ISSUE 10) to a prepared solve.
+
+        Entirely gated on the class batch actually carrying tiers/gangs:
+        plain problems leave every field at its None/empty default, so the
+        dispatch below them takes the exact pre-gang kernels and produces
+        byte-identical result wires."""
+        classes = prep.classes
+        tiers = np.array([c.tier for c in classes], dtype=np.int64)
+        has_tiers = bool(len(classes)) and bool((tiers != 0).any())
+        has_gangs = any(c.gang is not None for c in classes)
+        if not has_tiers and not has_gangs:
+            return
+        C = len(classes)
+        tier_of_class = np.clip(tiers, -(2**31 - 1), 2**31 - 1).astype(
+            np.int32
+        )
+        gang_of_class = np.full((C,), -1, dtype=np.int32)
+        if has_gangs:
+            # kernel-enforced gangs: fully on the device path. A gang with
+            # a member in the fallback set places through the host loop,
+            # where the atomicity backstop (solver/gangs.enforce_atomicity)
+            # is the enforcement — its device members must not roll back
+            # for a host placement the kernel cannot see. Those members
+            # carry the -2 sentinel: inert for the atomicity kernel (which
+            # keys on >= 0) but still a gang mark, so the preemption pass
+            # never evicts real workload to place a member the backstop
+            # may strip (gang-free means gang_of_class == -1 exactly).
+            fallback_names = {
+                c.gang[0]
+                for c in plan.fallback_classes
+                if getattr(c, "gang", None) is not None
+            }
+            gangs = []
+            for g in gangmod.collect_gangs(classes):
+                if g.name in fallback_names:
+                    for ci in g.class_indices:
+                        gang_of_class[ci] = -2
+                else:
+                    gangs.append(g)
+            if gangs:
+                Gp = _bucket(len(gangs), lo=1)
+                gmin = np.zeros((Gp,), dtype=np.int32)
+                for gi, g in enumerate(gangs):
+                    gmin[gi] = g.min_count
+                    for ci in g.class_indices:
+                        gang_of_class[ci] = gi
+                prep.gangs = gangs
+                prep.gang_min = self._dev(gmin)
+        prep._batch["tier_of_class"] = tier_of_class
+        prep._batch["gang_of_class"] = gang_of_class
+        # evictable-capacity planes for the preemption pass: positive-tier
+        # demand, existing nodes with evictable bound pods, and no device
+        # topology state (the documented interplay limit — a preempted
+        # placement bypasses the in-kernel topology counters)
+        if (
+            bool((tiers > 0).any())
+            and entry["E"]
+            and not plan.has_device_topology()
+        ):
+            ev_cache = entry.setdefault("ev_planes", {})
+            cached = ev_cache.get(N)
+            if cached is None:
+                cached = self._build_ev_planes(entry, N)
+                ev_cache[N] = cached
+            prep.ev, prep.ev_uids, prep.ev_freed = cached
+
+    def _build_ev_planes(self, entry: dict, N: int):
+        """ops/gangsched.EvPlanes over the existing nodes' evictable bound
+        pods: per node, cost-sorted ((disruption cost, uid) ascending —
+        utils/disruption.eviction_cost's order), pod axis padded to a
+        bucketed P. Returns (EvPlanes | None, uid table, freed-request
+        table) — the host tables map an evicted [N, P] mask back to
+        eviction claims and their freed capacity."""
+        E, Rp = entry["E"], entry["Rp"]
+        rvec_cap = entry["rvec_cap"]
+        per_node = [
+            sorted(
+                getattr(n, "evictable", ()) or (),
+                key=lambda e: (e.cost, e.uid),
+            )
+            for n in self.existing_nodes
+        ]
+        maxP = max((len(v) for v in per_node), default=0)
+        if maxP == 0:
+            return None, [], []
+        P = _bucket(maxP, lo=2)
+        req = np.zeros((N, P, Rp), dtype=np.float32)
+        tier = np.full((N, P), BIGI, dtype=np.int32)
+        cost = np.zeros((N, P), dtype=np.float32)
+        valid = np.zeros((N, P), dtype=bool)
+        ev_uids: List[List[str]] = []
+        ev_freed: List[list] = []
+        for ei in range(E):
+            uids, freed = [], []
+            for j, e in enumerate(per_node[ei]):
+                # freed capacity floor-quantizes (capacity-side): the
+                # kernel must never believe an eviction frees more than
+                # the float64 decode refit will actually credit
+                vec = rvec_cap(e.requests)
+                req[ei, j, : vec.shape[0]] = vec
+                tier[ei, j] = e.priority
+                cost[ei, j] = e.cost
+                valid[ei, j] = True
+                uids.append(e.uid)
+                freed.append(dict(e.requests))
+            ev_uids.append(uids)
+            ev_freed.append(freed)
+        planes = gangsched.EvPlanes(
+            req=req, tier=tier, cost=cost, valid=valid
+        )
+        return self._dev_ev(planes, N), ev_uids, ev_freed
+
+    def _dev_ev(self, planes, n_slots: int):
+        """Host->device put for the EvPlanes: slot axis pre-sharded over
+        the mesh via parallel.mesh.gang_plane_shardings (the GANG_EV_SPECS
+        classification GL501 resolves), replicated copies on a 1-device
+        scheduler — the EvPlanes twin of _dev_slots."""
+        for leaf in planes:
+            self._h2d_bytes += leaf.nbytes
+            if self._mesh is None:
+                self._h2d_dev_bytes += leaf.nbytes
+            else:
+                self._h2d_dev_bytes += -(-leaf.nbytes // self.devices)
+        if self._mesh is None:
+            return type(planes)(*(jnp.asarray(x) for x in planes))
+        return jax.device_put(
+            planes,
+            pmesh.gang_plane_shardings(self._mesh, planes, n_slots),
+        )
 
     def _class_steps(self, prep: _Prepared) -> ClassStep:
         """Per-STEP scanned arrays: one step per class, except self-selecting
@@ -1983,6 +2359,8 @@ class DeviceScheduler:
         cached = prep._batch.get("class_steps")
         if cached is not None:
             prep.step_class = prep._batch["step_class"]
+            prep.step_tier = prep._batch.get("step_tier_d")
+            prep.step_gang = prep._batch.get("step_gang_d")
             return cached
         cm = prep.class_masks
         plan = prep.plan
@@ -2085,6 +2463,21 @@ class DeviceScheduler:
         prep._batch["class_steps"] = step
         prep._batch["step_class"] = ci_j
         prep.step_class = ci_j
+        # gangsched step rows (replicated device [Jp]): the class tier and
+        # kernel-gang index lifted to the scanned step axis — present only
+        # when the batch carries tiers/gangs (plain problems skip the
+        # transfer entirely)
+        tier_of_class = prep._batch.get("tier_of_class")
+        if tier_of_class is not None:
+            gang_of_class = prep._batch["gang_of_class"]
+            prep.step_tier = self._dev(
+                _pad(tier_of_class[cis], {0: Jp}, 0)
+            )
+            prep.step_gang = self._dev(
+                _pad(gang_of_class[cis], {0: Jp}, -1)
+            )
+            prep._batch["step_tier_d"] = prep.step_tier
+            prep._batch["step_gang_d"] = prep.step_gang
         return step
 
     def _catalog_union(self) -> List[InstanceType]:

@@ -1,0 +1,338 @@
+"""gangsched: priority-preemptive packing and gang-atomic placement.
+
+The FFD scan (ops/ffd.py) packs a flat bag of pod classes; this layer
+makes two workload shapes first-class solver scenarios (ROADMAP item 3):
+
+* **Priority tiers with simulated preemption** — classes arrive tier-
+  ordered high→low (models/provisioner._sorted_classes lifts
+  utils/disruption.priority_tier to the class order), so within one solve
+  a lower tier can never starve a higher one. When a positive-tier class
+  STILL cannot place, ``preempt_pass`` treats strictly-lower-tier pods
+  already bound on existing nodes as evictable capacity: per node, the
+  cheapest sufficient PREFIX of its cost-ordered evictable pods is
+  priced by a vmapped prefix-fit (cumulative freed capacity → pods
+  admitted), and nodes are claimed cheapest-cost-per-admitted-pod first —
+  minimal-cost by construction at both levels ("Priority Matters",
+  PAPERS.md). The selected eviction set returns with the packing as
+  eviction claims the operator turns into drain-before-bind.
+
+* **Gang atomicity** — a gang axis rides the class batch (``gang_of_step``
+  maps scan steps to gangs, ``gang_min`` carries each gang's min-count).
+  ``gang_solve`` runs the scan, measures each gang's placed count, and
+  ROLLS BACK every gang below its min on device: requirement-plane
+  intersections are not invertible, so the rollback is a second
+  ``lax.cond``-gated scan from the same init state with failed gangs'
+  counts zeroed — no host round-trip, and the common all-gangs-commit case
+  pays only a segment-sum. A second-order cascade (a gang that only
+  committed because a failed gang's takes warped later placements) is
+  caught by a final mask: its takes zero and the whole group reports
+  unschedulable (the host backstop in solver/gangs.enforce_atomicity
+  covers the decode seam the same way).
+
+Off by default: these kernels only dispatch when the problem carries
+non-zero tiers or gangs (models/provisioner gates on the class batch), so
+plain problems run the exact pre-gang entries and produce byte-identical
+result wires.
+
+Interplay limits (documented, verifier-enforced): the preemption pass
+serves positive-tier, gang-free classes in solves WITHOUT device topology
+state (a preempted placement bypasses the in-kernel topology counters);
+gang rollback composes with everything.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from karpenter_core_tpu.ops.ffd import (
+    BIG,
+    BIGI,
+    ClassStep,
+    FFDStatics,
+    SlotState,
+    _class_slot_compatible,
+    _ffd_solve_impl,
+)
+
+# Preemption fan-out bound: one class's remaining pods spread over at most
+# this many preempted nodes per solve (a lax.scan length, so it is a
+# compile-time constant). Demands wider than this stay unschedulable —
+# bounded, predictable kernel cost beats an unbounded eviction sweep.
+NODE_ROUNDS = 8
+
+
+class EvPlanes(NamedTuple):
+    """Evictable bound pods per existing slot, cost-sorted.
+
+    Host prep (models/provisioner) sorts each node's evictable pods by
+    (disruption cost, uid) ascending and pads the pod axis to P; the
+    kernel masks by tier at use. Adding a field? Classify its slot-axis
+    placement in parallel/mesh.GANG_EV_SPECS (the GL501/GL503 routing).
+    """
+
+    req: jax.Array  # [N, P, R] float32 — quantized freed-capacity vectors
+    tier: jax.Array  # [N, P] int32 (pad: BIGI — never strictly lower)
+    cost: jax.Array  # [N, P] float32 — utils/disruption.eviction_cost
+    valid: jax.Array  # [N, P] bool
+
+
+# ---------------------------------------------------------------------------
+# gang-atomic solve
+
+
+def _gang_failures(takes, gang_of_step, gang_min):
+    """[G] bool — gangs whose placed count missed their min."""
+    G = gang_min.shape[0]
+    placed_step = jnp.sum(takes, axis=1)  # [J]
+    seg = jnp.where(gang_of_step >= 0, gang_of_step, G)
+    placed_g = jax.ops.segment_sum(
+        placed_step, seg, num_segments=G + 1
+    )[:G]
+    # padded gangs carry min 0: 0 < 0 is False, so they never "fail"
+    return placed_g < gang_min
+
+
+def _gang_solve_impl(state: SlotState, classes: ClassStep,
+                     statics: FFDStatics, gang_of_step, gang_min,
+                     level_iters: int):
+    final1, takes1, unplaced1 = _ffd_solve_impl(
+        state, classes, statics, level_iters
+    )
+    failed = _gang_failures(takes1, gang_of_step, gang_min)
+    step_failed = jnp.where(
+        gang_of_step >= 0, failed[jnp.clip(gang_of_step, 0)], False
+    )
+    any_failed = jnp.any(step_failed)
+
+    def rerun(_):
+        # the on-device rollback: re-solve from the SAME init state with
+        # failed gangs inert (count 0 places nothing and perturbs no
+        # state) — intersection-based requirement planes cannot be
+        # un-merged, so rollback IS a re-solve
+        classes2 = classes._replace(
+            count=jnp.where(step_failed, 0, classes.count)
+        )
+        return _ffd_solve_impl(state, classes2, statics, level_iters)
+
+    def keep(_):
+        return final1, takes1, unplaced1
+
+    final, takes, unplaced = jax.lax.cond(any_failed, rerun, keep, None)
+
+    # second-order cascade guard: a gang whose pass-1 commit depended on a
+    # rolled-back gang's takes can fail in pass 2 — zero its takes and
+    # report the group unschedulable rather than scanning forever. Slot
+    # planes keep the (tighter-than-needed) intersections; decode treats
+    # any resulting divergence through the host repair path, and the
+    # atomicity backstop re-checks the final Results.
+    failed2 = _gang_failures(takes, gang_of_step, gang_min)
+    step_failed2 = jnp.where(
+        gang_of_step >= 0, failed2[jnp.clip(gang_of_step, 0)], False
+    )
+    dropped = step_failed | step_failed2
+    takes = jnp.where(dropped[:, None], 0, takes)
+    # one unschedulable report per class, on its (sub_)last step — the
+    # step->class aggregation sums unplaced per class
+    unplaced = jnp.where(
+        dropped, jnp.where(classes.sub_last, classes.count, 0), unplaced
+    )
+    return final, takes, unplaced
+
+
+# graftlint: disable=GL103 -- deliberately non-donating: the parity tests
+# re-drive the same init state; the production path uses the donating twin
+gang_solve = partial(jax.jit, static_argnames=("level_iters",))(
+    _gang_solve_impl
+)
+
+# Donating twin (the production path): same lazy CPU-aliasing probe as
+# ops/ffd.ffd_solve_donated — donation is a no-op on CPU and the backend
+# probe must not initialize XLA at import time. The init state is used by
+# BOTH conditional scans inside one jit; XLA owns the internal aliasing.
+_gang_donated_impl = None
+
+
+def gang_solve_donated(state: SlotState, classes: ClassStep,
+                       statics: FFDStatics, gang_of_step, gang_min,
+                       level_iters: int = 32):
+    global _gang_donated_impl
+    if _gang_donated_impl is None:
+        if jax.default_backend() != "cpu":
+            _gang_donated_impl = partial(
+                jax.jit, static_argnames=("level_iters",), donate_argnums=(0,)
+            )(_gang_solve_impl)
+        else:
+            _gang_donated_impl = gang_solve
+    return _gang_donated_impl(
+        state, classes, statics, gang_of_step, gang_min,
+        level_iters=level_iters,
+    )
+
+
+def _gang_solve_batched_impl(state, classes, statics, gang_of_step,
+                             gang_min, level_iters: int):
+    return jax.vmap(
+        lambda s, c, st, g, gm: _gang_solve_impl(s, c, st, g, gm, level_iters)
+    )(state, classes, statics, gang_of_step, gang_min)
+
+
+# Batched twin for the continuous-batching driver (solve_batch): gang
+# problems coalesce only with gang problems of identical compile shapes
+# (the _KernelRequest shape key covers the gang tensors), and the stacked
+# state must still route through parallel.mesh batched placement.
+# graftlint: disable=GL103 -- non-donating twin, mirrors ffd_solve_batched
+gang_solve_batched = partial(jax.jit, static_argnames=("level_iters",))(
+    _gang_solve_batched_impl
+)
+
+_gang_batched_donated_impl = None
+
+
+def gang_solve_batched_donated(state, classes, statics, gang_of_step,
+                               gang_min, level_iters: int = 32):
+    global _gang_batched_donated_impl
+    if _gang_batched_donated_impl is None:
+        if jax.default_backend() != "cpu":
+            _gang_batched_donated_impl = partial(
+                jax.jit, static_argnames=("level_iters",), donate_argnums=(0,)
+            )(_gang_solve_batched_impl)
+        else:
+            _gang_batched_donated_impl = gang_solve_batched
+    return _gang_batched_donated_impl(
+        state, classes, statics, gang_of_step, gang_min,
+        level_iters=level_iters,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the preemption pass
+
+
+def _node_prefix_fit(avail_n, elig_n, req_n, cost_n, r):
+    """One node's eviction price curve (vmapped over the slot axis):
+    cumulative freed capacity over the cost-ordered eligible prefix →
+    (kfit [P+1] pods admitted after evicting the first j, cost [P+1]
+    cumulative cost of that prefix). j=0 is eviction-free residual fit."""
+    P = elig_n.shape[0]
+    freed = jnp.cumsum(jnp.where(elig_n[:, None], req_n, 0.0), axis=0)
+    freed0 = jnp.concatenate(
+        [jnp.zeros((1, req_n.shape[1]), req_n.dtype), freed], axis=0
+    )  # [P+1, R]
+    coste = jnp.cumsum(jnp.where(elig_n, cost_n, 0.0))
+    cost0 = jnp.concatenate([jnp.zeros((1,), coste.dtype), coste])
+    safe_r = jnp.where(r > 0, r, 1.0)
+    head = (avail_n[None, :] + freed0) / safe_r[None, :]
+    head = jnp.where(r[None, :] > 0, head, BIG)
+    kfit = jnp.floor(jnp.min(head, axis=-1))  # [P+1]
+    return jnp.clip(kfit, 0.0, 2**30).astype(jnp.int32), cost0
+
+
+def _preempt_impl(state: SlotState, classes: ClassStep,
+                  statics: FFDStatics, step_tier, step_gang, unplaced,
+                  ev: EvPlanes, node_rounds: int):
+    """Serve still-unplaced positive-tier gang-free classes from evictable
+    capacity. Scans the class axis with an (evicted, capacity-bonus)
+    carry; per class, the vmapped per-node prefix-fit prices every node
+    and a bounded greedy claims nodes cheapest-cost-per-admitted-pod
+    first. Returns (extra takes [J, N], unplaced' [J], evicted [N, P])."""
+    N, P = ev.tier.shape
+
+    def class_step(carry, xs):
+        evicted, bonus = carry
+        c, tier_j, gang_j, m0 = xs
+        # gang-free is exactly -1: -2 marks a member of a gang whose
+        # atomicity is host-enforced (fallback-straddling) — evicting for
+        # it could strand claims if the backstop strips the gang
+        enabled = (m0 > 0) & (gang_j == -1) & (tier_j > 0)
+        ok_node = (
+            (state.kind == 1)
+            & c.exist_taint_ok
+            & _class_slot_compatible(state, c, statics)
+        )
+        elig = ev.valid & (~evicted) & (ev.tier < tier_j)  # [N, P]
+        avail = state.capacity - state.requests + bonus  # [N, R]
+        kfit, cost0 = jax.vmap(
+            _node_prefix_fit, in_axes=(0, 0, 0, 0, None)
+        )(avail, elig, ev.req, ev.cost, c.requests)  # [N, P+1] each
+        kfit = jnp.where(ok_node[:, None] & enabled, kfit, 0)
+
+        def node_round(rc, _):
+            evicted_r, bonus_r, m_r, take_r, used_r = rc
+            t_full = jnp.where(used_r, 0, jnp.minimum(kfit[:, P], m_r))
+            # minimal prefix reaching the node's target take (kfit is
+            # monotone in j, so the count of prefixes below target IS the
+            # minimal index)
+            jneed = jnp.clip(
+                jnp.sum((kfit < t_full[:, None]).astype(jnp.int32), axis=1),
+                0, P,
+            )
+            costn = jnp.take_along_axis(cost0, jneed[:, None], axis=1)[:, 0]
+            score = jnp.where(
+                t_full > 0, costn / t_full.astype(jnp.float32), jnp.inf
+            )
+            n_star = jnp.argmin(score)
+            t = t_full[n_star]
+            act = t > 0
+            jn = jneed[n_star]
+            # jneed indexes the PHYSICAL prefix (freed cumsums run over the
+            # padded pod axis with ineligible rows contributing zero), so
+            # the evicted set is the eligible pods inside that prefix
+            newly = elig[n_star] & (jnp.arange(P) < jn) & act
+            evicted_r = evicted_r.at[n_star].set(evicted_r[n_star] | newly)
+            freed_n = jnp.sum(
+                jnp.where(newly[:, None], ev.req[n_star], 0.0), axis=0
+            )
+            delta = jnp.where(
+                act, freed_n - t.astype(jnp.float32) * c.requests, 0.0
+            )
+            bonus_r = bonus_r.at[n_star].add(delta)
+            take_r = take_r.at[n_star].add(jnp.where(act, t, 0))
+            used_r = used_r.at[n_star].set(used_r[n_star] | act)
+            return (evicted_r, bonus_r, m_r - jnp.where(act, t, 0),
+                    take_r, used_r), None
+
+        init = (
+            evicted, bonus, m0,
+            jnp.zeros((N,), jnp.int32), jnp.zeros((N,), bool),
+        )
+        (evicted2, bonus2, m2, take, _used), _ = jax.lax.scan(
+            node_round, init, None, length=node_rounds
+        )
+        return (evicted2, bonus2), (take, m2)
+
+    R = state.requests.shape[1]
+    init = (
+        jnp.zeros((N, P), dtype=bool),
+        jnp.zeros((N, R), dtype=jnp.float32),
+    )
+    (evicted_f, _bonus), (extra_takes, m_left) = jax.lax.scan(
+        class_step, init, (classes, step_tier, step_gang, unplaced)
+    )
+    return extra_takes, m_left, evicted_f
+
+
+# graftlint: disable=GL103 -- deliberately non-donating: the input is the
+# FINAL SlotState of the main scan, which decode still fetches (template /
+# head scalars) after the preemption pass prices the evictions against it
+preempt_pass = partial(
+    jax.jit, static_argnames=("node_rounds",)
+)(_preempt_impl)
+
+
+def _preempt_batched_impl(state, classes, statics, step_tier, step_gang,
+                          unplaced, ev, node_rounds: int):
+    return jax.vmap(
+        lambda s, c, st, t, g, u, e: _preempt_impl(
+            s, c, st, t, g, u, e, node_rounds
+        )
+    )(state, classes, statics, step_tier, step_gang, unplaced, ev)
+
+
+# graftlint: disable=GL103 -- non-donating twin of preempt_pass: the
+# stacked final states are still read by every member's decode fetch
+preempt_pass_batched = partial(
+    jax.jit, static_argnames=("node_rounds",)
+)(_preempt_batched_impl)

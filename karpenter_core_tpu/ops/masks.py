@@ -125,6 +125,30 @@ def fits(requests, allocatable):
     return ok & jnp.all(allocatable >= 0, axis=-1)[None, :]
 
 
+@partial(jax.jit, static_argnames=("num_gangs",))
+def gang_joint_templates(tmpl_ok, gang_id, num_gangs: int):
+    """Same-node-template gang co-location as a mask tensor: AND-reduce
+    class×template viability within each gang so every member class sees
+    only templates EVERY member could open fresh nodes from — the first
+    member's choice then binds the gang by construction (fresh_viability
+    is first-template-wins over the joint mask, so members resolve to the
+    same template deterministically).
+
+    tmpl_ok: [C, S] bool — per-class template viability (compat ∧ taints)
+    gang_id: [C] int32 — index of the class's same-template gang, -1 for
+             classes outside any such gang (their rows pass through)
+    Returns the narrowed [C, S] mask. Segment-AND rides segment_min over
+    int32 (a 0 anywhere in the gang zeroes the template for the gang)."""
+    member = gang_id >= 0
+    gid = jnp.clip(gang_id, 0)
+    ok_i = jnp.where(member[:, None], tmpl_ok.astype(jnp.int32), 1)
+    joint_g = jax.ops.segment_min(
+        ok_i, gid, num_segments=max(num_gangs, 1)
+    )  # [G, S]
+    joint = joint_g[gid] > 0
+    return jnp.where(member[:, None], tmpl_ok & joint, tmpl_ok)
+
+
 @jax.jit
 def fresh_viability(
     class_it,  # [C, T] bool — class x instance-type compat (intersects)

@@ -60,6 +60,66 @@ def _trivial_node_filter(group: TopologyGroup) -> bool:
     return all(len(alt) == 0 for alt in group.node_filter.alternatives)
 
 
+class GangZoneGroup:
+    """Synthetic zone-keyed affinity group (gangsched, ISSUE 10): every
+    member of a same-zone pod group co-locates in ONE topology zone.
+
+    Duck-types the TopologyGroup surface finalize_arrays consults (key /
+    domains / max_skew / min_domains / selects / is_owned_by) and lowers to
+    the kernel's existing type-2 (affinity) count state: the first member
+    class bootstraps on the first name-ranked admissible zone, pinning its
+    slots' zone row to that single value; every later member then sees
+    exactly one count>0 domain. No new kernel code — the co-location term
+    IS an extra mask tensor over the zone vocab, by construction."""
+
+    type = TYPE_AFFINITY
+    max_skew = 1 << 30  # affinity ignores skew
+    min_domains = None
+    key = apilabels.LABEL_TOPOLOGY_ZONE
+
+    def __init__(self, gang_name: str, member_uids, zone_domains):
+        from karpenter_core_tpu.solver.gangs import pod_gang_sig
+
+        self._sig = pod_gang_sig
+        self.gang_name = gang_name
+        self._uids = frozenset(member_uids)
+        self.domains = {z: 0 for z in sorted(zone_domains)}
+        self.empty_domains = set(self.domains)
+
+    def selects(self, pod) -> bool:
+        g = self._sig(pod)
+        return g is not None and g[0] == self.gang_name
+
+    def is_owned_by(self, uid) -> bool:
+        return uid in self._uids
+
+
+def _gang_zone_groups(classes: List[PodClass], topo: Topology) -> list:
+    """One GangZoneGroup per same-zone gang present in the class list.
+    Requires a non-empty zone domain universe (no zones → nothing to
+    co-locate in; the gang simply packs without the synthetic term)."""
+    zones = topo.domains.get(apilabels.LABEL_TOPOLOGY_ZONE, ())
+    if not zones:
+        return []
+    # same_zone ORs across members (solver/gangs.collect_gangs contract):
+    # every class of a flagged gang joins the group, or an unflagged
+    # member would be counted (selects matches by name) yet never pinned
+    flagged = {
+        g[0]
+        for cls in classes
+        if (g := getattr(cls, "gang", None)) is not None and g[2]
+    }
+    by_name: Dict[str, List] = {}
+    for cls in classes:
+        g = getattr(cls, "gang", None)
+        if g is not None and g[0] in flagged:
+            by_name.setdefault(g[0], []).extend(p.uid for p in cls.pods)
+    return [
+        GangZoneGroup(name, uids, zones)
+        for name, uids in sorted(by_name.items())
+    ]
+
+
 @dataclass
 class DeviceGroup:
     """One topology group lowered to device state."""
@@ -173,6 +233,14 @@ def plan_topology(classes: List[PodClass], topo: Topology) -> TopoPlan:
         all_groups.append(DeviceGroup(g, False, TYPE_CODE[g.type], g.key))
     for g in topo.inverse_topologies.values():
         all_groups.append(DeviceGroup(g, True, TYPE_CODE[g.type], g.key))
+    # synthetic same-zone gang co-location groups (gangsched, ISSUE 10):
+    # lowered as ordinary zone-keyed affinity count state; they live only
+    # in the plan (never in topo), so the host fallback path is unaware —
+    # the atomicity backstop (solver/gangs.enforce_atomicity) covers the
+    # decode-divergence edge where a member re-places host-side
+    gang_groups = _gang_zone_groups(classes, topo)
+    for g in gang_groups:
+        all_groups.append(DeviceGroup(g, False, TYPE_CODE[g.type], g.key))
 
     # groups whose counting/constraining cannot run device-side at all
     host_only = [
@@ -201,6 +269,17 @@ def plan_topology(classes: List[PodClass], topo: Topology) -> TopoPlan:
             reasons[id(cls)] = "owns a host-only (node-filtered) group"
             continue
         ok, reason, wf = _eligibility(cls, owned, inv)
+        if (
+            ok
+            and wf is not None
+            and wf.key == apilabels.LABEL_TOPOLOGY_ZONE
+            and any(g.selects(cls.pods[0]) for g in gang_groups)
+        ):
+            # a zone water-fill spread and the synthetic same-zone gang
+            # affinity fight over one key row — the same conflict
+            # _eligibility rejects for real groups, applied here because
+            # synthetic groups bypass the owned/inv collection
+            ok, reason = False, "zone spread + same-zone gang on one key"
         if ok:
             device_classes.append(cls)
             wf_by_class[id(cls)] = wf
@@ -334,7 +413,14 @@ def finalize_arrays(plan: TopoPlan, frozen, topo: Topology) -> None:
                 plan.z_owner[ci, gi] = sel
             else:
                 plan.z_sel[ci, gi] = sel
-                plan.z_owner[ci, gi] = id(dg.group) in owned_ids
+                # the is_owned_by disjunct is identity for real groups
+                # (owned_ids was built from it) and the ONLY ownership
+                # route for synthetic gang groups, which live outside
+                # topo.topologies
+                plan.z_owner[ci, gi] = (
+                    id(dg.group) in owned_ids
+                    or dg.group.is_owned_by(rep.uid)
+                )
 
     # --- step expansion ---------------------------------------------------
     steps: List[StepSpec] = []

@@ -120,6 +120,47 @@ def batch_sharding(mesh: Mesh, ndim: int, axis: str = "slots") -> NamedSharding:
 # pytrees by field name instead of shape guessing.
 CLASS_STEP_SPECS = {"exist_taint_ok": 1}
 
+# ops/gangsched.EvPlanes — the preemption pass's evictable-capacity planes.
+# Every field leads with the slot axis ([N, P] / [N, P, R]): each slot's
+# evictable bound pods are that slot's private state, so they shard over
+# the mesh exactly like SlotState planes. Same refuse-to-guess contract:
+# a new EvPlanes field must be classified here (gang_plane_shardings
+# raises on an unlisted field).
+GANG_EV_SPECS = {"req": 0, "tier": 0, "cost": 0, "valid": 0}
+
+
+def gang_plane_shardings(mesh: Mesh, planes, n_slots: int,
+                         axis: str = "slots"):
+    """Shardings for an ops/gangsched.EvPlanes: slot axis sharded over the
+    mesh, classified by field name via GANG_EV_SPECS — the gangsched twin
+    of slot_shardings (and the placement route graftlint GL501 resolves
+    for the gang-state jit entries)."""
+    unknown = [f for f in planes._fields if f not in GANG_EV_SPECS]
+    if unknown:
+        raise ValueError(
+            f"gang_plane_shardings: unclassified EvPlanes field(s)"
+            f" {unknown}; annotate them in parallel.mesh.GANG_EV_SPECS"
+        )
+    specs = {}
+    for f in planes._fields:
+        leaf = getattr(planes, f)
+        dim = GANG_EV_SPECS[f]
+        if leaf.shape[dim] != n_slots:
+            raise ValueError(
+                f"gang_plane_shardings: {f} has shape {leaf.shape},"
+                f" expected dim {dim} == n_slots ({n_slots})"
+            )
+        specs[f] = axis_sharding(mesh, leaf.ndim, dim, axis)
+    return type(planes)(**specs)
+
+
+def batched_gang_plane_shardings(mesh: Mesh, planes, n_slots: int,
+                                 axis: str = "slots"):
+    """Problem-batched EvPlanes ([B, N, ...] leaves): batch axis
+    replicated, slot axis sharded — composes with the continuous-batching
+    vmapped gang solve the same way batched_slot_shardings does."""
+    return _batched_specs(mesh, planes, GANG_EV_SPECS, n_slots, axis)
+
 
 def _batched_specs(mesh: Mesh, tree, table: dict, n_slots: int, axis: str):
     """Shardings for a problem-batched NamedTuple [B, ...]: the batch axis

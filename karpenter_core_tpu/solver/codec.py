@@ -157,7 +157,7 @@ def decode_response(data: bytes) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
 # livelock — so the version bumps and a mixed deployment fails EXPLICITLY
 # (version-skew error → greedy degradation with the decode-failure metric)
 # instead of silently losing the mask.
-SOLVE_WIRE_VERSION = 2
+SOLVE_WIRE_VERSION = 3
 
 
 def _json_payload(header: dict) -> bytes:
@@ -322,7 +322,7 @@ def _decode_volume_usage(d: Optional[dict]):
 def _encode_sim_node(n) -> dict:
     from karpenter_core_tpu.kube import serial
 
-    return {
+    out = {
         "name": n.name,
         "labels": dict(n.labels),
         "taints": [serial.encode(t) for t in n.taints],
@@ -334,6 +334,25 @@ def _encode_sim_node(n) -> dict:
         "nodepool_name": n.nodepool_name,
         "volume_usage": _encode_volume_usage(n.volume_usage),
     }
+    # evictable bound pods (gangsched, ISSUE 10): the capacity views a
+    # priority-preemptive solve may claim as victims. Key omitted when
+    # empty — a node with nothing evictable encodes exactly like a
+    # pre-gang one, and the canonical (cost, uid) order keeps the
+    # problem fingerprint stable across operator relist order.
+    ev = getattr(n, "evictable", ()) or ()
+    if ev:
+        out.update({
+            "evictable": [
+                {
+                    "uid": e.uid,
+                    "priority": e.priority,
+                    "requests": dict(e.requests),
+                    "cost": e.cost,
+                }
+                for e in sorted(ev, key=lambda e: (e.cost, e.uid))
+            ],
+        })
+    return out
 
 
 def _decode_sim_node(d: dict):
@@ -341,6 +360,11 @@ def _decode_sim_node(d: dict):
         SimNode,
     )
     from karpenter_core_tpu.kube import serial
+
+    from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import (
+        EvictablePod,
+    )
+    from karpenter_core_tpu.utils.disruption import priority_tier
 
     return SimNode(
         name=d["name"],
@@ -353,6 +377,21 @@ def _decode_sim_node(d: dict):
         nodeclaim_name=d["nodeclaim_name"],
         nodepool_name=d["nodepool_name"],
         volume_usage=_decode_volume_usage(d["volume_usage"]),
+        # absent from pre-gangsched encoders -> nothing evictable. The
+        # priority clamps through priority_tier at the decode net: the
+        # legitimate path (state/cluster._evictable_on) already ships a
+        # tier, and an unclamped hostile value would overflow the int32
+        # EvPlanes tensor INSIDE the exclusive device window — a crash
+        # charged as poison where a cheap corrupt-wire rejection belongs.
+        evictable=tuple(
+            EvictablePod(
+                uid=e["uid"],
+                priority=priority_tier(int(e["priority"])),
+                requests=dict(e["requests"]),
+                cost=float(e["cost"]),
+            )
+            for e in d.get("evictable", ())
+        ),
     )
 
 
@@ -510,9 +549,37 @@ def problem_bucket(header: dict) -> str:
     point), while the exact-shape check lives one layer down
     (models/provisioner.solve_batch groups by real compile shapes and
     splits any batch the predictor got wrong, so a bucket collision can
-    cost a missed coalesce but never a wrong result)."""
+    cost a missed coalesce but never a wrong result).
+
+    Gangsched (ISSUE 10) shape components: tiers-active, the tier-count
+    bucket, gang presence, and evictable-capacity presence join the key,
+    because a gang/priority problem dispatches DIFFERENT kernels
+    (gang_solve / preempt_pass) with extra tensor arguments — its compile
+    shapes can never match a plain problem's, so coalescing them into one
+    PR 9 vmap batch would split every batch at the shape_key check.
+    Tiers-ACTIVE (any non-zero tier) is the shape-relevant bit: the
+    prepared step-tier/step-gang rows attach exactly when it holds, so an
+    all-default problem and an all-tier-100 problem can never share
+    kernel shapes even though both have one distinct tier. Tier COUNT
+    (not values) additionally rides the bucket for the step-axis layout;
+    two active-tier problems with the same count may still coalesce."""
     import hashlib
 
+    from karpenter_core_tpu.solver.gangs import GANG_ANNOTATION
+
+    tiers = set()
+    has_gangs = False
+    for p in header.get("pods", ()):
+        if isinstance(p, dict):
+            tiers.add(int(p.get("priority") or 0))
+            md = p.get("metadata") or {}
+            ann = md.get("annotations") or {}
+            if ann.get(GANG_ANNOTATION):
+                has_gangs = True
+    has_evictable = any(
+        n.get("evictable") for n in header.get("existing_nodes", ())
+        if isinstance(n, dict)
+    )
     parts = (
         SOLVE_WIRE_VERSION,
         _pow2_bucket(len(header.get("it_table", ())), lo=1),
@@ -522,6 +589,10 @@ def problem_bucket(header: dict) -> str:
         _pow2_bucket(len(header.get("pods", ())), lo=8),
         header.get("max_slots", 0),
         bool(header.get("topology")),
+        any(t != 0 for t in tiers),
+        _pow2_bucket(len(tiers), lo=1),
+        has_gangs,
+        has_evictable,
     )
     return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
 
@@ -578,6 +649,18 @@ def encode_solve_results(results, solve_seconds: float) -> bytes:
         "errors": dict(results.pod_errors),
         "solve_seconds": solve_seconds,
     }
+    # eviction claims (gangsched, ISSUE 10): node name -> victim uids the
+    # operator drains before binding. Key omitted when empty, so every
+    # non-preemptive solve's result wire is byte-identical to a pre-gang
+    # build's at the same wire version (the off-by-default parity the
+    # acceptance battery pins).
+    evictions = getattr(results, "evictions", None)
+    if evictions:
+        header.update({
+            "evictions": {
+                node: list(uids) for node, uids in sorted(evictions.items())
+            },
+        })
     return _json_payload(header)
 
 

@@ -391,7 +391,11 @@ class RemoteScheduler:
 
     def solve(self, pods: List):
         from karpenter_core_tpu.metrics import wiring as m
+        from karpenter_core_tpu.solver import gangs as gangmod
 
+        # one O(pods) annotation/priority scan per solve, shared by the
+        # decode backstop and every degradation exit below
+        gangsched = gangmod.has_gangsched(pods)
         digest = None
         quarantine = self.client.quarantine
         try:
@@ -414,7 +418,7 @@ class RemoteScheduler:
             if quarantine is not None and quarantine.quarantined(digest):
                 m.SOLVER_QUARANTINE_ROUTED.inc({"site": "client"})
                 m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "solve"})
-                return self._fallback_solve(pods)
+                return self._fallback_solve(pods, gangsched)
             t0 = time.perf_counter()
             data, kernel = self.client.call("/solve", body)
             total = time.perf_counter() - t0
@@ -425,10 +429,17 @@ class RemoteScheduler:
             with m.SOLVER_RPC_PHASE_DURATION.time({"phase": "decode"}):
                 wire = codec.decode_solve_results(data)
                 results = self._materialize(wire, pods)
+            if gangsched:
+                # decode-seam atomicity backstop (gangsched, ISSUE 10): a
+                # wire uid that no longer resolves to a live pod can
+                # materialize a gang partially — strip it BEFORE
+                # verification, which treats partial gangs as violations
+                gangmod.enforce_atomicity(results, pods)
+                gangmod.prune_evictions(results)
         except RemoteSolverError as e:
             self._note_rpc_failure(e, digest)
             m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "solve"})
-            return self._fallback_solve(pods)
+            return self._fallback_solve(pods, gangsched)
         except (ValueError, KeyError):
             # malformed response (wire-version skew, truncated body):
             # degrade like an unreachable sidecar, but count the cause so
@@ -437,7 +448,7 @@ class RemoteScheduler:
             if quarantine is not None and digest is not None:
                 quarantine.strike(digest, "decode")
             m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "solve"})
-            return self._fallback_solve(pods)
+            return self._fallback_solve(pods, gangsched)
         if self.verify:
             from karpenter_core_tpu.solver import verify as verifymod
 
@@ -455,7 +466,7 @@ class RemoteScheduler:
                 if quarantine is not None and digest is not None:
                     quarantine.strike(digest, "verify")
                 m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "solve"})
-                return self._fallback_solve(pods)
+                return self._fallback_solve(pods, gangsched)
         if quarantine is not None and digest is not None:
             quarantine.clear(digest)
         return results
@@ -481,21 +492,30 @@ class RemoteScheduler:
         elif e.cause in ("timeout", "error", "corrupt", "injected"):
             quarantine.strike(digest, e.cause)
 
-    def _fallback_solve(self, pods: List):
+    def _fallback_solve(self, pods: List, gangsched: Optional[bool] = None):
         """Greedy degradation: the host Scheduler over the same inputs —
-        the cluster keeps provisioning at greedy parity."""
+        the cluster keeps provisioning at greedy parity, with gangsched
+        problems routed through solver/gangs.degraded_solve's tiered
+        wrapper. ``gangsched`` carries solve()'s already-computed
+        has_gangsched verdict; None rescans."""
         from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
             Scheduler,
         )
+        from karpenter_core_tpu.solver import gangs as gangmod
 
-        return Scheduler(
-            self.nodepools,
-            self.instance_types,
-            existing_nodes=self.existing_nodes,
-            daemonset_pods=self.daemonset_pods,
-            topology=self.topology,
-            unavailable_offerings=self.unavailable_offerings,
-        ).solve(pods)
+        def make_scheduler():
+            return Scheduler(
+                self.nodepools,
+                self.instance_types,
+                existing_nodes=self.existing_nodes,
+                daemonset_pods=self.daemonset_pods,
+                topology=self.topology,
+                unavailable_offerings=self.unavailable_offerings,
+            )
+
+        return gangmod.degraded_solve(
+            make_scheduler, pods, self.existing_nodes, gangsched
+        )
 
     # -- response materialization -----------------------------------------
 
@@ -618,8 +638,25 @@ class RemoteScheduler:
                 pods_by_uid[u] for u in uids if u in pods_by_uid
             ]
             sims.append(sim)
+        # eviction claims (gangsched, ISSUE 10): absent on every
+        # non-preemptive wire (the byte-parity contract), a str->List[str]
+        # map when present. A claim on a node that vanished locally is
+        # dropped with its sim — nothing to drain, nothing placed there.
+        evictions: Dict[str, List[str]] = {}
+        ev_wire = wire.get("evictions", {})
+        if not isinstance(ev_wire, dict):
+            corrupt(f"evictions is not a dict: {ev_wire!r}")
+        for node_name, uids in ev_wire.items():
+            if not isinstance(node_name, str):
+                corrupt(f"eviction node name is not a string: {node_name!r}")
+            uids = str_list(uids, "eviction uids")
+            if node_name in node_by_name:
+                evictions[node_name] = list(uids)
         return Results(
-            new_node_claims=claims, existing_nodes=sims, pod_errors=errors
+            new_node_claims=claims,
+            existing_nodes=sims,
+            pod_errors=errors,
+            evictions=evictions,
         )
 
 

@@ -25,6 +25,8 @@ from karpenter_core_tpu.api import labels as apilabels
 from karpenter_core_tpu.api.objects import Pod, RESOURCE_PODS, Taint
 from karpenter_core_tpu.cloudprovider.types import InstanceType
 from karpenter_core_tpu.scheduling import Requirements
+from karpenter_core_tpu.solver.gangs import pod_gang_sig
+from karpenter_core_tpu.utils.disruption import priority_tier
 from karpenter_core_tpu.solver.vocab import (
     EntityMasks,
     FrozenVocab,
@@ -45,6 +47,14 @@ class PodClass:
     tolerations: tuple
     requests: dict
     pods: List[Pod] = field(default_factory=list)
+    # gangsched (ISSUE 10): the class's priority tier
+    # (utils/disruption.priority_tier — 0 for the k8s default) and its
+    # gang signature (solver/gangs.pod_gang_sig — None outside any gang).
+    # Both are part of the spec signature below, so a class is always
+    # tier- and gang-homogeneous; plain pods carry the defaults and their
+    # signatures (hence every prepared-state cache key) are unchanged.
+    tier: int = 0
+    gang: Optional[tuple] = None
     # the raw-spec equivalence key this class was grouped under (see
     # _spec_signature). Everything the solver encodes per class — value
     # masks, strict masks, quantized request vectors, taint rows — is a
@@ -70,7 +80,18 @@ def _spec_signature(pod: Pod, label_aware: bool) -> tuple:
     which groups COUNT the pod (TopologyGroup.selects), terms decide which
     groups CONSTRAIN it, so pods differing in either are not exchangeable.
     Topology-free solves skip both so deployment-distinct labels don't
-    fragment the 50k-pod class collapse."""
+    fragment the 50k-pod class collapse.
+
+    Priority tiers and gang membership (ISSUE 10) append a trailing
+    component ONLY when non-default: the kernel packs tiers in order and
+    commits gangs atomically, so pods differing in either are not
+    exchangeable — but a default-tier gang-free pod's signature is
+    byte-identical to the pre-gang one (the off-by-default parity the
+    prepared caches and wire fingerprints rest on). The suffixed tuples
+    cannot collide with the unsuffixed ones (lengths 3/12 vs 2/11)."""
+    tier = priority_tier(pod.priority)
+    gang = pod_gang_sig(pod)
+    suffix = () if tier == 0 and gang is None else ((tier, gang),)
     # fast path for the dominant 50k-batch shape: resource-only pods (no
     # affinity/tolerations/spread/ports/volumes). The short tuple can never
     # collide with the full 10-tuple below.
@@ -88,7 +109,7 @@ def _spec_signature(pod: Pod, label_aware: bool) -> tuple:
             tuple(sorted((pod.metadata.labels or {}).items()))
             if label_aware
             else (),
-        )
+        ) + suffix
     affinity_sig = None
     pod_aff_sig = None
     pod_anti_sig = None
@@ -124,7 +145,7 @@ def _spec_signature(pod: Pod, label_aware: bool) -> tuple:
         # placement (zone pins; attach-limit accounting on existing nodes)
         tuple(pod.volume_requirements),
         tuple(pod.volumes),
-    )
+    ) + suffix
 
 
 def group_pods(pods: Sequence[Pod], label_aware: bool = True) -> List[PodClass]:
@@ -144,6 +165,8 @@ def group_pods(pods: Sequence[Pod], label_aware: bool = True) -> List[PodClass]:
                 tolerations=tuple(pod.tolerations),
                 requests=dict(pod.resource_requests),
                 signature=(label_aware, sig),
+                tier=priority_tier(pod.priority),
+                gang=pod_gang_sig(pod),
             )
             classes[sig] = cls
         cls.pods.append(pod)

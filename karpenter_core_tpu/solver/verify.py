@@ -39,6 +39,18 @@ Checked invariants (one ``Violation`` per breach, reason-coded):
                       requirement-compatible offering outside the ICE
                       snapshot (a packing onto stocked-out capacity is a
                       guaranteed create→ICE→delete round)
+* ``eviction``      — preemption legality (gangsched, ISSUE 10): every
+                      eviction claim's victim is strictly lower tier
+                      (utils/disruption.priority_tier) than some pod its
+                      freed capacity admitted on that node; a claim that
+                      admits nothing is a dangling drain for free
+* ``eviction_unknown`` — an eviction claim naming a node outside the solve
+                      input or a uid outside that node's evictable set —
+                      the operator would drain a pod the solve never saw
+* ``gang``          — gang atomicity: a pod group is fully placed (its
+                      min-count) or fully unschedulable; a partially
+                      materialized gang deadlocks the workload while
+                      holding capacity
 
 The pass is O(pods) with per-class dedup: constraint checks depend only on
 a pod's spec equivalence class (solver/snapshot._spec_signature), so each
@@ -83,6 +95,9 @@ REASONS = (
     "anti_affinity",
     "spread",
     "offering",
+    "eviction",
+    "eviction_unknown",
+    "gang",
 )
 
 
@@ -297,6 +312,14 @@ class ResultVerifier:
                             f"{label} places unknown pod uid {p.uid!r}",
                         ))
 
+        # eviction-claim capacity credit (gangsched, ISSUE 10): placements
+        # on a node with eviction claims assume the victims' freed
+        # capacity (the operator drains before binding), so the capacity
+        # check must see it — but ONLY capacity a claim can actually free
+        # (uids resolved against the node's own evictable set; legality
+        # and unknown-uid violations are reported by _verify_gangsched)
+        ev_credit = self._eviction_credit(results)
+
         for label, group, node, group_pods, kind in groups:
             if kind == "claim" and not group_pods:
                 out.append(Violation(
@@ -305,7 +328,10 @@ class ResultVerifier:
             if kind == "claim":
                 out.extend(self._verify_claim(label, group, check_of))
             else:
-                out.extend(self._verify_existing(label, node, group, check_of))
+                out.extend(self._verify_existing(
+                    label, node, group, check_of,
+                    credit=ev_credit.get(node.name),
+                ))
 
         # conservation: exactly-once XOR reported unschedulable
         errors = results.pod_errors
@@ -336,6 +362,176 @@ class ResultVerifier:
         # the CLASS cache tells whether any spread work exists at all
         if any(c.spread_hard for c in class_cache.values()):
             out.extend(self._verify_spread(results, check_of))
+        out.extend(self._verify_gangsched(results, pods, placed))
+        return out
+
+    # -- gangsched claims (ISSUE 10) ---------------------------------------
+
+    def _eviction_credit(self, results) -> Dict[str, dict]:
+        """Per-node freed capacity from the result's eviction claims —
+        resolved against each node's OWN evictable set so a forged uid
+        can never mint capacity (it reports eviction_unknown instead)."""
+        evictions = getattr(results, "evictions", None)
+        if not evictions:
+            return {}
+        credit: Dict[str, dict] = {}
+        for node_name, uids in evictions.items():
+            node = self.existing_by_name.get(node_name)
+            if node is None:
+                continue
+            ev_by_uid = {
+                e.uid: e for e in getattr(node, "evictable", ()) or ()
+            }
+            freed = [
+                ev_by_uid[u].requests for u in uids if u in ev_by_uid
+            ]
+            if freed:
+                credit[node_name] = resutil.merge(*freed)
+        return credit
+
+    def _verify_gangsched(self, results, pods, placed) -> List[Violation]:
+        """Eviction-claim legality + gang atomicity over the final
+        assignment. Independent of the kernel: tiers re-derive through
+        utils/disruption.priority_tier (the single tier ordering all
+        three layers share) and gang membership re-derives from the pod
+        annotations (solver/gangs), not from any solver state — which is
+        also why the gang scan below runs unconditionally: any gate that
+        skipped it would have to trust the solver's own "no gangs" claim.
+        The price is one O(pods) annotation pass per verification."""
+        from karpenter_core_tpu.solver.gangs import (
+            gang_members,
+            gang_min_count,
+            pod_gang_sig,
+        )
+        from karpenter_core_tpu.utils.disruption import priority_tier
+
+        out: List[Violation] = []
+        evictions = getattr(results, "evictions", None) or {}
+        if evictions:
+            placed_on: Dict[str, list] = {}
+            for sim in results.existing_nodes:
+                placed_on.setdefault(sim.name, []).extend(sim.pods)
+            for node_name, uids in sorted(evictions.items()):
+                node = self.existing_by_name.get(node_name)
+                if node is None:
+                    out.append(Violation(
+                        "eviction_unknown",
+                        f"eviction claim targets node {node_name!r}"
+                        " outside the solve input",
+                    ))
+                    continue
+                ev_by_uid = {
+                    e.uid: e for e in getattr(node, "evictable", ()) or ()
+                }
+                admitted = placed_on.get(node_name) or []
+                max_tier = max(
+                    (priority_tier(p.priority) for p in admitted),
+                    default=None,
+                )
+                if max_tier is None:
+                    out.append(Violation(
+                        "eviction",
+                        f"eviction claim on {node_name!r} admits no placed"
+                        " pod — a drain that enables nothing",
+                    ))
+                elif max_tier <= 0:
+                    # the preemption pass serves POSITIVE tiers only: a
+                    # claim on a node whose admitted pods are all tier<=0
+                    # cannot be its output, whatever the victims' tiers —
+                    # rejects forged claims riding an all-default solve
+                    out.append(Violation(
+                        "eviction",
+                        f"eviction claim on {node_name!r} admits no"
+                        f" positive-tier pod (max tier {max_tier}) —"
+                        " preemption serves positive tiers only",
+                    ))
+                    max_tier = None  # victim checks below would be vacuous
+                for uid in uids:
+                    victim = ev_by_uid.get(uid)
+                    if victim is None:
+                        out.append(Violation(
+                            "eviction_unknown",
+                            f"eviction claim on {node_name!r} names uid"
+                            f" {uid!r} outside the node's evictable set",
+                        ))
+                        continue
+                    vt = priority_tier(victim.priority)
+                    if max_tier is not None and vt >= max_tier:
+                        out.append(Violation(
+                            "eviction",
+                            f"illegal preemption on {node_name!r}: victim"
+                            f" {uid!r} (tier {vt}) is not strictly below"
+                            f" any admitted pod (max tier {max_tier})",
+                        ))
+        members = gang_members(pods)
+        colocated = any(
+            (g := pod_gang_sig(p)) is not None and (g[2] or g[3])
+            for mp in members.values()
+            for p in mp
+        )
+        # zone / template attribution per placed pod, built only when a
+        # gang declares co-location (O(placements) otherwise skipped)
+        zone_of: Dict[int, str] = {}
+        pool_of: Dict[int, str] = {}
+        if colocated:
+            for claim in results.new_node_claims:
+                zr = claim.requirements.get(apilabels.LABEL_TOPOLOGY_ZONE)
+                zvals = zr.sorted_values() if zr is not None else []
+                for p in claim.pods:
+                    pool_of[id(p)] = claim.template.nodepool_name
+                    if len(zvals) == 1:
+                        zone_of[id(p)] = zvals[0]
+            for sim in results.existing_nodes:
+                node = self.existing_by_name.get(sim.name)
+                z = (node.labels or {}).get(
+                    apilabels.LABEL_TOPOLOGY_ZONE
+                ) if node is not None else None
+                for p in sim.pods:
+                    if z:
+                        zone_of[id(p)] = z
+        for name, mpods in sorted(members.items()):
+            bound = [p for p in mpods if placed.get(id(p), 0)]
+            min_count = gang_min_count(mpods)
+            if 0 < len(bound) < min_count:
+                out.append(Violation(
+                    "gang",
+                    f"pod group {name!r} partially materialized:"
+                    f" {len(bound)}/{len(mpods)} placed, below min-count"
+                    f" {min_count} — a gang commits whole or not at all",
+                ))
+                continue
+            if not bound:
+                continue
+            # co-location flags OR across members (collect_gangs contract)
+            same_zone = any(
+                (g := pod_gang_sig(p)) is not None and g[2] for p in mpods
+            )
+            same_tmpl = any(
+                (g := pod_gang_sig(p)) is not None and g[3] for p in mpods
+            )
+            if same_zone:
+                # soundness over completeness: only attributable members
+                # (single-valued claim zone / labeled existing node) count
+                zones = {
+                    zone_of[id(p)] for p in bound if id(p) in zone_of
+                }
+                if len(zones) > 1:
+                    out.append(Violation(
+                        "gang",
+                        f"pod group {name!r} declares same-zone but its"
+                        f" members span zones {sorted(zones)}",
+                    ))
+            if same_tmpl:
+                pools = {
+                    pool_of[id(p)] for p in bound if id(p) in pool_of
+                }
+                if len(pools) > 1:
+                    out.append(Violation(
+                        "gang",
+                        f"pod group {name!r} declares same-node-template"
+                        f" but its fresh members span templates"
+                        f" {sorted(pools)}",
+                    ))
         return out
 
     # -- per-group checks --------------------------------------------------
@@ -479,7 +675,9 @@ class ResultVerifier:
             ))
         return out
 
-    def _verify_existing(self, label, node, sim, check_of) -> List[Violation]:
+    def _verify_existing(
+        self, label, node, sim, check_of, credit=None
+    ) -> List[Violation]:
         from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
             node_daemon_pods,
         )
@@ -522,10 +720,30 @@ class ResultVerifier:
                     f"{label}: pod {p.metadata.name!r} satisfies none of"
                     " its required node-affinity terms",
                 ))
-        if not _fits_with_tolerance(totals, node.available):
+        # eviction claims free capacity on this node (drain-before-bind):
+        # the credit was resolved against the node's own evictable set
+        avail = (
+            resutil.merge(dict(node.available), credit)
+            if credit else node.available
+        )
+        if not _fits_with_tolerance(totals, avail):
             out.append(Violation(
                 "capacity",
                 f"{label} requests {resutil.to_string(totals)} exceed node"
+                f" available {resutil.to_string(dict(avail))}",
+            ))
+        elif credit and _fits_with_tolerance(totals, node.available):
+            # the claim must be LOAD-BEARING: a legitimate preemption only
+            # fires when the placements could NOT fit the ordinary free
+            # capacity (kernel and host twin both gate on it). A claim on
+            # a node whose placements fit without the freed credit drains
+            # real workload to enable nothing — the forged-claim shape a
+            # tier comparison alone cannot catch (any higher-tier pod that
+            # landed through ordinary capacity would legalize it).
+            out.append(Violation(
+                "eviction",
+                f"{label}: eviction claim is not load-bearing — placed"
+                f" requests {resutil.to_string(totals)} fit the node's own"
                 f" available {resutil.to_string(dict(node.available))}",
             ))
         if any(c.anti_terms for c, _n in class_counts.values()):

@@ -321,9 +321,38 @@ class Cluster:
                     initialized=sn.initialized(),
                     nodeclaim_name=sn.node_claim.name if sn.node_claim else "",
                     nodepool_name=sn.nodepool_name,
+                    evictable=self._evictable_on(pods),
                 )
             )
         return out
+
+    @staticmethod
+    def _evictable_on(pods) -> tuple:
+        """Bound pods a preemptive solve may evict (gangsched, ISSUE 10):
+        reschedulable non-daemonset pods, as capacity views carrying the
+        disruption-cost victim ordering. The tier-legality rule (only
+        strictly-lower tiers are evictable) is applied at USE — the kernel
+        masks by the contending class's tier — so the view is
+        priority-complete, not pre-filtered."""
+        from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import (
+            EvictablePod,
+        )
+        from karpenter_core_tpu.utils import pod as podutil
+        from karpenter_core_tpu.utils.disruption import (
+            eviction_cost,
+            priority_tier,
+        )
+
+        return tuple(
+            EvictablePod(
+                uid=p.uid,
+                priority=priority_tier(p.priority),
+                requests=resutil.requests_for_pods(p),
+                cost=eviction_cost(p),
+            )
+            for p in pods
+            if not p.is_daemonset and podutil.is_reschedulable(p)
+        )
 
     def existing_pod_triples(self) -> List[Tuple[Pod, dict, str]]:
         """(pod, node labels, node name) for topology domain counting

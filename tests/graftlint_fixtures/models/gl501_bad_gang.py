@@ -1,0 +1,27 @@
+# GL501 bad (gangsched entry): a DeviceScheduler-shaped driver builds a
+# SlotState straight from host numpy and hands it to the gang-atomic
+# SlotState jit entry (ops/gangsched.gang_solve) — nothing in its
+# dataflow ever routed through parallel.mesh placement (slot_shardings /
+# axis_sharding / gang_plane_shardings or an explicit device_put
+# sharding), so on a multi-device mesh the gang-atomic scan compiles
+# against absent shardings and silently degrades to replicated copies.
+# Lint corpus only — never imported.
+import numpy as np
+
+from karpenter_core_tpu.ops.ffd import SlotState
+from karpenter_core_tpu.ops.gangsched import gang_solve
+
+
+class DeviceScheduler:
+    def _make_gang_state(self, n_slots, k, v):
+        # every plane is host numpy: provenance {host}, never placed
+        return SlotState(
+            valmask=np.ones((n_slots, k, v), dtype=bool),
+            kind=np.zeros((n_slots,), dtype=np.int8),
+        )
+
+    def solve(self, steps, statics, gang_of_step, gang_min, n_slots, k, v):
+        state = self._make_gang_state(n_slots, k, v)
+        return gang_solve(
+            state, steps, statics, gang_of_step, gang_min, level_iters=32
+        )  # GL501

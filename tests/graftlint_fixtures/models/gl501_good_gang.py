@@ -1,0 +1,43 @@
+# GL501 good (gangsched entry): the sanctioned routing for the
+# gang-atomic solve — the SlotState is committed to the slot mesh through
+# parallel.mesh placement (slot_shardings) and the evictable-capacity
+# planes route through gang_plane_shardings before either gangsched jit
+# entry consumes them, so the SPMD solve compiles against the real
+# shardings by construction. Lint corpus only — never imported.
+import jax
+import numpy as np
+
+from karpenter_core_tpu.ops.ffd import SlotState
+from karpenter_core_tpu.ops.gangsched import gang_solve, preempt_pass
+from karpenter_core_tpu.parallel import mesh as pmesh
+
+
+class DeviceScheduler:
+    def __init__(self, mesh, n_slots):
+        self._mesh = mesh
+        self._n_slots = n_slots
+
+    def _make_gang_state(self, n_slots, k, v):
+        host = SlotState(
+            valmask=np.ones((n_slots, k, v), dtype=bool),
+            kind=np.zeros((n_slots,), dtype=np.int8),
+        )
+        return jax.device_put(
+            host, pmesh.slot_shardings(self._mesh, host, self._n_slots)
+        )
+
+    def solve(self, steps, statics, gang_of_step, gang_min, n_slots, k, v):
+        state = self._make_gang_state(n_slots, k, v)
+        return gang_solve(
+            state, steps, statics, gang_of_step, gang_min, level_iters=32
+        )
+
+    def preempt(self, steps, statics, tiers, gangs, unplaced, ev, n, k, v):
+        state = self._make_gang_state(n, k, v)
+        planes = jax.device_put(
+            ev, pmesh.gang_plane_shardings(self._mesh, ev, self._n_slots)
+        )
+        return preempt_pass(
+            state, steps, statics, tiers, gangs, unplaced, planes,
+            node_rounds=8,
+        )

@@ -58,3 +58,16 @@ def test_fast_bench_emits_well_formed_json():
     # slots touched on device can exceed final claims (sparse-tail repack
     # drops empty claims) but never undershoot them
     assert phases["used_slots"] >= primary["nodes"] > 0
+
+    # the tiny cfg11 gangsched smoke (ISSUE 10): preemption fired, every
+    # gang stayed atomic, and the eviction set stayed minimal
+    gangs = line["detail"]["cfg11_gangs"]
+    for key in ("p50_solve_s", "preemption_count", "eviction_minimality",
+                "gangs", "gangs_placed", "gang_atomicity_violations",
+                "unschedulable", "p50_vs_cfg1"):
+        assert key in gangs, key
+    assert gangs["preemption_count"] > 0
+    assert gangs["gang_atomicity_violations"] == 0
+    assert gangs["gang_atomicity_ok"] is True
+    assert gangs["eviction_minimality_ok"] is True
+    assert gangs["gangs_placed"] > 0

@@ -144,6 +144,9 @@ def sample_volume_usage() -> VolumeUsage:
 
 def sample_sim_node(name="existing-0") -> SimNode:
     from karpenter_core_tpu.api.objects import Taint
+    from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import (
+        EvictablePod,
+    )
 
     return SimNode(
         name=name,
@@ -156,6 +159,20 @@ def sample_sim_node(name="existing-0") -> SimNode:
         nodeclaim_name="claim-0",
         nodepool_name="default",
         volume_usage=sample_volume_usage(),
+        # gangsched: the evictable-capacity view rides the solve wire, in
+        # the encoder's canonical (cost, uid) order so the round-trip is
+        # an exact deep-equality (the encoder sorts; relist order is not
+        # part of the wire)
+        evictable=(
+            EvictablePod(
+                uid="victim-2", priority=-5,
+                requests={"cpu": 1.0, "memory": 1.0 * 2**30}, cost=0.25,
+            ),
+            EvictablePod(
+                uid="victim-1", priority=0,
+                requests={"cpu": 0.5}, cost=1.0,
+            ),
+        ),
     )
 
 
@@ -241,7 +258,7 @@ _WIRE_FIELDS = {
     SimNode: {
         "name", "labels", "taints", "available", "capacity",
         "daemon_requests", "initialized", "nodeclaim_name",
-        "nodepool_name", "volume_usage",
+        "nodepool_name", "volume_usage", "evictable",
     },
     InstanceType: {"name", "requirements", "offerings", "capacity", "overhead"},
     Offering: {"requirements", "price", "available"},
@@ -351,6 +368,28 @@ def test_solve_request_roundtrip_field_for_field():
     its = decoded["instance_types"]
     assert its["batch"][0] is its["default"][0]
     assert its["batch"][1] is its["default"][1]
+
+
+def test_evictable_priority_clamps_at_the_decode_net():
+    """A hostile/corrupt wire priority far past int32 must clamp at decode
+    (utils/disruption.priority_tier — the legitimate encoder side already
+    ships a tier): unclamped it would overflow the int32 EvPlanes tensor
+    INSIDE the exclusive device window, a crash charged as poison where a
+    cheap rejection belongs."""
+    from karpenter_core_tpu.utils.disruption import priority_tier
+
+    problem = sample_problem()
+    data = codec.encode_solve_request(**problem)
+    header = codec._json_header(data)
+    ev = header["existing_nodes"][0]["evictable"]
+    assert ev, "sample node lost its evictable view"
+    ev[0]["priority"] = 10**18
+    decoded = codec.decode_solve_request(codec._json_payload(header))
+    prio = decoded["existing_nodes"][0].evictable[0].priority
+    assert prio == priority_tier(10**18)
+    import numpy as np
+
+    np.full((1,), prio, dtype=np.int32)  # the EvPlanes store must not raise
 
 
 def test_solve_request_wire_bytes_are_canonical():

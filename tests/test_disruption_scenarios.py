@@ -249,7 +249,9 @@ class TestDisruptionCost:
         assert disutil.eviction_cost(hi) == 2.0
         vast = make_pod(cpu=1.0)
         vast.priority = 10**10
-        assert disutil.eviction_cost(vast) == 10.0  # clamped (+-10)
+        # priority term clamps to +8 (base 1.0 -> 9.0), leaving headroom
+        # under the 10.0 ceiling so deletion costs still order critical pods
+        assert disutil.eviction_cost(vast) == 9.0
 
     def test_expiring_soon_costs_less(self):
         from karpenter_core_tpu.api.duration import NillableDuration

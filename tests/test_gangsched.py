@@ -1,0 +1,1148 @@
+"""gangsched (ISSUE 10): priority-preemptive packing and gang-atomic
+placement as first-class solver scenarios.
+
+Six layers of proof:
+
+* units — the pod-group annotation contract (solver/gangs), the canonical
+  priority tier and the eviction-cost clamp regression (the 2^25 priority
+  term used to saturate the documented [-10, 10] contract for any
+  PriorityClass >= ~3e8, erasing the deletion-cost ordering among
+  critical pods), and the snapshot class split on tier/gang;
+* off-by-default parity — problems with no priorities and no gangs never
+  dispatch a gang kernel and produce BYTE-IDENTICAL result wires with the
+  gangsched preparation surgically disabled, on the single-device path,
+  the conftest-forced 8-device virtual mesh, and the batched driver;
+* preemption — a critical pod that fits no fresh instance is admitted
+  onto a full existing node by evicting the minimal-cost prefix of
+  strictly-lower-tier bound pods; claims come back on the result wire,
+  the verifier accepts, and the 8-device mesh reproduces the identical
+  eviction set;
+* gang atomicity — a gang that cannot reach its min-count rolls back ON
+  DEVICE (the freed capacity is reused by gang-free pods in the same
+  solve), min-count commits partial-above-min placements, same-zone and
+  same-node-template co-location hold, and the batched driver keeps gang
+  problems out of plain problems' vmap batches (distinct shape keys and
+  codec buckets) while still coalescing same-shaped gang problems;
+* verifier mutations — forged eviction of an equal-tier victim, a claim
+  naming an unknown uid or node, a dangling claim that admits nothing,
+  and a partially-materialized gang each reject with their own typed
+  reason riding solver_result_rejected_total{reason};
+* end-to-end — the operator executes eviction claims as drain-before-bind
+  (victims evicted, Preempted events, critical bound, victims reschedule)
+  and gang atomicity holds through the seeded chaos harness and a real
+  sidecar murder (greedy degradation preserves the semantics).
+"""
+from __future__ import annotations
+
+import copy
+
+import pytest
+
+from tests.helpers import GIB, make_nodepool, make_pod
+from tests.test_e2e import new_operator, replicated
+from tests.test_soak import assert_coherent
+
+from karpenter_core_tpu.api import labels as L
+from karpenter_core_tpu.api.objects import NodeSelectorRequirement
+from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import (
+    EvictablePod,
+    SimNode,
+)
+from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+    Scheduler,
+)
+from karpenter_core_tpu.metrics import wiring as m
+from karpenter_core_tpu.models.provisioner import DeviceScheduler, solve_batch
+from karpenter_core_tpu.solver import codec
+from karpenter_core_tpu.solver import gangs as gangmod
+from karpenter_core_tpu.solver import verify as verifymod
+from karpenter_core_tpu.solver.gangs import (
+    GANG_ANNOTATION,
+    GANG_MIN_SIZE_ANNOTATION,
+    GANG_SAME_TEMPLATE_ANNOTATION,
+    GANG_SAME_ZONE_ANNOTATION,
+    collect_gangs,
+    gang_min_count,
+    pod_gang_sig,
+)
+from karpenter_core_tpu.solver.snapshot import group_pods
+from karpenter_core_tpu.solver.verify import ResultVerifier
+from karpenter_core_tpu.utils.disruption import (
+    eviction_cost,
+    priority_tier,
+)
+
+SYSTEM_CLUSTER_CRITICAL = 2_000_000_000
+NODE_LABELS = {
+    L.LABEL_TOPOLOGY_ZONE: "zone-a",
+    L.LABEL_OS: "linux",
+    L.LABEL_ARCH: "amd64",
+    L.CAPACITY_TYPE_LABEL_KEY: "on-demand",
+    L.NODEPOOL_LABEL_KEY: "default",
+}
+
+
+def gang_pod(name, gang, cpu=1.0, memory_gib=0.5, min_size=None,
+             same_zone=False, same_template=False, priority=0, **kw):
+    p = make_pod(cpu=cpu, memory_gib=memory_gib, name=name, **kw)
+    p.priority = priority
+    p.metadata.annotations[GANG_ANNOTATION] = gang
+    if min_size is not None:
+        p.metadata.annotations[GANG_MIN_SIZE_ANNOTATION] = str(min_size)
+    if same_zone:
+        p.metadata.annotations[GANG_SAME_ZONE_ANNOTATION] = "true"
+    if same_template:
+        p.metadata.annotations[GANG_SAME_TEMPLATE_ANNOTATION] = "true"
+    return p
+
+
+def full_node(name="exist-0", available_cpu=0.5, victims=4,
+              victim_cpu=3.0, victim_tier=0):
+    """An existing node with scarce headroom and a cost-ordered evictable
+    population (cost ascending with the index, so the minimal-cost prefix
+    is victims[0:k])."""
+    return SimNode(
+        name=name,
+        labels={**NODE_LABELS, L.LABEL_HOSTNAME: name},
+        taints=[],
+        available={"cpu": available_cpu, "memory": 8 * GIB, "pods": 100.0},
+        capacity={"cpu": 16.0, "memory": 16 * GIB, "pods": 110.0},
+        initialized=True,
+        evictable=tuple(
+            EvictablePod(
+                uid=f"victim-{i}",
+                priority=victim_tier,
+                requests={"cpu": victim_cpu, "memory": 0.5 * GIB},
+                cost=1.0 + 0.1 * i,
+            )
+            for i in range(victims)
+        ),
+    )
+
+
+def small_catalog():
+    """Fresh nodes top out at 2 cpu: any larger pod can only place through
+    preemption on an existing node."""
+    return build_catalog(cpu_grid=[1, 2])
+
+
+def _wire(results):
+    # solve_seconds is timing, not packing: pin it so wire comparison is
+    # exact over the decision content
+    return codec.encode_solve_results(results, 0.0)
+
+
+def _scheduler(pools, catalog, existing=(), devices=1, max_slots=64):
+    return DeviceScheduler(
+        pools, {p.name: list(catalog) for p in pools},
+        existing_nodes=list(existing), max_slots=max_slots, devices=devices,
+    )
+
+
+# ---------------------------------------------------------------------------
+# units: annotation contract, tiers, eviction-cost clamp
+# ---------------------------------------------------------------------------
+
+
+class TestAnnotationContract:
+    def test_gang_free_pod_has_no_signature(self):
+        assert pod_gang_sig(make_pod(cpu=1.0, name="plain")) is None
+
+    def test_signature_components(self):
+        p = gang_pod("a", "job-1", min_size=3, same_zone=True)
+        assert pod_gang_sig(p) == ("job-1", 3, True, False)
+
+    def test_garbage_min_size_defaults_to_whole_group(self):
+        p = gang_pod("a", "job-1")
+        p.metadata.annotations[GANG_MIN_SIZE_ANNOTATION] = "not-a-number"
+        assert pod_gang_sig(p) == ("job-1", 0, False, False)
+        assert gang_min_count([p, gang_pod("b", "job-1")]) == 2
+
+    def test_min_count_resolves_largest_declared_capped_at_size(self):
+        pods = [gang_pod(f"p{i}", "j", min_size=s)
+                for i, s in enumerate((2, 5, 0))]
+        # declared max (5) exceeds the group size (3) -> the full group
+        assert gang_min_count(pods) == 3
+        pods = [gang_pod(f"q{i}", "j", min_size=2) for i in range(4)]
+        assert gang_min_count(pods) == 2
+
+    def test_collect_gangs_ors_colocation_and_sums_members(self):
+        pods = (
+            [gang_pod(f"a{i}", "alpha", cpu=1.0) for i in range(3)]
+            + [gang_pod("a-big", "alpha", cpu=2.0, same_zone=True)]
+            + [gang_pod("b0", "beta", cpu=1.0, min_size=1)]
+            + [make_pod(cpu=1.0, name="plain")]
+        )
+        classes = group_pods(pods)
+        gangs = {g.name: g for g in collect_gangs(classes)}
+        assert set(gangs) == {"alpha", "beta"}
+        alpha = gangs["alpha"]
+        # same_zone=True on one member binds the gang, members span the
+        # (1cpu x plain) and (2cpu x same-zone) classes
+        assert alpha.same_zone and not alpha.same_template
+        assert alpha.total == 4 and alpha.min_count == 4
+        assert len(alpha.class_indices) == 2
+        assert gangs["beta"].min_count == 1
+
+
+class TestPriorityTier:
+    def test_unset_and_garbage_are_tier_zero(self):
+        assert priority_tier(None) == 0
+        assert priority_tier(0) == 0
+        assert priority_tier("garbage") == 0
+
+    def test_value_is_the_tier_clamped_to_int32(self):
+        assert priority_tier(100) == 100
+        assert priority_tier(-7) == -7
+        assert priority_tier(SYSTEM_CLUSTER_CRITICAL) == SYSTEM_CLUSTER_CRITICAL
+        assert priority_tier(2**40) == 2**31 - 1
+
+    def test_eviction_cost_clamp_regression(self):
+        """ISSUE 10 satellite: the raw priority/2^25 term saturated the
+        documented [-10, 10] contract for any PriorityClass >= ~3e8 —
+        system-cluster-critical (2e9) landed at 59.6 pre-clamp, so two
+        critical pods with different pod-deletion-cost annotations costed
+        identically. Per-term clamps (deletion +-1, priority +-8) keep the
+        2^-27-scale deletion term a live tiebreak on BOTH signs: a single
+        +-9 priority clamp still parked critical pods at the 10.0 ceiling,
+        erasing positive deletion costs."""
+        from karpenter_core_tpu.utils.disruption import (
+            POD_DELETION_COST_ANNOTATION,
+        )
+
+        def crit(name, deletion_cost):
+            p = make_pod(cpu=1.0, name=name)
+            p.priority = SYSTEM_CLUSTER_CRITICAL
+            p.metadata.annotations[POD_DELETION_COST_ANNOTATION] = str(
+                deletion_cost
+            )
+            return p
+
+        ladder = [crit(f"crit-{i}", dc) for i, dc in enumerate(
+            [-1000000, 1000000, 2000000]  # mixed AND positive-vs-positive
+        )]
+        costs = [eviction_cost(p) for p in ladder]
+        assert costs == sorted(costs) and len(set(costs)) == 3, (
+            f"deletion-cost ordering erased among critical pods: {costs}"
+        )
+        assert all(-10.0 <= c <= 10.0 for c in costs)
+
+    def test_victim_order_is_cost_within_legal_tiers(self):
+        """The victim ordering contract both halves share: eligibility is
+        tier-based (strictly lower only), selection within the eligible
+        set is (cost, uid) — NOT tier-then-cost. A dear low-tier pod is
+        passed over for a cheap slightly-higher (still legal) one."""
+        from karpenter_core_tpu.utils.disruption import (
+            POD_DELETION_COST_ANNOTATION,
+        )
+
+        dear = make_pod(cpu=1.0, name="low-dear")
+        dear.metadata.annotations[POD_DELETION_COST_ANNOTATION] = "100000000"
+        cheap = make_pod(cpu=1.0, name="mid-cheap")
+        cheap.priority = 5
+        assert eviction_cost(cheap) < eviction_cost(dear)
+
+
+class TestSnapshotSplit:
+    def test_priority_splits_classes(self):
+        a = make_pod(cpu=1.0, name="a")
+        b = make_pod(cpu=1.0, name="b")
+        b.priority = 100
+        classes = group_pods([a, b])
+        assert len(classes) == 2
+        assert sorted(c.tier for c in classes) == [0, 100]
+
+    def test_gang_splits_classes(self):
+        a = make_pod(cpu=1.0, name="a")
+        b = gang_pod("b", "job-1", cpu=1.0, memory_gib=1.0)
+        classes = group_pods([a, b])
+        assert len(classes) == 2
+        gangs = [c.gang for c in classes]
+        assert None in gangs and ("job-1", 0, False, False) in gangs
+
+    def test_default_pods_share_the_pre_gang_signature(self):
+        """The off-by-default contract's root: a default-tier gang-free
+        pod's signature (hence every prepared-cache key derived from it)
+        carries NO gangsched suffix."""
+        a = make_pod(cpu=1.0, name="a")
+        b = make_pod(cpu=1.0, name="b")
+        b.priority = 0  # explicitly default
+        classes = group_pods([a, b])
+        assert len(classes) == 1
+        assert classes[0].tier == 0 and classes[0].gang is None
+        # fast-path signature stays the pre-gang 3-tuple shape
+        (label_aware, sig) = classes[0].signature
+        assert not any(
+            isinstance(part, tuple) and len(part) == 2
+            and isinstance(part[0], int) and part[0] != 0
+            for part in sig[-1:]
+        )
+
+
+# ---------------------------------------------------------------------------
+# off-by-default parity
+# ---------------------------------------------------------------------------
+
+
+def _neutralized(monkeypatch):
+    """Surgically disable every gangsched hook — the closest in-process
+    stand-in for 'main before this PR'. Plain problems must not be able to
+    tell the difference, byte for byte."""
+    monkeypatch.setattr(
+        DeviceScheduler, "_prepare_gangsched",
+        lambda self, prep, plan, entry, N: None,
+    )
+    monkeypatch.setattr(gangmod, "has_gangsched", lambda pods: False)
+
+
+def _forbid_gang_kernels(monkeypatch):
+    from karpenter_core_tpu.ops import gangsched as gops
+
+    def boom(*a, **k):
+        raise AssertionError("gang kernel dispatched on a plain problem")
+
+    for entry in ("gang_solve", "gang_solve_donated", "gang_solve_batched",
+                  "gang_solve_batched_donated", "preempt_pass",
+                  "preempt_pass_batched"):
+        monkeypatch.setattr(gops, entry, boom)
+
+
+def _plain_problem(n=40):
+    pods = [
+        make_pod(cpu=0.25 * (1 + i % 4), memory_gib=0.5 * (1 + i % 3),
+                 name=f"p{i}")
+        for i in range(n)
+    ]
+    return [make_nodepool()], build_catalog()[:16], pods
+
+
+class TestOffByDefaultParity:
+    @pytest.mark.parametrize("devices", [1, 8])
+    def test_plain_problem_byte_identical_wire(self, devices, monkeypatch):
+        pools, catalog, pods = _plain_problem()
+        existing = [full_node(victims=0)]
+        live = _scheduler(pools, catalog, existing, devices=devices).solve(
+            copy.deepcopy(pods)
+        )
+        wire_live = _wire(live)
+
+        _neutralized(monkeypatch)
+        _forbid_gang_kernels(monkeypatch)
+        off = _scheduler(pools, catalog, existing, devices=devices).solve(
+            copy.deepcopy(pods)
+        )
+        assert wire_live == _wire(off)
+        # and the wire carries no eviction key at all (pre-gang decoders
+        # would parse it unchanged)
+        assert b"evictions" not in wire_live
+
+    def test_plain_problem_never_dispatches_gang_kernels(self, monkeypatch):
+        _forbid_gang_kernels(monkeypatch)
+        pools, catalog, pods = _plain_problem()
+        res = _scheduler(pools, catalog).solve(pods)
+        assert not res.pod_errors and not res.evictions
+
+    def test_plain_batched_path_byte_identical(self, monkeypatch):
+        """The batched driver on plain problems is equally gangsched-blind:
+        solo wire == batched wire with the hooks disabled."""
+        pools_a, catalog, pods_a = _plain_problem(24)
+        solo = _wire(_scheduler(pools_a, catalog).solve(
+            copy.deepcopy(pods_a)
+        ))
+        _neutralized(monkeypatch)
+        _forbid_gang_kernels(monkeypatch)
+        pools_b, _, pods_b = _plain_problem(24)
+        outcomes, stats = solve_batch([
+            (_scheduler(pools_a, catalog), copy.deepcopy(pods_a)),
+            (_scheduler(pools_b, catalog), copy.deepcopy(pods_b)),
+        ])
+        assert [k for k, _ in outcomes] == ["ok", "ok"]
+        assert stats["batched_problems"] == 2  # same shapes still coalesce
+        assert _wire(outcomes[0][1]) == solo
+
+
+# ---------------------------------------------------------------------------
+# preemption
+# ---------------------------------------------------------------------------
+
+
+def preemption_problem():
+    pools = [make_nodepool()]
+    catalog = small_catalog()
+    existing = [full_node()]
+    crit = make_pod(cpu=8.0, memory_gib=1.0, name="critical")
+    crit.priority = SYSTEM_CLUSTER_CRITICAL
+    return pools, catalog, existing, [crit]
+
+
+class TestPreemption:
+    def test_minimal_cost_eviction_set_admits_the_critical_pod(self):
+        pools, catalog, existing, pods = preemption_problem()
+        rejected = dict(m.SOLVER_RESULT_REJECTED.values)
+        res = _scheduler(pools, catalog, existing).solve(pods)
+        assert not res.pod_errors
+        # needs 8 - 0.5 = 7.5 cpu freed; victims carry 3.0 each, cost
+        # ascending -> the minimal-cost sufficient prefix is exactly the 3
+        # cheapest of the 4
+        assert res.evictions == {
+            "exist-0": ["victim-0", "victim-1", "victim-2"]
+        }
+        assert [p.name for s in res.existing_nodes for p in s.pods] == [
+            "critical"
+        ]
+        # the production verifier accepted (no rejection counter movement)
+        assert dict(m.SOLVER_RESULT_REJECTED.values) == rejected
+
+    def test_sharded_mesh_reproduces_the_identical_claims(self):
+        pools, catalog, existing, pods = preemption_problem()
+        solo = _scheduler(pools, catalog, existing).solve(
+            copy.deepcopy(pods)
+        )
+        sharded = _scheduler(pools, catalog, existing, devices=8).solve(
+            copy.deepcopy(pods)
+        )
+        assert _wire(solo) == _wire(sharded)
+        assert sharded.evictions == solo.evictions
+
+    def test_equal_tier_population_is_not_evictable(self):
+        pools, catalog, _, pods = preemption_problem()
+        existing = [full_node(victim_tier=SYSTEM_CLUSTER_CRITICAL)]
+        res = _scheduler(pools, catalog, existing).solve(pods)
+        # nothing strictly lower -> no preemption, pod unschedulable
+        assert not res.evictions
+        assert len(res.pod_errors) == 1
+
+    def test_negative_tier_pending_pod_does_not_preempt(self):
+        pools, catalog, existing, _ = preemption_problem()
+        low = make_pod(cpu=8.0, memory_gib=1.0, name="low")
+        low.priority = -5  # below the k8s default; victims are tier 0
+        res = _scheduler(pools, catalog, existing).solve([low])
+        assert not res.evictions and len(res.pod_errors) == 1
+
+    def test_gang_members_never_preempt(self):
+        """Documented interplay limit: the preemption pass serves gang-FREE
+        classes only (a preempted gang member would bypass the in-kernel
+        co-location state)."""
+        pools, catalog, existing, _ = preemption_problem()
+        member = gang_pod("g0", "job-g", cpu=8.0, memory_gib=1.0,
+                          priority=SYSTEM_CLUSTER_CRITICAL)
+        res = _scheduler(pools, catalog, existing).solve([member])
+        assert not res.evictions and len(res.pod_errors) == 1
+
+    def test_fallback_straddling_gang_member_never_preempts(self):
+        """A gang with one member forced host-fallback (non-trivial spread
+        node filter) is kernel-excluded — but its DEVICE members are still
+        gang members: the preemption pass must not evict real workload to
+        place a pod the atomicity backstop may strip."""
+        pools, catalog, existing, _ = preemption_problem()
+        # device-class member: only placeable through preemption
+        big = gang_pod("gs-big", "job-s", cpu=8.0, memory_gib=1.0,
+                       priority=SYSTEM_CLUSTER_CRITICAL)
+        # fallback-forcing member: zone spread + zone pin = non-trivial
+        # spread node filter, a host-only group (topoplan fallback)
+        small = gang_pod("gs-small", "job-s", cpu=0.5, memory_gib=0.5,
+                         priority=SYSTEM_CLUSTER_CRITICAL,
+                         spread_zone=True, zone_in=["zone-a"])
+        res = _scheduler(pools, catalog, existing).solve([big, small])
+        assert not res.evictions
+        # atomicity holds degraded: the whole gang is unschedulable
+        assert set(res.pod_errors) == {big.uid, small.uid}
+
+    def test_batched_driver_preempts_with_solo_parity(self):
+        """Two same-shaped preemption problems ride one vmapped dispatch
+        pair (solve + preempt) and each reproduces its solo wire."""
+        pools, catalog, existing, pods = preemption_problem()
+        solo = _wire(_scheduler(pools, catalog, existing).solve(
+            copy.deepcopy(pods)
+        ))
+        outcomes, stats = solve_batch([
+            (_scheduler(pools, catalog, existing), copy.deepcopy(pods)),
+            (_scheduler(pools, catalog, existing), copy.deepcopy(pods)),
+        ])
+        assert [k for k, _ in outcomes] == ["ok", "ok"]
+        assert stats["batched_problems"] >= 2
+        assert _wire(outcomes[0][1]) == solo
+        assert _wire(outcomes[1][1]) == solo
+
+
+# ---------------------------------------------------------------------------
+# gang atomicity
+# ---------------------------------------------------------------------------
+
+
+class TestGangAtomicity:
+    def test_failed_gang_rolls_back_and_frees_capacity_on_device(self):
+        """A 3x4cpu gang over 9 available cpu (no fresh fits) cannot reach
+        min-count: every member reports unschedulable AND the two slots it
+        transiently held serve gang-free pods in the SAME solve — the
+        rollback happened on device, not by post-hoc stripping."""
+        pools = [make_nodepool()]
+        catalog = small_catalog()
+        node = full_node(available_cpu=9.0, victims=0)
+        gang = [gang_pod(f"g{i}", "job-a", cpu=4.0) for i in range(3)]
+        fillers = [make_pod(cpu=4.0, memory_gib=0.5, name=f"f{i}")
+                   for i in range(2)]
+        rejected = dict(m.SOLVER_RESULT_REJECTED.values)
+        res = _scheduler(pools, catalog, [node]).solve(gang + fillers)
+        assert set(res.pod_errors) == {p.uid for p in gang}
+        placed = [p.name for s in res.existing_nodes for p in s.pods]
+        assert sorted(placed) == ["f0", "f1"]
+        assert dict(m.SOLVER_RESULT_REJECTED.values) == rejected
+
+    def test_min_count_commits_partial_above_min(self):
+        pools = [make_nodepool()]
+        catalog = small_catalog()
+        node = full_node(available_cpu=9.0, victims=0)
+        gang = [gang_pod(f"g{i}", "job-a", cpu=4.0, min_size=2)
+                for i in range(3)]
+        res = _scheduler(pools, catalog, [node]).solve(gang)
+        assert len(res.pod_errors) == 1  # 2 of 3 placed >= min 2
+        placed = [p.name for s in res.existing_nodes for p in s.pods]
+        assert len(placed) == 2
+
+    def test_whole_gang_unschedulable_metric_moves(self):
+        pools = [make_nodepool()]
+        catalog = small_catalog()
+        node = full_node(available_cpu=9.0, victims=0)
+        gang = [gang_pod(f"g{i}", "job-a", cpu=4.0) for i in range(3)]
+        before = m.SOLVER_GANG_UNSCHEDULABLE.value()
+        _scheduler(pools, catalog, [node]).solve(gang)
+        assert m.SOLVER_GANG_UNSCHEDULABLE.value() == before + 1
+
+    def test_same_zone_gang_follows_the_pinned_member(self):
+        """One member zone-pinned to zone-b drags the whole gang there —
+        the synthetic zone-affinity group in action."""
+        pool = make_nodepool(requirements=[NodeSelectorRequirement(
+            L.LABEL_TOPOLOGY_ZONE, "In", ("zone-a", "zone-b", "zone-c"),
+        )])
+        pods = [
+            gang_pod(f"z{i}", "job-z", cpu=1.0, same_zone=True,
+                     **({"zone_in": ["zone-b"]} if i == 0 else {}))
+            for i in range(4)
+        ]
+        rejected = dict(m.SOLVER_RESULT_REJECTED.values)
+        res = _scheduler([pool], small_catalog()).solve(pods)
+        assert not res.pod_errors
+        zones = set()
+        for c in res.new_node_claims:
+            zr = c.requirements.get(L.LABEL_TOPOLOGY_ZONE)
+            assert zr is not None
+            zones.update(zr.sorted_values())
+        assert zones == {"zone-b"}
+        assert dict(m.SOLVER_RESULT_REJECTED.values) == rejected
+
+    def test_same_template_gang_lands_on_one_nodepool(self):
+        """Two pools at different weights; a same-template gang whose
+        members individually prefer different pools must resolve to ONE
+        (the joint template mask AND-reduces viability before
+        first-template-wins)."""
+        heavy = make_nodepool(name="heavy", weight=10, requirements=[
+            NodeSelectorRequirement(L.LABEL_ARCH, "In", ("amd64",)),
+        ])
+        light = make_nodepool(name="light")
+        catalog = small_catalog()
+        pods = [
+            gang_pod(f"t{i}", "job-t", cpu=1.0, same_template=True)
+            for i in range(4)
+        ]
+        sched = DeviceScheduler(
+            [heavy, light],
+            {"heavy": list(catalog), "light": list(catalog)},
+            max_slots=64,
+        )
+        res = sched.solve(pods)
+        assert not res.pod_errors
+        pools_used = {
+            c.requirements.get(L.NODEPOOL_LABEL_KEY).sorted_values()[0]
+            for c in res.new_node_claims if c.pods
+        }
+        assert len(pools_used) == 1
+
+    def test_same_zone_flag_on_one_member_binds_the_whole_gang(self):
+        """Co-location flags OR across members (collect_gangs contract):
+        the zone-pinned member declares NOTHING — the other members'
+        same_zone flag must still drag the whole gang to its zone."""
+        pool = make_nodepool(requirements=[NodeSelectorRequirement(
+            L.LABEL_TOPOLOGY_ZONE, "In", ("zone-a", "zone-b", "zone-c"),
+        )])
+        pods = [gang_pod("z0", "job-z", cpu=1.0, zone_in=["zone-b"])] + [
+            gang_pod(f"z{i}", "job-z", cpu=1.0, same_zone=True)
+            for i in range(1, 4)
+        ]
+        res = _scheduler([pool], small_catalog()).solve(pods)
+        assert not res.pod_errors
+        zones = set()
+        for c in res.new_node_claims:
+            zr = c.requirements.get(L.LABEL_TOPOLOGY_ZONE)
+            assert zr is not None
+            zones.update(zr.sorted_values())
+        assert zones == {"zone-b"}, zones
+
+    def test_same_template_flag_on_one_member_binds_the_whole_gang(self):
+        """One member pool-pinned WITHOUT the flag, another member flagged
+        same_template: the OR-resolved gang must land on one pool."""
+        heavy = make_nodepool(name="heavy", weight=10)
+        light = make_nodepool(name="light")
+        catalog = small_catalog()
+        pods = [
+            gang_pod("t0", "job-t", cpu=1.0, same_template=True),
+            gang_pod("t1", "job-t", cpu=1.0,
+                     node_selector={L.NODEPOOL_LABEL_KEY: "light"}),
+        ]
+        sched = DeviceScheduler(
+            [heavy, light],
+            {"heavy": list(catalog), "light": list(catalog)},
+            max_slots=64,
+        )
+        res = sched.solve(pods)
+        assert not res.pod_errors
+        pools_used = {
+            c.requirements.get(L.NODEPOOL_LABEL_KEY).sorted_values()[0]
+            for c in res.new_node_claims if c.pods
+        }
+        assert pools_used == {"light"}, pools_used
+
+    def test_gang_joint_templates_mask_unit(self):
+        import numpy as np
+
+        from karpenter_core_tpu.ops import masks as mops
+
+        tmpl_ok = np.array([
+            [True, True, False],
+            [False, True, True],
+            [True, False, True],
+        ])
+        gang_id = np.array([0, 0, -1], dtype=np.int32)
+        out = np.asarray(mops.gang_joint_templates(
+            tmpl_ok, gang_id, num_gangs=1
+        ))
+        # gang members 0/1 AND-reduce to their common template (1);
+        # the gang-free class 2 passes through untouched
+        assert out.tolist() == [
+            [False, True, False],
+            [False, True, False],
+            [True, False, True],
+        ]
+
+
+# ---------------------------------------------------------------------------
+# batching seams: buckets and shape keys
+# ---------------------------------------------------------------------------
+
+
+class TestBatchingSeams:
+    def _bucket_for(self, pods):
+        data = codec.encode_solve_request(
+            [make_nodepool()], {"default": build_catalog()[:4]},
+            [], [], pods, max_slots=64,
+        )
+        return codec.decode_solve_request(data)["bucket"]
+
+    def test_problem_bucket_splits_gangs_and_tiers(self):
+        plain = [make_pod(cpu=1.0, name="a")]
+        ganged = [gang_pod("a", "job-1", cpu=1.0)]
+        # tiers-ACTIVE is the shape-relevant bit (step-tier rows attach
+        # exactly when any tier is non-zero), so even an all-one-tier
+        # problem splits from the plain bucket; two active-tier problems
+        # with the same distinct-tier count still share one
+        one_tier = [make_pod(cpu=1.0, name="a")]
+        one_tier[0].priority = 100
+        other_tier = [make_pod(cpu=1.0, name="a")]
+        other_tier[0].priority = -7
+        b_plain, b_gang, b_one, b_other = (
+            self._bucket_for(plain), self._bucket_for(ganged),
+            self._bucket_for(one_tier), self._bucket_for(other_tier),
+        )
+        assert b_one == b_other  # values don't ride the bucket, count does
+        assert len({b_plain, b_gang, b_one}) == 3
+
+    def test_evictable_capacity_splits_the_bucket(self):
+        pods = [make_pod(cpu=1.0, name="a")]
+        bare = codec.decode_solve_request(codec.encode_solve_request(
+            [make_nodepool()], {"default": build_catalog()[:4]},
+            [full_node(victims=0)], [], pods, max_slots=64,
+        ))["bucket"]
+        armed = codec.decode_solve_request(codec.encode_solve_request(
+            [make_nodepool()], {"default": build_catalog()[:4]},
+            [full_node(victims=2)], [], pods, max_slots=64,
+        ))["bucket"]
+        assert bare != armed
+
+    def test_mixed_gang_plain_batch_never_coalesces_but_stays_correct(self):
+        """ISSUE 10 satellite: a gang problem and a plain problem of
+        identical pod shapes land in ONE solve_batch call, are never
+        vmapped together (distinct kernel shape keys), and each yields its
+        solo result wire byte-for-byte."""
+        pools_g = [make_nodepool()]
+        pools_p = [make_nodepool()]
+        catalog = small_catalog()
+        node_g = full_node(name="exist-g", available_cpu=9.0, victims=0)
+        node_p = full_node(name="exist-p", available_cpu=9.0, victims=0)
+        gang = [gang_pod(f"g{i}", "job-a", cpu=4.0) for i in range(2)]
+        plain = [make_pod(cpu=4.0, memory_gib=0.5, name=f"p{i}")
+                 for i in range(2)]
+        solo_g = _wire(_scheduler(pools_g, catalog, [node_g]).solve(
+            copy.deepcopy(gang)
+        ))
+        solo_p = _wire(_scheduler(pools_p, catalog, [node_p]).solve(
+            copy.deepcopy(plain)
+        ))
+        outcomes, stats = solve_batch([
+            (_scheduler(pools_g, catalog, [node_g]), copy.deepcopy(gang)),
+            (_scheduler(pools_p, catalog, [node_p]), copy.deepcopy(plain)),
+        ])
+        assert [k for k, _ in outcomes] == ["ok", "ok"]
+        assert stats["batched_problems"] == 0, (
+            "a gang problem coalesced into a plain problem's vmap batch"
+        )
+        assert _wire(outcomes[0][1]) == solo_g
+        assert _wire(outcomes[1][1]) == solo_p
+
+    def test_same_shaped_gang_problems_do_coalesce(self):
+        pools_a = [make_nodepool()]
+        pools_b = [make_nodepool()]
+        catalog = small_catalog()
+        node_a = full_node(name="exist-a", available_cpu=9.0, victims=0)
+        node_b = full_node(name="exist-b", available_cpu=9.0, victims=0)
+        gang_a = [gang_pod(f"a{i}", "job-a", cpu=4.0) for i in range(2)]
+        gang_b = [gang_pod(f"b{i}", "job-b", cpu=4.0) for i in range(2)]
+        solo_a = _wire(_scheduler(pools_a, catalog, [node_a]).solve(
+            copy.deepcopy(gang_a)
+        ))
+        outcomes, stats = solve_batch([
+            (_scheduler(pools_a, catalog, [node_a]), copy.deepcopy(gang_a)),
+            (_scheduler(pools_b, catalog, [node_b]), copy.deepcopy(gang_b)),
+        ])
+        assert [k for k, _ in outcomes] == ["ok", "ok"]
+        assert stats["batched_problems"] >= 2
+        assert _wire(outcomes[0][1]) == solo_a
+
+
+# ---------------------------------------------------------------------------
+# verifier mutations: every forgery rejects with its own typed reason
+# ---------------------------------------------------------------------------
+
+
+class TestVerifierGangschedMutations:
+    def _preemption_solved(self):
+        pools, catalog, existing, pods = preemption_problem()
+        sched = DeviceScheduler(
+            pools, {"default": list(catalog)},
+            existing_nodes=existing, max_slots=64, verify=False,
+        )
+        sp = copy.deepcopy(pods)
+        res = sched.solve(sp)
+        assert res.evictions
+        verifier = ResultVerifier(pools, {"default": list(catalog)},
+                                  existing_nodes=existing)
+        assert not verifier.verify(res, sp)  # precondition: clean
+        return res, sp, pools, {"default": list(catalog)}, existing
+
+    def _reasons(self, pools, its, existing, res, sp):
+        violations = ResultVerifier(
+            pools, its, existing_nodes=existing
+        ).verify(res, sp)
+        # the production rejection path: one counter bump per reason
+        if violations:
+            verifymod.reject(violations, path="test")
+        return {v.reason for v in violations}
+
+    def test_forged_equal_tier_eviction_is_rejected(self):
+        res, sp, pools, its, existing = self._preemption_solved()
+        # victim-3 re-badged to the admitted pod's own tier: no longer
+        # strictly below anything its capacity admitted
+        node = existing[0]
+        forged = tuple(
+            EvictablePod(uid=e.uid, priority=SYSTEM_CLUSTER_CRITICAL,
+                         requests=e.requests, cost=e.cost)
+            for e in node.evictable
+        )
+        existing = [SimNode(
+            name=node.name, labels=node.labels, taints=node.taints,
+            available=node.available, capacity=node.capacity,
+            initialized=node.initialized, evictable=forged,
+        )]
+        before = dict(m.SOLVER_RESULT_REJECTED.values)
+        reasons = self._reasons(pools, its, existing, res, sp)
+        assert "eviction" in reasons, reasons
+        moved = {
+            k: v for k, v in m.SOLVER_RESULT_REJECTED.values.items()
+            if dict(k).get("reason") == "eviction"
+        }
+        assert moved, "no eviction-reason rejection counter moved"
+        assert dict(m.SOLVER_RESULT_REJECTED.values) != before
+
+    def test_forged_eviction_on_all_default_tier_solve_is_rejected(self):
+        """A lying sidecar appends a claim naming a genuinely lower-tier
+        victim to a solve where every pod is tier 0: preemption serves
+        positive tiers only, so no admitted pod can have enabled it."""
+        pools = [make_nodepool()]
+        catalog = small_catalog()
+        its = {"default": list(catalog)}
+        existing = [full_node(available_cpu=2.0, victims=1,
+                              victim_cpu=3.0, victim_tier=-5)]
+        sched = DeviceScheduler(pools, its, existing_nodes=existing,
+                                max_slots=64, verify=False)
+        sp = [make_pod(cpu=1.0, name="plain")]  # tier 0
+        res = sched.solve(sp)
+        assert not res.evictions
+        assert any(s.pods for s in res.existing_nodes)
+        res.evictions = {"exist-0": ["victim-0"]}
+        reasons = self._reasons(pools, its, existing, res, sp)
+        assert "eviction" in reasons, reasons
+
+    def test_non_load_bearing_eviction_claim_is_rejected(self):
+        """A forged claim riding a LEGITIMATE high-tier placement: the
+        pod landed through ordinary free capacity, so a tier comparison
+        alone would legalize draining the lower-tier victim for nothing."""
+        pools = [make_nodepool()]
+        catalog = small_catalog()
+        its = {"default": list(catalog)}
+        existing = [full_node(available_cpu=2.0, victims=1,
+                              victim_cpu=3.0, victim_tier=0)]
+        sched = DeviceScheduler(pools, its, existing_nodes=existing,
+                                max_slots=64, verify=False)
+        hi = make_pod(cpu=1.0, name="hi")
+        hi.priority = 100
+        sp = [hi]
+        res = sched.solve(sp)
+        assert not res.evictions
+        assert any(s.pods for s in res.existing_nodes)
+        res.evictions = {"exist-0": ["victim-0"]}
+        reasons = self._reasons(pools, its, existing, res, sp)
+        assert "eviction" in reasons, reasons
+
+    def test_eviction_claim_naming_unknown_uid_is_rejected(self):
+        res, sp, pools, its, existing = self._preemption_solved()
+        res.evictions["exist-0"].append("never-existed")
+        reasons = self._reasons(pools, its, existing, res, sp)
+        assert "eviction_unknown" in reasons, reasons
+        moved = {
+            k: v for k, v in m.SOLVER_RESULT_REJECTED.values.items()
+            if dict(k).get("reason") == "eviction_unknown"
+        }
+        assert moved
+
+    def test_eviction_claim_on_unknown_node_is_rejected(self):
+        res, sp, pools, its, existing = self._preemption_solved()
+        res.evictions["ghost-node"] = ["victim-0"]
+        reasons = self._reasons(pools, its, existing, res, sp)
+        assert "eviction_unknown" in reasons, reasons
+
+    def test_dangling_claim_that_admits_nothing_is_rejected(self):
+        res, sp, pools, its, existing = self._preemption_solved()
+        # strip the placement the evictions were load-bearing for: the
+        # claim now drains three pods to enable nothing
+        for sim in res.existing_nodes:
+            sim.pods = []
+        res.pod_errors = {p.uid: "unschedulable" for p in sp}
+        reasons = self._reasons(pools, its, existing, res, sp)
+        assert "eviction" in reasons, reasons
+
+    def test_scattered_same_zone_gang_is_rejected(self):
+        """A structurally-valid lying result that spreads a same-zone gang
+        over two zones must reject: atomicity alone is not the whole gang
+        contract — the verifier re-derives co-location from annotations."""
+        from karpenter_core_tpu.scheduling.requirement import Requirement
+
+        pool = make_nodepool(requirements=[NodeSelectorRequirement(
+            L.LABEL_TOPOLOGY_ZONE, "In", ("zone-a", "zone-b", "zone-c"),
+        )])
+        its = {"default": list(small_catalog())}
+        pods = [
+            gang_pod(f"z{i}", "job-z", cpu=1.0, same_zone=True)
+            for i in range(4)
+        ]
+        sched = DeviceScheduler([pool], its, max_slots=64, verify=False)
+        sp = copy.deepcopy(pods)
+        res = sched.solve(sp)
+        assert not res.pod_errors
+        claims = [c for c in res.new_node_claims if c.pods]
+        # the forgery moves ONE claim's zone: the gang must span >= 2
+        # claims or the whole group would move together
+        assert len(claims) >= 2
+        verifier = ResultVerifier([pool], its)
+        assert not verifier.verify(res, sp)  # precondition: clean
+        claims[0].requirements[L.LABEL_TOPOLOGY_ZONE] = Requirement(
+            L.LABEL_TOPOLOGY_ZONE, values={"zone-c"}
+        )
+        reasons = {v.reason for v in verifier.verify(res, sp)}
+        assert "gang" in reasons, reasons
+
+    def test_partially_materialized_gang_is_rejected(self):
+        pools = [make_nodepool()]
+        catalog = small_catalog()
+        its = {"default": list(catalog)}
+        gang = [gang_pod(f"g{i}", "job-a", cpu=1.0) for i in range(4)]
+        sched = DeviceScheduler(pools, its, max_slots=64, verify=False)
+        sp = copy.deepcopy(gang)
+        res = sched.solve(sp)
+        verifier = ResultVerifier(pools, its)
+        assert not verifier.verify(res, sp)  # fully placed: clean
+        # drop one member from its claim -> below min-count (the whole
+        # group), leaving the rest partially materialized
+        victim = sp[0]
+        for c in res.new_node_claims:
+            c.pods = [p for p in c.pods if p.uid != victim.uid]
+        res.pod_errors[victim.uid] = "lost at the decode seam"
+        reasons = {v.reason for v in ResultVerifier(pools, its).verify(
+            res, sp
+        )}
+        assert "gang" in reasons, reasons
+
+    def test_reasons_are_registered_counter_labels(self):
+        """The three new reasons are part of the verifier's typed-reason
+        contract (REASONS) so dashboards can pre-provision the series."""
+        assert {"eviction", "eviction_unknown", "gang"} <= set(
+            verifymod.REASONS
+        )
+
+
+# ---------------------------------------------------------------------------
+# the host fallback: tiered greedy with preemption
+# ---------------------------------------------------------------------------
+
+
+class TestHostFallback:
+    def test_higher_tier_claims_scarce_capacity_first(self):
+        """Pods arrive low-priority-first; the tier-banded fallback must
+        still give the existing node's last 3 cpu to the critical pod (a
+        tier-blind greedy would hand it to 'low' by arrival order)."""
+        catalog = small_catalog()
+        node = full_node(available_cpu=3.0, victims=0)
+        low = make_pod(cpu=3.0, memory_gib=0.5, name="low")
+        high = make_pod(cpu=3.0, memory_gib=0.5, name="high")
+        high.priority = 100
+
+        def make_scheduler():
+            return Scheduler([make_nodepool()], {"default": list(catalog)},
+                             existing_nodes=[node])
+
+        res = gangmod.host_gang_solve(make_scheduler, [low, high], [node])
+        on_node = [p.name for s in res.existing_nodes for p in s.pods]
+        assert on_node == ["high"]
+        assert low.uid in res.pod_errors  # 3cpu fits no fresh instance
+
+    def test_host_preemption_matches_the_kernel_rule(self):
+        pools, catalog, existing, pods = preemption_problem()
+
+        def make_scheduler():
+            return Scheduler(pools, {"default": list(catalog)},
+                             existing_nodes=list(existing))
+
+        res = gangmod.host_gang_solve(make_scheduler, pods, existing)
+        assert not res.pod_errors
+        assert res.evictions == {
+            "exist-0": ["victim-0", "victim-1", "victim-2"]
+        }
+
+    def test_host_preemption_serves_the_overshoot_residual(self):
+        """An eviction prefix usually frees MORE than the first pod needs;
+        a second capacity-starved pod must be admitted into that residual
+        with zero further evictions (the kernel's bonus-carry admission)."""
+        catalog = small_catalog()
+        node = full_node(available_cpu=0.5, victims=4, victim_cpu=3.0)
+        big = make_pod(cpu=4.0, memory_gib=0.5, name="big")
+        big.priority = 100
+        mid = make_pod(cpu=2.5, memory_gib=0.5, name="mid")
+        mid.priority = 100
+
+        def make_scheduler():
+            return Scheduler([make_nodepool()], {"default": list(catalog)},
+                             existing_nodes=[node])
+
+        res = gangmod.host_gang_solve(
+            make_scheduler, [big, mid], [node]
+        )
+        # big: 0.5 free + 2 victims x 3.0 = 6.5 >= 4.0 (overshoot 2.5);
+        # mid then fits the residual exactly — no third eviction
+        assert not res.pod_errors
+        assert res.evictions == {"exist-0": ["victim-0", "victim-1"]}
+
+    def test_fallback_strips_partial_gangs(self):
+        catalog = small_catalog()
+        node = full_node(available_cpu=9.0, victims=0)
+        gang = [gang_pod(f"g{i}", "job-a", cpu=4.0) for i in range(3)]
+
+        def make_scheduler():
+            return Scheduler([make_nodepool()], {"default": list(catalog)},
+                             existing_nodes=[node])
+
+        res = gangmod.host_gang_solve(make_scheduler, gang, [node])
+        assert set(res.pod_errors) == {p.uid for p in gang}
+        assert not [p for s in res.existing_nodes for p in s.pods]
+
+    def test_degraded_device_path_preserves_semantics(self, monkeypatch):
+        """Force the device result to fail verification: the re-solve must
+        go through the tiered wrapper, not the flat greedy."""
+        pools, catalog, existing, pods = preemption_problem()
+        sched = _scheduler(pools, catalog, existing)
+        seen = {}
+        orig = gangmod.host_gang_solve
+
+        def spy(make_scheduler, spods, enodes=()):
+            seen["pods"] = list(spods)
+            return orig(make_scheduler, spods, enodes)
+
+        monkeypatch.setattr(gangmod, "host_gang_solve", spy)
+        monkeypatch.setattr(
+            verifymod.ResultVerifier, "verify",
+            lambda self, res, p: [verifymod.Violation("capacity", "forged")],
+        )
+        res = sched.solve(pods)
+        assert seen, "gang problem degraded through the flat greedy path"
+        assert res.evictions == {
+            "exist-0": ["victim-0", "victim-1", "victim-2"]
+        }
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: drain-before-bind, chaos, sidecar murder
+# ---------------------------------------------------------------------------
+
+
+class TestOperatorEndToEnd:
+    def test_preemption_drains_before_bind_and_victims_reschedule(self):
+        """The full story: a zone-a node fills with low-priority pods, the
+        pool moves to zone-b, a critical zone-a-pinned pod arrives. The
+        operator executes the eviction claims (Preempted events), binds
+        the critical pod into the freed capacity, and the victims — being
+        replicated — reschedule onto fresh zone-b capacity."""
+        catalog = build_catalog(cpu_grid=[4])
+        op = new_operator("tpu", catalog=catalog)
+        pool = make_nodepool(requirements=[NodeSelectorRequirement(
+            L.LABEL_TOPOLOGY_ZONE, "In", ("zone-a",),
+        )])
+        op.kube.create(pool)
+        for i in range(3):
+            op.kube.create(replicated(make_pod(cpu=1.0, name=f"low{i}")))
+        op.run_until_idle()
+        (node_a,) = op.kube.list_nodes()
+        assert node_a.labels[L.LABEL_TOPOLOGY_ZONE] == "zone-a"
+
+        pool = op.kube.get(type(pool), "default")
+        pool.spec.template.requirements = [NodeSelectorRequirement(
+            L.LABEL_TOPOLOGY_ZONE, "In", ("zone-b",),
+        )]
+        op.kube.update(pool)
+        evicted_before = m.SOLVER_PREEMPTION_EVICTIONS.value()
+        crit = replicated(make_pod(cpu=3.0, name="crit",
+                                   zone_in=["zone-a"]))
+        crit.priority = SYSTEM_CLUSTER_CRITICAL
+        op.kube.create(crit)
+        op.run_until_idle()
+
+        pods = {p.name: p for p in op.kube.list_pods()}
+        assert pods["crit"].node_name == node_a.name
+        # all three victims drained and rescheduled elsewhere
+        for i in range(3):
+            low = pods[f"low{i}"]
+            assert low.node_name and low.node_name != node_a.name
+        assert m.SOLVER_PREEMPTION_EVICTIONS.value() == evicted_before + 3
+        preempted = [e for e in op.recorder.events if e.reason == "Preempted"]
+        assert len(preempted) == 3
+        assert_coherent(op)
+
+    def test_gang_binds_atomically_through_the_operator(self):
+        op = new_operator("tpu")
+        op.kube.create(make_nodepool())
+        for i in range(6):
+            op.kube.create(replicated(gang_pod(f"g{i}", "job-a", cpu=1.0)))
+        op.run_until_idle()
+        pods = op.kube.list_pods()
+        assert all(p.node_name for p in pods)
+        assert_coherent(op)
+
+
+def _assert_gangs_atomic(op):
+    """Zero partially-materialized gangs over the LIVE bindings."""
+    by_gang = {}
+    for p in op.kube.list_pods():
+        g = pod_gang_sig(p)
+        if g is not None:
+            by_gang.setdefault(g[0], []).append(p)
+    for name, mpods in sorted(by_gang.items()):
+        bound = [p for p in mpods if p.node_name]
+        assert not bound or len(bound) >= gang_min_count(mpods), (
+            f"gang {name!r} partially materialized:"
+            f" {len(bound)}/{len(mpods)} bound"
+        )
+
+
+class TestGangChaos:
+    def test_gang_atomicity_under_seeded_chaos(self):
+        """Waves of mixed gang/priority/plain workload through the seeded
+        chaos harness (conflicts, 429s, ICE, provider faults) on the
+        device path: the cluster converges with every gang whole and the
+        rejection counters unmoved (clean-run contract)."""
+        from tests.test_chaos import _chaos_operator
+
+        rejected = dict(m.SOLVER_RESULT_REJECTED.values)
+        op, schedule, store = _chaos_operator(seed=1310, solver="tpu")
+        store.create(make_nodepool())
+        serial = 0
+        for wave in range(3):
+            for gi in range(2):
+                gname = f"gang-{wave}-{gi}"
+                for _ in range(3):
+                    store.create(replicated(gang_pod(
+                        f"w{serial}", gname,
+                        cpu=[0.5, 1.0][serial % 2],
+                    )))
+                    serial += 1
+            for _ in range(3):
+                p = replicated(make_pod(cpu=1.0, name=f"w{serial}"))
+                p.priority = [0, 100, SYSTEM_CLUSTER_CRITICAL][serial % 3]
+                store.create(p)
+                serial += 1
+            op.run_until_idle(max_iters=400)
+            op.clock.step(61.0)
+            op.run_until_idle(max_iters=400)
+            _assert_gangs_atomic(op)
+        assert schedule.draws > 0
+        assert_coherent(op)
+        _assert_gangs_atomic(op)
+        assert dict(m.SOLVER_RESULT_REJECTED.values) == rejected, (
+            "verifier rejected a clean gangsched solve under chaos"
+        )
+
+    def test_gang_atomicity_survives_sidecar_murder(self):
+        """Kill a real sidecar mid-churn: the greedy degradation path must
+        hold the same gang-atomicity contract the device path does."""
+        from tests.test_solverd import new_operator as solverd_operator
+
+        op = solverd_operator("sidecar", batch_idle_duration=0.0)
+        try:
+            sup = op.solver_supervisor
+            op.solver_client.max_retries = 0
+            op.solver_client.sleep = lambda s: None
+            op.kube.create(make_nodepool())
+            # wave 1 rides the live sidecar
+            for i in range(4):
+                op.kube.create(replicated(gang_pod(
+                    f"alive{i}", "gang-alive", cpu=1.0
+                )))
+            op.run_until_idle(disrupt=False)
+            _assert_gangs_atomic(op)
+            assert all(p.node_name for p in op.kube.list_pods())
+            # murder the sidecar; hold the respawn window shut so wave 2
+            # really degrades to the tiered host fallback
+            op.solver_client.timeout = 1.0
+            sup._delay = 9999.0
+            sup.proc.kill()
+            sup.proc.wait(timeout=10)
+            fb = m.SOLVER_RPC_FALLBACKS.value({"endpoint": "solve"})
+            for i in range(4):
+                op.kube.create(replicated(gang_pod(
+                    f"dead{i}", "gang-dead", cpu=1.0
+                )))
+            op.run_until_idle(disrupt=False)
+            assert m.SOLVER_RPC_FALLBACKS.value(
+                {"endpoint": "solve"}
+            ) > fb
+            _assert_gangs_atomic(op)
+            assert all(p.node_name for p in op.kube.list_pods())
+            assert_coherent(op)
+        finally:
+            op.shutdown()

@@ -64,6 +64,7 @@ PLACED = frozenset({REPL, SHARD})
 _MESH_SHARDERS = {
     "slot_shardings", "axis_sharding", "batch_sharding",
     "batched_slot_shardings", "batched_step_shardings",
+    "gang_plane_shardings", "batched_gang_plane_shardings",
 }
 _MESH_REPLICATORS = {"replicated"}
 

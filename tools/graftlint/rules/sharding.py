@@ -46,13 +46,23 @@ from tools.graftlint.engine import ParsedFile, Rule, dotted_name, register
 # inherited from the retired GL104). The batched twins (ISSUE 9) consume
 # a problem-STACKED SlotState — batch-stacked state must still route
 # through parallel.mesh placement (batched_slot_shardings /
-# batched_step_shardings), so they carry the same contract.
+# batched_step_shardings), so they carry the same contract. The gangsched
+# entries (ISSUE 10) are SlotState kernels too: gang_solve* runs the same
+# scan with a gang axis riding the class batch, and preempt_pass* consumes
+# the FINISHED solve's SlotState plus the EvPlanes (whose slot axis routes
+# through parallel.mesh.gang_plane_shardings / the batched twin).
 SLOTSTATE_JIT_ENTRIES = {
     "ffd_solve",
     "ffd_solve_donated",
     "ffd_solve_batched",
     "ffd_solve_batched_donated",
     "_prefix_scan",
+    "gang_solve",
+    "gang_solve_donated",
+    "gang_solve_batched",
+    "gang_solve_batched_donated",
+    "preempt_pass",
+    "preempt_pass_batched",
 }
 
 
