@@ -1525,6 +1525,13 @@ def _lint_report():
         # surface the actual violations (stderr keeps the stdout contract
         # of exactly one JSON line)
         print(f.render(), file=sys.stderr)
+    # family labels ride the timing JSON so a dashboard reads "rangecheck
+    # got slower", not "GL6xx got slower"
+    family_names = {
+        "GL1xx": "jaxpurity", "GL2xx": "determinism", "GL3xx": "concurrency",
+        "GL4xx": "parity", "GL5xx": "shardcheck", "GL6xx": "rangecheck",
+        "GL000": "suppression-hygiene",
+    }
     family_seconds: dict = {}
     for rid, dt in result.rule_seconds.items():
         fam = rid[:3] + "xx" if rid != "GL000" else "GL000"
@@ -1549,6 +1556,10 @@ def _lint_report():
                     "family_seconds": {
                         fam: round(dt, 4)
                         for fam, dt in sorted(family_seconds.items())
+                    },
+                    "family_names": {
+                        fam: family_names.get(fam, fam)
+                        for fam in sorted(family_seconds)
                     },
                     "cache": {
                         "hits": result.cache_hits,

@@ -297,7 +297,9 @@ class _KernelRequest:
     kind: str = "solve"
     # gang-atomic solve (both None for plain problems — same kernels,
     # same jit entries, byte-identical results as pre-gang)
-    gang_of_step: object = None  # [Jp] int32 gang step index (-1 gang-free, -2 host-enforced gang)
+    # [Jp] int32 gang step index (gangmod.GANG_FREE outside any gang,
+    # gangmod.GANG_FALLBACK_STRADDLING for host-enforced gangs)
+    gang_of_step: object = None
     gang_min: object = None  # [Gp] int32 per-gang min-count
     # preemption pass inputs (kind == "preempt")
     step_tier: object = None  # [Jp] int32
@@ -1097,7 +1099,9 @@ class DeviceScheduler:
             u_host = np.asarray(jax.device_get(unplaced_bc))[:C]
             goc = prep._batch["gang_of_class"][:C]
             toc = prep._batch["tier_of_class"][:C]
-            if bool(((u_host > 0) & (toc > 0) & (goc == -1)).any()):
+            if bool(
+                ((u_host > 0) & (toc > 0) & (goc == gangmod.GANG_FREE)).any()
+            ):
                 J = len(plan.steps)
                 Jp = int(prep.step_class.shape[0])
                 u_step = jnp.where(
@@ -2232,17 +2236,19 @@ class DeviceScheduler:
         tier_of_class = np.clip(tiers, -(2**31 - 1), 2**31 - 1).astype(
             np.int32
         )
-        gang_of_class = np.full((C,), -1, dtype=np.int32)
+        gang_of_class = np.full((C,), gangmod.GANG_FREE, dtype=np.int32)
         if has_gangs:
             # kernel-enforced gangs: fully on the device path. A gang with
             # a member in the fallback set places through the host loop,
             # where the atomicity backstop (solver/gangs.enforce_atomicity)
             # is the enforcement — its device members must not roll back
             # for a host placement the kernel cannot see. Those members
-            # carry the -2 sentinel: inert for the atomicity kernel (which
-            # keys on >= 0) but still a gang mark, so the preemption pass
-            # never evicts real workload to place a member the backstop
-            # may strip (gang-free means gang_of_class == -1 exactly).
+            # carry the GANG_FALLBACK_STRADDLING sentinel: inert for the
+            # atomicity kernel (which keys on >= 0) but still a gang mark,
+            # so the preemption pass never evicts real workload to place a
+            # member the backstop may strip (gang-free means gang_of_class
+            # == gangmod.GANG_FREE exactly; solver/gangs.py single-sources
+            # the sentinel domain).
             fallback_names = {
                 c.gang[0]
                 for c in plan.fallback_classes
@@ -2252,7 +2258,7 @@ class DeviceScheduler:
             for g in gangmod.collect_gangs(classes):
                 if g.name in fallback_names:
                     for ci in g.class_indices:
-                        gang_of_class[ci] = -2
+                        gang_of_class[ci] = gangmod.GANG_FALLBACK_STRADDLING
                 else:
                     gangs.append(g)
             if gangs:
@@ -2474,7 +2480,9 @@ class DeviceScheduler:
                 _pad(tier_of_class[cis], {0: Jp}, 0)
             )
             prep.step_gang = self._dev(
-                _pad(gang_of_class[cis], {0: Jp}, -1)
+                # padded steps are gang-free: never preemption-eligible
+                # anyway (their counts are 0), never a kernel gang
+                _pad(gang_of_class[cis], {0: Jp}, gangmod.GANG_FREE)
             )
             prep._batch["step_tier_d"] = prep.step_tier
             prep._batch["step_gang_d"] = prep.step_gang

@@ -56,6 +56,7 @@ from karpenter_core_tpu.ops.ffd import (
     _class_slot_compatible,
     _ffd_solve_impl,
 )
+from karpenter_core_tpu.solver.gangs import GANG_FREE
 
 # Preemption fan-out bound: one class's remaining pods spread over at most
 # this many preempted nodes per solve (a lax.scan length, so it is a
@@ -243,10 +244,11 @@ def _preempt_impl(state: SlotState, classes: ClassStep,
     def class_step(carry, xs):
         evicted, bonus = carry
         c, tier_j, gang_j, m0 = xs
-        # gang-free is exactly -1: -2 marks a member of a gang whose
-        # atomicity is host-enforced (fallback-straddling) — evicting for
-        # it could strand claims if the backstop strips the gang
-        enabled = (m0 > 0) & (gang_j == -1) & (tier_j > 0)
+        # gang-free is exactly GANG_FREE: GANG_FALLBACK_STRADDLING marks a
+        # member of a gang whose atomicity is host-enforced — evicting for
+        # it could strand claims if the backstop strips the gang (the
+        # sentinel domain is single-sourced in solver/gangs.py)
+        enabled = (m0 > 0) & (gang_j == GANG_FREE) & (tier_j > 0)
         ok_node = (
             (state.kind == 1)
             & c.exist_taint_ok

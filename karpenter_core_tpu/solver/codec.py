@@ -529,6 +529,25 @@ def problem_fingerprint(header: dict) -> str:
     ).hexdigest()
 
 
+# decode-net clamp for the wire's slot ceiling: max_slots sizes every
+# device plane's slot axis, so a hostile (or fat-fingered) huge value
+# would allocate unbounded device memory INSIDE the exclusive device
+# window — a crash charged as poison where a cheap decode clamp belongs.
+# 1 << 20 mirrors models/provisioner._SLOT_HARD_CAP (one slot per pod at
+# 1M pods, far past any real solve; the adaptive regrow loop refuses to
+# cross it anyway, so clamping here never changes a solvable problem).
+_MAX_SLOTS_CAP = 1 << 20
+
+
+def _clamp_slots(n) -> int:
+    """Normalize a wire-decoded slot ceiling to [1, _MAX_SLOTS_CAP]."""
+    try:
+        n = int(n)
+    except (TypeError, ValueError):
+        raise ValueError(f"malformed max_slots on the wire: {n!r}")
+    return max(1, min(n, _MAX_SLOTS_CAP))
+
+
 def _pow2_bucket(n: int, lo: int = 8) -> int:
     """Next power of two >= lo — the same axis-bucketing rule the device
     planes use (models/provisioner._bucket), duplicated here so the wire
@@ -617,7 +636,7 @@ def decode_solve_request(data: bytes) -> dict:
         "daemonset_pods": [serial.decode(d) for d in h["daemonset_pods"]],
         "pods": [serial.decode(d) for d in h["pods"]],
         "topology": _decode_topology(h["topology"]),
-        "max_slots": h["max_slots"],
+        "max_slots": _clamp_slots(h["max_slots"]),
         # absent from pre-ICE-cache encoders -> empty set, same semantics
         "unavailable_offerings": frozenset(
             OfferingKey(*k) for k in h.get("unavailable_offerings", [])
@@ -733,7 +752,7 @@ def decode_frontier_request(data: bytes) -> dict:
         "candidate_pods": [
             [serial.decode(d) for d in pods] for pods in h["candidate_pods"]
         ],
-        "max_slots": h["max_slots"],
+        "max_slots": _clamp_slots(h["max_slots"]),
         "tenant": h.get("tenant", "default"),
     }
 

@@ -55,6 +55,26 @@ GANG_SAME_TEMPLATE_ANNOTATION = (
 
 _TRUE = ("true", "1", "yes")
 
+# -- device-side gang sentinels ----------------------------------------------
+# The gang_of_class / gang_of_step planes (models/provisioner, consumed by
+# ops/gangsched) carry a gang index >= 0 for kernel-enforced gangs and one
+# of two NEGATIVE sentinels below. The two are NOT interchangeable: a
+# ``< 0`` test conflates them, and the preemption pass must gate on
+# GANG_FREE exactly — evicting real workload to place a member of a
+# fallback-straddling gang could strand eviction claims if the host
+# atomicity backstop (enforce_atomicity) strips the gang. One definition
+# here (the module both halves already import); graftlint GL602 seeds its
+# sentinel-domain registry from GANG_SENTINELS, so sentinel-confusing
+# comparisons fail lint instead of review.
+GANG_FREE = -1  # class belongs to no gang at all
+GANG_FALLBACK_STRADDLING = -2  # member of a gang the host backstop enforces
+
+# domain-registry view consumed by tools/graftlint/rules/rangecheck.py
+GANG_SENTINELS = {
+    "gang-free": GANG_FREE,
+    "fallback-straddling": GANG_FALLBACK_STRADDLING,
+}
+
 
 def pod_gang_sig(pod: Pod) -> Optional[tuple]:
     """The gang signature of one pod: (name, min_size, same_zone,

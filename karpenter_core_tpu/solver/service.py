@@ -479,6 +479,14 @@ class SolverDaemon:
                 # guarantee release_batch and the member drain sweep
                 # (done()/disarm() are no-ops for digests never begun)
                 for d in digests:
+                    # graftlint: disable=GL304 -- deliberate tradeoff
+                    # (ISSUE 8/9 review): begin() must run at grant time —
+                    # journaling the digest any earlier would charge a
+                    # crash strike against problems still sitting in the
+                    # queue — and inside the release-guaranteeing try so a
+                    # disk-full raise can never wedge the gateway. The
+                    # write is a tmp+rename of a tiny JSON file; done()
+                    # (the rewrite) stays off the window below.
                     self.quarantine.begin(d)
                 if self.watchdog is not None:
                     self.watchdog.arm(
@@ -665,6 +673,10 @@ class SolverDaemon:
         self.gateway.await_grant(ticket)
         dt = 0.0
         grant_t0 = time.perf_counter()
+        # graftlint: disable=GL304 -- same deliberate tradeoff as the
+        # solve path: the in-flight journal write belongs at grant time
+        # (earlier would strike queued problems at a crash) and its
+        # tmp+rename of a tiny file is bounded; done() runs post-release.
         self.quarantine.begin(digest)
         if self.watchdog is not None:
             self.watchdog.arm(f"consolidate tenant={ticket.tenant}")

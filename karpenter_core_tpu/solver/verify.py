@@ -424,15 +424,26 @@ class ResultVerifier:
                     e.uid: e for e in getattr(node, "evictable", ()) or ()
                 }
                 admitted = placed_on.get(node_name) or []
+                # GANG-FREE admitted pods only: both preemption halves gate
+                # on solver/gangs.GANG_FREE (device: gang_j == GANG_FREE;
+                # host: pod_gang_sig(p) is None), so a claim whose only
+                # positive-tier admitted pod is a gang member cannot be
+                # legitimate preemption output — the eviction would be
+                # serving a placement the atomicity backstop may strip
                 max_tier = max(
-                    (priority_tier(p.priority) for p in admitted),
+                    (
+                        priority_tier(p.priority)
+                        for p in admitted
+                        if pod_gang_sig(p) is None
+                    ),
                     default=None,
                 )
                 if max_tier is None:
                     out.append(Violation(
                         "eviction",
                         f"eviction claim on {node_name!r} admits no placed"
-                        " pod — a drain that enables nothing",
+                        " gang-free pod — a drain that enables nothing"
+                        " preemption could have produced",
                     ))
                 elif max_tier <= 0:
                     # the preemption pass serves POSITIVE tiers only: a

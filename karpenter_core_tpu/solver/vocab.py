@@ -187,8 +187,16 @@ def encode_requirements_batch(
         defines=defines,
         concrete=concrete,
         negative=negative,
-        gt=gt.astype(np.int32),
-        lt=lt.astype(np.int32),
+        # clamp to the sentinel bounds before narrowing: Gt/Lt bounds come
+        # off the solve wire (codec._decode_req) as arbitrary ints, and an
+        # unclamped astype WRAPS — a hostile 2**40 bound would flip sign
+        # inside the int32 device planes. Within the closed world the
+        # clamp is exact: every integer vocab value lies strictly inside
+        # (GT_NONE, LT_NONE), so a bound at/beyond a sentinel admits (or
+        # excludes) exactly the same values the raw bound would, and the
+        # host-side mask above already folded the raw bound exactly.
+        gt=np.clip(gt, GT_NONE, LT_NONE).astype(np.int32),
+        lt=np.clip(lt, GT_NONE, LT_NONE).astype(np.int32),
     )
 
 

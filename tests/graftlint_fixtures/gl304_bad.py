@@ -1,0 +1,42 @@
+"""BAD: journal file I/O while the exclusive device grant (and the daemon
+state lock) is held — the ISSUE 8/9 review finding as a fixture. A
+disk-full (or NFS-stalled) write here wedges every queued tenant behind
+this grant."""
+import json
+import threading
+
+
+class Gateway:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def await_grant(self, ticket):
+        pass
+
+    def release(self, ticket, seconds):
+        pass
+
+
+class Daemon:
+    def __init__(self, gateway, journal_path):
+        self.gateway = gateway
+        self.journal_path = journal_path
+        self._state_lock = threading.Lock()
+        self.solves = 0
+
+    def _write_journal(self, digest):
+        with open(self.journal_path, "w") as f:
+            json.dump({"inflight": [digest]}, f)
+
+    def solve(self, ticket, digest):
+        self.gateway.await_grant(ticket)
+        try:
+            self._write_journal(digest)  # file I/O inside the window
+            return ticket
+        finally:
+            self.gateway.release(ticket, 0.0)
+
+    def count(self, n):
+        with self._state_lock:
+            self.solves += n
+            self._write_journal(str(n))  # file I/O under the state lock

@@ -1,0 +1,45 @@
+"""GOOD: the same daemon with every journal write moved off the exclusive
+window — the device phase between await_grant and release touches no file
+or network, and the state lock guards only in-memory counters."""
+import json
+import threading
+
+
+class Gateway:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def await_grant(self, ticket):
+        pass
+
+    def release(self, ticket, seconds):
+        pass
+
+
+class Daemon:
+    def __init__(self, gateway, journal_path):
+        self.gateway = gateway
+        self.journal_path = journal_path
+        self._state_lock = threading.Lock()
+        self.solves = 0
+
+    def _write_journal(self, digest):
+        with open(self.journal_path, "w") as f:
+            json.dump({"inflight": [digest]}, f)
+
+    def _solve_device(self, ticket):
+        return ticket
+
+    def solve(self, ticket, digest):
+        self.gateway.await_grant(ticket)
+        try:
+            result = self._solve_device(ticket)
+        finally:
+            self.gateway.release(ticket, 0.0)
+        self._write_journal(digest)  # off the window: after release
+        return result
+
+    def count(self, n):
+        with self._state_lock:
+            self.solves += n
+        self._write_journal(str(n))  # off the lock

@@ -1,0 +1,11 @@
+"""BAD: the eviction-cost ±9 regression shape — per-term clamps whose sum
+(1 + [-1,1] + [-9,9] = [-9,11]) exceeds the outer [-10,10] clamp, so every
+cost past the bound collapses onto 10.0 and the lower-order deletion-cost
+tiebreak is erased among critical pods."""
+
+
+def eviction_cost(deletion_cost, priority):
+    cost = 1.0
+    cost += min(max(float(deletion_cost) / 2.0 ** 27, -1.0), 1.0)
+    cost += min(max(float(priority) / 2.0 ** 25, -9.0), 9.0)
+    return min(max(cost, -10.0), 10.0)

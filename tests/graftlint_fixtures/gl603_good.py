@@ -1,0 +1,10 @@
+"""GOOD: per-term clamps sized so the sum (1 + [-1,1] + [-8,8] = [-8,10])
+stays inside the outer [-10,10] contract — the total clamp is a backstop
+the interior never exceeds, and every tiebreak term stays live."""
+
+
+def eviction_cost(deletion_cost, priority):
+    cost = 1.0
+    cost += min(max(float(deletion_cost) / 2.0 ** 27, -1.0), 1.0)
+    cost += min(max(float(priority) / 2.0 ** 25, -8.0), 8.0)
+    return min(max(cost, -10.0), 10.0)

@@ -1,0 +1,22 @@
+"""BAD: sentinel-domain confusion on the gang planes — a zero-boundary
+test while the -2 (fallback-straddling) sentinel is live conflates it
+with -1 (gang-free), and a cross-domain comparison treats unrelated
+sentinel spaces as one."""
+import numpy as np
+
+GANG_FREE = -1
+GANG_FALLBACK_STRADDLING = -2
+
+
+def preempt_gate(unplaced):
+    gang_of_class = np.full((8,), GANG_FREE, dtype=np.int32)
+    gang_of_class[3] = GANG_FALLBACK_STRADDLING
+    # conflates gang-free with fallback-straddling: a preemption gated on
+    # this would evict real workload for a gang the backstop may strip
+    eligible = (unplaced > 0) & (gang_of_class < 0)
+    return eligible
+
+
+def joint_mask(gang_of_step, new_template):
+    # gang indices and template indices are unrelated sentinel spaces
+    return gang_of_step == new_template

@@ -1,0 +1,22 @@
+"""GOOD: the idiomatic forms — equality against the NAMED sentinel for
+the gang-free gate, and the zero-boundary test only where no deeper
+sentinel is positively live (a parameter whose values the analysis cannot
+see stays silent: positive evidence only)."""
+import numpy as np
+
+GANG_FREE = -1
+GANG_FALLBACK_STRADDLING = -2
+
+
+def preempt_gate(unplaced):
+    gang_of_class = np.full((8,), GANG_FREE, dtype=np.int32)
+    gang_of_class[3] = GANG_FALLBACK_STRADDLING
+    # gang-free is exactly GANG_FREE — never `< 0`
+    eligible = (unplaced > 0) & (gang_of_class == GANG_FREE)
+    return eligible
+
+
+def kernel_gangs(gang_of_step):
+    # selecting kernel-enforced gangs (>= 0) on a plane with no deeper
+    # sentinel positively live here
+    return gang_of_step >= 0

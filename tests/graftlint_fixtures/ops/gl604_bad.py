@@ -1,0 +1,11 @@
+"""BAD: an unmasked argmin over pad-provenance content inside a traced
+region — the inert padded slots participate in the reduction, so a padded
+row can win the argmin and steer the packing."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pick_slot(scores):
+    padded = jnp.pad(scores, (0, 8))
+    return jnp.argmin(padded)

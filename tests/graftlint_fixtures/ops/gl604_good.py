@@ -1,0 +1,12 @@
+"""GOOD: the padded content routes through a masking step (jnp.where with
+a validity predicate and a neutral fill) before the reduction — padded
+slots cannot vote."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pick_slot(scores, n):
+    padded = jnp.pad(scores, (0, 8))
+    masked = jnp.where(jnp.arange(padded.shape[0]) < n, padded, 1e30)
+    return jnp.argmin(masked)

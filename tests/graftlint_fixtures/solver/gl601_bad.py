@@ -1,0 +1,32 @@
+"""BAD: the retro ISSUE 10 shape — a wire-decoded int64 priority flows
+into the int32 evictable-tier plane with no normalizer/clip on the path.
+The decode net casts with int() (unbounded) and the prep layer stores it
+into an int32 array element, which WRAPS on overflow inside the exclusive
+device window."""
+import numpy as np
+
+
+class EvictablePod:
+    def __init__(self, uid, priority, cost):
+        self.uid = uid
+        self.priority = priority
+        self.cost = cost
+
+
+def _decode_sim_node(d):
+    return [
+        EvictablePod(
+            uid=e["uid"],
+            priority=int(e["priority"]),
+            cost=float(e["cost"]),
+        )
+        for e in d.get("evictable", ())
+    ]
+
+
+def build_ev_planes(nodes):
+    tier = np.full((4, 8), 0, dtype=np.int32)
+    for ei, node in enumerate(nodes):
+        for j, e in enumerate(node.evictable):
+            tier[ei, j] = e.priority
+    return tier

@@ -1,0 +1,32 @@
+"""GOOD: the same decode-to-device-plane path with the priority clamped
+through the registered normalizer (utils/disruption.priority_tier) at the
+decode net — the int32 store can no longer wrap."""
+import numpy as np
+
+from karpenter_core_tpu.utils.disruption import priority_tier
+
+
+class EvictablePod:
+    def __init__(self, uid, priority, cost):
+        self.uid = uid
+        self.priority = priority
+        self.cost = cost
+
+
+def _decode_sim_node(d):
+    return [
+        EvictablePod(
+            uid=e["uid"],
+            priority=priority_tier(int(e["priority"])),
+            cost=float(e["cost"]),
+        )
+        for e in d.get("evictable", ())
+    ]
+
+
+def build_ev_planes(nodes):
+    tier = np.full((4, 8), 0, dtype=np.int32)
+    for ei, node in enumerate(nodes):
+        for j, e in enumerate(node.evictable):
+            tier[ei, j] = e.priority
+    return tier

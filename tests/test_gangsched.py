@@ -813,6 +813,21 @@ class TestVerifierGangschedMutations:
         reasons = self._reasons(pools, its, existing, res, sp)
         assert "eviction" in reasons, reasons
 
+    def test_eviction_claim_admitting_only_gang_members_is_rejected(self):
+        """Both preemption halves serve GANG-FREE pods only (device:
+        gang_j == gangs.GANG_FREE; host: pod_gang_sig is None), so a claim
+        whose only positive-tier admitted pod is a gang member cannot be
+        legitimate preemption output — re-badging the admitted pod as a
+        gang member must flip a clean solve to rejected (ISSUE 11)."""
+        res, sp, pools, its, existing = self._preemption_solved()
+        claimed = set(res.evictions)
+        for sim in res.existing_nodes:
+            if sim.name in claimed:
+                for p in sim.pods:
+                    p.metadata.annotations[GANG_ANNOTATION] = "forged-gang"
+        reasons = self._reasons(pools, its, existing, res, sp)
+        assert "eviction" in reasons, reasons
+
     def test_eviction_claim_naming_unknown_uid_is_rejected(self):
         res, sp, pools, its, existing = self._preemption_solved()
         res.evictions["exist-0"].append("never-existed")

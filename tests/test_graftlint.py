@@ -68,18 +68,20 @@ def test_tree_is_clean():
 
 
 def test_rule_inventory():
-    """At least 13 rules across the five invariant families."""
+    """At least 18 rules across the six invariant families."""
     run([str(FIXTURES / "gl000_good.py")])  # force registration
     ids = set(RULES)
-    assert len(ids) >= 13, f"only {len(ids)} rules registered: {sorted(ids)}"
+    assert len(ids) >= 18, f"only {len(ids)} rules registered: {sorted(ids)}"
     families = {rid[:3] for rid in ids if rid != "GL000"}
-    assert {"GL1", "GL2", "GL3", "GL4", "GL5"} <= families, (
+    assert {"GL1", "GL2", "GL3", "GL4", "GL5", "GL6"} <= families, (
         "expected jax-purity (GL1xx), determinism (GL2xx), concurrency"
-        " (GL3xx), parity (GL4xx) and shardcheck (GL5xx) families,"
-        f" got {sorted(families)}"
+        " (GL3xx), parity (GL4xx), shardcheck (GL5xx) and rangecheck"
+        f" (GL6xx) families, got {sorted(families)}"
     )
     assert "GL104" not in ids, "GL104 was retired into GL503 (shardcheck)"
     assert {"GL403", "GL501", "GL502", "GL503", "GL504"} <= ids
+    # ISSUE 11: the rangecheck family + the I/O-under-grant lint
+    assert {"GL304", "GL601", "GL602", "GL603", "GL604"} <= ids
 
 
 def test_baseline_is_frozen_empty():
@@ -677,3 +679,111 @@ def test_dataflow_memo_does_not_grow_across_runs():
         gc.collect()
         sizes.append(max(len(df._envs) for df in dataflow._CACHE.values()))
     assert sizes[0] == sizes[-1], f"memo grew across runs: {sizes}"
+
+
+# -- rangecheck / ISSUE 11 regressions ---------------------------------------
+
+
+def test_retro_detection_gl601_evictable_priority_store():
+    """Acceptance pin: the PR 10 bug shape — an unclamped int64 wire
+    priority stored into the int32 EvPlanes plane — fires GL601."""
+    result = run(
+        [str(FIXTURES / "solver" / "gl601_bad.py")],
+        use_baseline=False,
+        rule_ids=["GL601"],
+    )
+    assert result.new, "the retro PR 10 fixture must fire GL601"
+    assert "int32" in result.new[0][0].message
+
+
+def test_retro_detection_gl304_journal_io_under_grant():
+    """Acceptance pin: journal file I/O between await_grant and release
+    (the PR 8/9 review finding) fires GL304."""
+    result = run(
+        [str(FIXTURES / "gl304_bad.py")],
+        use_baseline=False,
+        rule_ids=["GL304"],
+    )
+    held = {f.message.split("while ")[1].split(" is held")[0]
+            for f, _ in result.new}
+    assert "the exclusive device grant" in held
+    assert "_state_lock" in held
+
+
+def test_rangecheck_clean_on_tree_paths():
+    """GL6xx + GL304: the solver/models/ops tree satisfies the numeric
+    contracts with only the justified inline suppressions."""
+    result = run(
+        [
+            "karpenter_core_tpu/solver",
+            "karpenter_core_tpu/models",
+            "karpenter_core_tpu/ops",
+            "karpenter_core_tpu/utils",
+            "karpenter_core_tpu/parallel",
+        ],
+        use_baseline=False,
+        rule_ids=["GL304", "GL601", "GL602", "GL603", "GL604"],
+    )
+    assert result.ok, "\n".join(f.render() for f, _ in result.new)
+
+
+def test_changed_only_restricts_file_scope_not_project_scope(tmp_path):
+    """--changed-only semantics: file-scope rules skip unchanged files,
+    project-scope rules still see (and report over) the full set."""
+    d = tmp_path / "graftlint_fixtures"
+    d.mkdir()
+    changed = d / "gl201_changed.py"
+    unchanged = d / "gl201_unchanged.py"
+    src = (FIXTURES / "gl201_bad.py").read_text()
+    changed.write_text(src)
+    unchanged.write_text(src)
+
+    full = run([str(d)], use_baseline=False)
+    assert {f.path for f, _ in full.new if f.rule == "GL201"} == {
+        str(changed), str(unchanged)
+    }
+
+    restricted = run(
+        [str(d)], use_baseline=False, restrict_to={str(changed)}
+    )
+    flagged = {f.path for f, _ in restricted.new if f.rule == "GL201"}
+    assert flagged == {str(changed)}, (
+        "file-scope findings must come only from the restricted set,"
+        f" got {flagged}"
+    )
+
+
+def test_changed_relpaths_returns_py_set():
+    from tools.graftlint.engine import changed_relpaths
+
+    changed = changed_relpaths("HEAD")
+    assert isinstance(changed, set)
+    assert all(p.endswith(".py") for p in changed)
+
+
+def test_project_verdict_cache_roundtrip(tmp_path):
+    """The project-scope verdict cache: a warm identical run reproduces
+    the project findings without re-running the rules, and any file edit
+    busts it."""
+    cache = tmp_path / "cache.json"
+    # exercised against the real (in-repo) fixture dir: out-of-repo tmp
+    # paths deliberately bypass the project cache key
+    cold = run([str(FIXTURES)], use_baseline=False, cache_path=cache)
+    data = json.loads(cache.read_text())
+    assert "__project__" in data
+    warm = run([str(FIXTURES)], use_baseline=False, cache_path=cache)
+    assert [(fi, s) for fi, s in warm.new] == [(fi, s) for fi, s in cold.new]
+    assert warm.suppressed == cold.suppressed
+    # a rule-hash change must bust the project verdict too
+    import tools.graftlint.engine as engine
+    old = engine._rules_hash
+    engine._RULES_HASH = None
+    try:
+        engine._rules_hash = lambda: "different"
+        busted = run([str(FIXTURES)], use_baseline=False, cache_path=cache)
+        assert [(fi, s) for fi, s in busted.new] == [
+            (fi, s) for fi, s in cold.new
+        ]
+    finally:
+        engine._rules_hash = old
+        engine._RULES_HASH = None

@@ -1,0 +1,304 @@
+"""ISSUE 11 — the rangecheck abstract domain and the decode-net clamps.
+
+Three layers under test:
+
+1. the RangeDataflow engine (tools/graftlint/dataflow.py): interval
+   arithmetic and hull joins, the union/intersection taint-vs-guard
+   split, cross-file call-graph propagation through constructor/attribute
+   summaries, and termination under recursion (widening to top);
+2. the sentinel registry: GL602's gang domain seeds from
+   solver/gangs.GANG_SENTINELS — the single source the kernel and the
+   prep layer import;
+3. the decode-net fixes the GL601 audit landed: Gt/Lt bounds clamp to the
+   sentinel range before the int32 narrowing in vocab, and the wire's
+   max_slots clamps to the slot hard cap at decode.
+"""
+from __future__ import annotations
+
+import ast
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tools.graftlint import dataflow
+from tools.graftlint.engine import ParsedFile
+
+
+def _pf(src: str, relpath: str = "karpenter_core_tpu/solver/mini.py"):
+    return ParsedFile(Path(relpath), relpath, textwrap.dedent(src))
+
+
+def _absval(df, pf, expr: str, fn_name: str):
+    fn = next(
+        n for n in pf.walk(ast.FunctionDef) if n.name == fn_name
+    )
+    return df.absval(pf, ast.parse(expr, mode="eval").body, fn)
+
+
+class TestRangeDataflowEngine:
+    def test_interval_hull_join_across_reassignment(self):
+        pf = _pf(
+            """
+            def f(flag):
+                x = 1
+                if flag:
+                    x = 5
+                return x
+            """
+        )
+        df = dataflow.RangeDataflow([pf])
+        v = _absval(df, pf, "x", "f")
+        assert (v.lo, v.hi) == (1, 5)
+        assert v.values == {1, 5}
+
+    def test_clamp_pattern_bounds_unknown_input(self):
+        pf = _pf(
+            """
+            def f(t):
+                y = min(max(float(t), -1.0), 1.0)
+                return y
+            """
+        )
+        df = dataflow.RangeDataflow([pf])
+        v = _absval(df, pf, "y", "f")
+        assert (v.lo, v.hi) == (-1.0, 1.0)
+
+    def test_augassign_accumulates_the_hull(self):
+        pf = _pf(
+            """
+            def f(a, b):
+                cost = 1.0
+                cost += min(max(float(a), -1.0), 1.0)
+                cost += min(max(float(b), -8.0), 8.0)
+                return cost
+            """
+        )
+        df = dataflow.RangeDataflow([pf])
+        v = _absval(df, pf, "cost", "f")
+        assert (v.lo, v.hi) == (-8.0, 10.0)
+
+    def test_guards_intersect_taints_union_on_join(self):
+        a = dataflow.AbsVal(taints={dataflow.WIRE}, guards={dataflow.CLAMPED})
+        b = dataflow.AbsVal(taints=set(), guards=set())
+        a.join(b)
+        assert dataflow.WIRE in a.taints  # union: tainted anywhere
+        assert dataflow.CLAMPED not in a.guards  # intersection: all paths
+
+    def test_normalizer_call_grants_the_clamped_guard(self):
+        pf = _pf(
+            """
+            def f(raw):
+                t = priority_tier(int(raw))
+                return t
+            """
+        )
+        df = dataflow.RangeDataflow([pf])
+        v = _absval(df, pf, "t", "f")
+        assert dataflow.CLAMPED in v.guards
+        assert v.fits_dtype("int32")
+
+    def test_wire_seed_and_cross_function_attr_summary(self):
+        """The interprocedural chain GL601 resolves: a decode function's
+        constructor kwarg records a wire-tainted attribute summary that an
+        attribute read in ANOTHER function (file) observes."""
+        pf = _pf(
+            """
+            class Claim:
+                pass
+
+            def _decode_claim(d):
+                return Claim(weight=int(d["weight"]))
+            """
+        )
+        pf2 = _pf(
+            """
+            def use(c):
+                w = c.weight
+                return w
+            """,
+            relpath="karpenter_core_tpu/models/mini_use.py",
+        )
+        df = dataflow.RangeDataflow([pf, pf2])
+        v = _absval(df, pf2, "w", "use")
+        assert dataflow.WIRE in v.taints
+        assert dataflow.CLAMPED not in v.guards
+
+    def test_recursion_widens_to_top_and_terminates(self):
+        """Widening termination: a self-recursive accumulator must yield
+        the unknown interval instead of looping the fixpoint."""
+        pf = _pf(
+            """
+            def grow(n):
+                if n <= 0:
+                    return 0
+                return grow(n - 1) + 1
+
+            def f(n):
+                g = grow(n)
+                return g
+            """
+        )
+        df = dataflow.RangeDataflow([pf])  # must terminate
+        v = _absval(df, pf, "g", "f")
+        assert not v.within(-(2 ** 31), 2 ** 31)  # unknown, never "fits"
+
+    def test_sentinel_liveness_through_named_constants(self):
+        """Module-level constants resolve, so the hoisted GANG_* names
+        keep -2 positively live where the literal used to be."""
+        pf = _pf(
+            """
+            import numpy as np
+
+            GANG_FREE = -1
+            GANG_FALLBACK_STRADDLING = -2
+
+            def f():
+                gang_of_class = np.full((4,), GANG_FREE, dtype=np.int32)
+                gang_of_class[0] = GANG_FALLBACK_STRADDLING
+                return gang_of_class
+            """,
+            relpath="karpenter_core_tpu/ops/mini_gang.py",
+        )
+        df = dataflow.RangeDataflow([pf])
+        v = _absval(df, pf, "gang_of_class", "f")
+        assert v.values == {-1, -2}
+        assert "gang" in v.sentinels
+
+    def test_pad_taint_set_by_pad_and_cleared_by_where(self):
+        pf = _pf(
+            """
+            import jax.numpy as jnp
+
+            def f(scores, n):
+                padded = jnp.pad(scores, (0, 8))
+                masked = jnp.where(jnp.arange(16) < n, padded, 1e30)
+                return masked
+            """,
+            relpath="karpenter_core_tpu/ops/mini_pad.py",
+        )
+        df = dataflow.RangeDataflow([pf])
+        p = _absval(df, pf, "padded", "f")
+        m = _absval(df, pf, "masked", "f")
+        assert dataflow.PAD in p.taints and dataflow.MASKED not in p.guards
+        assert dataflow.MASKED in m.guards
+
+    def test_astype_narrowing_widens_unproven_interval(self):
+        pf = _pf(
+            """
+            import numpy as np
+
+            def f(x64):
+                small = x64.astype(np.int32)
+                return small
+            """
+        )
+        df = dataflow.RangeDataflow([pf])
+        v = _absval(df, pf, "small", "f")
+        assert v.dtype == "int32"
+        assert not v.known  # the cast wraps; nothing is proven
+
+
+class TestSentinelRegistry:
+    def test_gang_domain_seeds_from_solver_gangs(self):
+        from karpenter_core_tpu.solver import gangs
+
+        dom = dataflow.SENTINEL_DOMAINS["gang"]["values"]
+        assert dom == gangs.GANG_SENTINELS
+        assert gangs.GANG_SENTINELS["gang-free"] == gangs.GANG_FREE == -1
+        assert (
+            gangs.GANG_SENTINELS["fallback-straddling"]
+            == gangs.GANG_FALLBACK_STRADDLING
+            == -2
+        )
+
+    def test_kernel_and_prep_import_the_constants(self):
+        from karpenter_core_tpu.models import provisioner
+        from karpenter_core_tpu.ops import gangsched
+
+        assert gangsched.GANG_FREE == -1
+        assert provisioner.gangmod.GANG_FREE == -1
+        assert provisioner.gangmod.GANG_FALLBACK_STRADDLING == -2
+
+
+class TestDecodeNetClamps:
+    def test_vocab_gt_lt_clamp_to_sentinel_bounds(self):
+        """A hostile 2**40 Gt bound must not wrap inside the int32 device
+        planes — it clamps to the sentinel range, which is exact within
+        the closed world (every vocab value lies strictly inside)."""
+        from karpenter_core_tpu.scheduling.requirement import Requirement
+        from karpenter_core_tpu.scheduling.requirements import Requirements
+        from karpenter_core_tpu.solver.vocab import (
+            GT_NONE,
+            LT_NONE,
+            Vocab,
+            encode_requirements_batch,
+        )
+
+        reqs = Requirements()
+        reqs.add(Requirement("size", complement=True, greater_than=2 ** 40))
+        reqs.add(Requirement("rank", complement=True, less_than=-(2 ** 40)))
+        v = Vocab()
+        v.observe_requirements(reqs)
+        frozen = v.finalize()
+        masks = encode_requirements_batch(frozen, [reqs])
+        assert masks.gt.dtype == np.int32 and masks.lt.dtype == np.int32
+        kid_size = frozen.keys["size"]
+        kid_rank = frozen.keys["rank"]
+        # pre-fix this wrapped to a NEGATIVE int32 (2**40 % 2**32 ... sign
+        # flip), silently admitting everything the bound excluded
+        assert masks.gt[0, kid_size] == LT_NONE
+        assert masks.lt[0, kid_rank] == GT_NONE
+        assert (masks.gt[0] >= GT_NONE).all()
+        assert (masks.lt[0] <= LT_NONE).all()
+
+    def test_codec_clamp_slots(self):
+        from karpenter_core_tpu.solver.codec import _MAX_SLOTS_CAP, _clamp_slots
+
+        assert _clamp_slots(256) == 256
+        assert _clamp_slots(2 ** 40) == _MAX_SLOTS_CAP
+        assert _clamp_slots(0) == 1
+        assert _clamp_slots(-5) == 1
+        with pytest.raises(ValueError):
+            _clamp_slots("not-a-number")
+
+    def test_decode_solve_request_clamps_hostile_max_slots(self):
+        from karpenter_core_tpu.solver import codec
+
+        wire = codec.encode_solve_request(
+            nodepools=[],
+            instance_types={},
+            existing_nodes=[],
+            daemonset_pods=[],
+            pods=[],
+            topology=None,
+            max_slots=2 ** 40,
+        )
+        decoded = codec.decode_solve_request(wire)
+        assert decoded["max_slots"] == codec._MAX_SLOTS_CAP
+
+    def test_decode_frontier_request_clamps_hostile_max_slots(self):
+        from karpenter_core_tpu.solver import codec
+
+        wire = codec.encode_frontier_request(
+            nodepools=[],
+            instance_types={},
+            cand_nodes=[],
+            keep_nodes=[],
+            daemonset_pods=[],
+            base_pods=[],
+            candidate_pods=[],
+            max_slots=2 ** 40,
+        )
+        decoded = codec.decode_frontier_request(wire)
+        assert decoded["max_slots"] == codec._MAX_SLOTS_CAP
+
+    def test_legit_max_slots_roundtrips_unchanged(self):
+        from karpenter_core_tpu.solver import codec
+
+        wire = codec.encode_solve_request(
+            nodepools=[], instance_types={}, existing_nodes=[],
+            daemonset_pods=[], pods=[], topology=None, max_slots=1024,
+        )
+        assert codec.decode_solve_request(wire)["max_slots"] == 1024

@@ -377,3 +377,844 @@ def get(files: List[ParsedFile]) -> ProjectDataflow:
             _CACHE.clear()
         _CACHE[key] = df
     return df
+
+
+# ===========================================================================
+# Second abstract domain (ISSUE 11): value ranges, dtype width, taints.
+#
+# The provenance lattice above answers "WHERE has this array been?"
+# (host/device/sharded). The GL6xx rangecheck family needs a second,
+# orthogonal question answered per value: "WHAT can this integer BE?" —
+# its static interval, the dtype width it is stored at, whether it
+# originated on the wire, whether it carries inert padding, and which
+# registered sentinel domain its negative magic numbers belong to. The
+# same engine shape carries it: per-function environments, constructor /
+# attribute-store summaries, return summaries joined over every return
+# site, all iterated eagerly to a fixpoint over the scanned set and
+# cached by content hash.
+#
+# Join discipline (the noise/soundness split the GL5xx rules pinned):
+#
+# * intervals join by HULL — imprecision widens toward (-inf, +inf),
+#   which every consumer treats as "unknown" and stays silent on unless a
+#   taint demands otherwise;
+# * TAINTS (wire, pad, padsize, sentinel domains) join by UNION — a value
+#   that is wire-derived on ANY path is wire-derived;
+# * GUARDS (clamped-by-normalizer, masked) join by INTERSECTION — a value
+#   is only clamped if EVERY contributing store/path clamped it. This is
+#   what lets GL601 see through the attribute-summary whitewash: if one
+#   EvictablePod constructor site drops its priority_tier clamp, the
+#   project-wide `priority` summary loses the guard even though the
+#   other sites kept theirs.
+# * recursion widens to TOP immediately (a cyclic return summary yields
+#   the unknown interval), so the fixpoint terminates on any input — the
+#   widening-termination property the engine unit tests pin.
+# ===========================================================================
+
+INF = float("inf")
+
+# taints (union-join)
+WIRE = "wire"  # decoded from a solver wire payload
+PAD = "pad"  # array content includes inert padding rows/slots
+PADSIZE = "padsize"  # a SIZE minted by a padding helper (pad_to_devices)
+
+# guards (intersection-join)
+CLAMPED = "clamped"  # passed a registered normalizer or an explicit clip
+MASKED = "masked"  # routed through a masking step (jnp.where etc.)
+
+# dtype bounds; NARROW_INT_DTYPES are the widths GL601 polices stores into
+INT_BOUNDS = {
+    "int8": (-(2 ** 7), 2 ** 7 - 1),
+    "int16": (-(2 ** 15), 2 ** 15 - 1),
+    "int32": (-(2 ** 31), 2 ** 31 - 1),
+    "int64": (-(2 ** 63), 2 ** 63 - 1),
+}
+NARROW_INT_DTYPES = frozenset({"int8", "int16", "int32"})
+
+# Registered normalizers: call tails that map an arbitrary host/wire int
+# into a documented codomain. Calling one both bounds the interval and
+# grants the CLAMPED guard — the sanctioned way through a GL601 narrowing
+# store. utils/disruption.priority_tier is THE tier normalizer (kernel /
+# fallback / verifier all ride it); codec._clamp_slots is the decode-net
+# clamp for the wire's slot ceiling.
+RANGE_NORMALIZERS: Dict[str, tuple] = {
+    "priority_tier": (-(2 ** 31 - 1), 2 ** 31 - 1),
+    "_clamp_slots": (1, 1 << 20),
+}
+
+# calls whose result is explicitly clipped: (lo-arg index, hi-arg index)
+_CLIP_CALLS = {"clip"}  # np.clip / jnp.clip / ndarray.clip
+
+# padding producers: results carry array-content PAD; size producers
+# carry PADSIZE (an array constructed with a PADSIZE shape is PAD)
+_PAD_ARRAY_CALLS = {"_pad", "pad"}  # models/provisioner._pad, np/jnp.pad
+_PAD_SIZE_CALLS = {"pad_to_devices", "_bucket", "_bucket_steps",
+                   "_pow2_bucket"}
+
+# masking calls: the sanctioned step between padded content and a
+# reduction (GL604)
+_MASK_CALLS = {"where"}
+
+# numpy-ish array constructors whose dtype= kw types the array
+_ARRAY_CTORS = {"zeros", "ones", "full", "empty", "array", "asarray",
+                "arange", "full_like", "zeros_like", "ones_like"}
+
+
+def _seed_sentinel_domains() -> Dict[str, dict]:
+    """The sentinel-domain registry: domain -> {values: {label: int},
+    names: exact value names, prefixes: name prefixes}. The gang domain
+    seeds from solver/gangs.GANG_SENTINELS — the single source the kernel
+    (ops/gangsched) and the prep layer (models/provisioner) import — with
+    a literal fallback so a standalone fixture lint (or a checkout whose
+    package cannot import) still checks the same contract."""
+    try:
+        from karpenter_core_tpu.solver.gangs import GANG_SENTINELS
+
+        gang_values = dict(GANG_SENTINELS)
+    except Exception:  # pragma: no cover - import-degraded environments
+        gang_values = {"gang-free": -1, "fallback-straddling": -2}
+    return {
+        "gang": {
+            "values": gang_values,
+            "names": {"step_gang", "gang_j", "goc", "gang_id", "gid"},
+            "prefixes": ("gang_of",),
+        },
+        "template": {
+            "values": {"no-template": -1},
+            "names": {"new_template", "slot_template", "template_arr"},
+            "prefixes": (),
+        },
+    }
+
+
+SENTINEL_DOMAINS: Dict[str, dict] = _seed_sentinel_domains()
+
+
+def sentinel_domain_of(name: str) -> Optional[str]:
+    """The registered sentinel domain a bare value name belongs to, or
+    None. Matched on the dotted tail (``prep.step_gang`` -> gang)."""
+    if not name:
+        return None
+    tail = name.rsplit(".", 1)[-1]
+    for dom, spec in SENTINEL_DOMAINS.items():
+        if tail in spec["names"]:
+            return dom
+        if any(tail.startswith(p) for p in spec["prefixes"]):
+            return dom
+    return None
+
+
+class AbsVal:
+    """One value's abstract state in the range domain (mutable; joined in
+    place inside environments and summaries)."""
+
+    __slots__ = ("lo", "hi", "dtype", "taints", "guards", "values",
+                 "sentinels")
+
+    _VALUES_CAP = 8  # beyond this the exact-value set degrades to unknown
+
+    def __init__(self, lo=-INF, hi=INF, dtype=None, taints=(), guards=(),
+                 values=None, sentinels=()):
+        self.lo = lo
+        self.hi = hi
+        self.dtype = dtype
+        self.taints = set(taints)
+        self.guards = set(guards)
+        # None = could be anything; a set = positively-known candidates
+        self.values = set(values) if values is not None else None
+        self.sentinels = set(sentinels)
+
+    # -- lattice operations ------------------------------------------------
+
+    def copy(self) -> "AbsVal":
+        return AbsVal(self.lo, self.hi, self.dtype, self.taints,
+                      self.guards, self.values, self.sentinels)
+
+    def join(self, other: "AbsVal") -> "AbsVal":
+        self.lo = min(self.lo, other.lo)
+        self.hi = max(self.hi, other.hi)
+        if self.dtype != other.dtype:
+            self.dtype = None
+        self.taints |= other.taints
+        self.guards &= other.guards
+        if self.values is None or other.values is None:
+            self.values = None
+        else:
+            self.values |= other.values
+            if len(self.values) > self._VALUES_CAP:
+                self.values = None
+        self.sentinels |= other.sentinels
+        return self
+
+    def join_element(self, stored: "AbsVal") -> None:
+        """An element store (``arr[i] = v``): the array keeps its dtype —
+        that coercion is exactly what GL601 polices — but its CONTENT
+        hull, taints and value set absorb the stored value."""
+        self.lo = min(self.lo, stored.lo)
+        self.hi = max(self.hi, stored.hi)
+        self.taints |= stored.taints
+        self.guards &= stored.guards
+        if self.values is None or stored.values is None:
+            self.values = None
+        else:
+            self.values |= stored.values
+            if len(self.values) > self._VALUES_CAP:
+                self.values = None
+        self.sentinels |= stored.sentinels
+
+    # -- queries the rules ask ---------------------------------------------
+
+    @property
+    def known(self) -> bool:
+        return self.lo != -INF or self.hi != INF
+
+    def within(self, lo: float, hi: float) -> bool:
+        """Positively known to fit [lo, hi]."""
+        return self.lo >= lo and self.hi <= hi
+
+    def fits_dtype(self, dtype: str) -> bool:
+        b = INT_BOUNDS.get(dtype)
+        return b is not None and self.within(b[0], b[1])
+
+    def live_values(self) -> frozenset:
+        return frozenset(self.values or ())
+
+    def __repr__(self) -> str:  # debugging aid, not part of any contract
+        return (
+            f"AbsVal([{self.lo}, {self.hi}], dtype={self.dtype},"
+            f" taints={sorted(self.taints)}, guards={sorted(self.guards)},"
+            f" values={self.values}, sentinels={sorted(self.sentinels)})"
+        )
+
+
+def _unknown() -> AbsVal:
+    return AbsVal()
+
+
+def _mentions_name(expr: ast.AST, name: str) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id == name for n in ast.walk(expr)
+    )
+
+
+def _literal_number(node: ast.AST):
+    """int/float of a literal expression (``-1`` is UnaryOp(USub, 1)),
+    None otherwise. Bools are NOT numbers here."""
+    if isinstance(node, ast.Constant):
+        v = node.value
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return v
+        return None
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _literal_number(node.operand)
+        return -v if v is not None else None
+    return None
+
+
+def _dtype_name(node: ast.AST) -> Optional[str]:
+    """'int32' from np.int32 / jnp.int32 / 'int32' / "int32"-ish nodes."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in INT_BOUNDS else None
+    name = dotted_name(node)
+    tail = name.rsplit(".", 1)[-1] if name else ""
+    return tail if tail in INT_BOUNDS else None
+
+
+def _wire_decoder(pf: ParsedFile, fn) -> bool:
+    """Functions whose parameters are wire payloads: the solver codec's
+    decode family (decode_* / _decode_*) in solver/ modules. Kept narrow
+    on purpose — the models/ decode phase decodes DEVICE results, not
+    attacker-reachable bytes, and a wide seed would drown GL601 in host
+    noise."""
+    if "/solver/" not in f"/{pf.relpath}":
+        return False
+    name = getattr(fn, "name", "")
+    return name.startswith("decode") or name.startswith("_decode")
+
+
+class RangeDataflow:
+    """Interval/dtype/taint queries over one scanned file set.
+
+    Structured exactly like :class:`ProjectDataflow` (same eager two-pass
+    summary construction, same weak memoization, same name-tail call
+    resolution) over :class:`AbsVal` instead of a tag set. Use
+    :func:`get_ranges`."""
+
+    def __init__(self, files: List[ParsedFile]):
+        self.files = files
+        self.defs: Dict[str, List] = {}
+        self.classes: Dict[str, ast.ClassDef] = {}
+        for pf in files:
+            for node in pf.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+                self.defs.setdefault(node.name, []).append((pf, node))
+            for node in pf.walk(ast.ClassDef):
+                self.classes.setdefault(node.name, node)
+        self.attr_summary: Dict[str, AbsVal] = {}
+        # module-level integer constants, project-wide by bare name: lets
+        # `gangmod.GANG_FALLBACK_STRADDLING` (an Attribute read of another
+        # module) resolve to its literal so sentinel liveness survives the
+        # ISSUE 11 constant hoist instead of only seeing raw -2 literals
+        self.module_constants: Dict[str, AbsVal] = {}
+        for pf in files:
+            for st in pf.tree.body:
+                if not isinstance(st, ast.Assign):
+                    continue
+                v = _literal_number(st.value)
+                if v is None:
+                    continue
+                for tgt in st.targets:
+                    if isinstance(tgt, ast.Name):
+                        cur = self.module_constants.get(tgt.id)
+                        nv = AbsVal(
+                            lo=v, hi=v,
+                            values={v} if isinstance(v, int) else None,
+                        )
+                        if cur is None:
+                            self.module_constants[tgt.id] = nv
+                        else:
+                            cur.join(nv)
+        self._summaries = weakref.WeakKeyDictionary()
+        self._envs = weakref.WeakKeyDictionary()
+        self._in_progress: Set[int] = set()
+        for _ in range(2):
+            self._summaries.clear()
+            self._envs.clear()
+            for pf in files:
+                self._env_for(pf, None)
+                for node in pf.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+                    self._env_for(pf, node)
+
+    # -- public query ------------------------------------------------------
+
+    def absval(self, pf: ParsedFile, expr: ast.AST, fn) -> AbsVal:
+        """Abstract value of an expression in the local environment of
+        ``fn`` (None = module level)."""
+        env = self._env_for(pf, fn)
+        return self._eval(pf, expr, env, _MAX_DEPTH)
+
+    # -- environments ------------------------------------------------------
+
+    def _env_for(self, pf: ParsedFile, fn) -> Dict[str, AbsVal]:
+        key = fn if fn is not None else pf.tree
+        cached = self._envs.get(key)
+        if cached is not None:
+            return cached
+        env: Dict[str, AbsVal] = {}
+        self._envs[key] = env  # pre-bind: cycles read the partial env
+        if isinstance(fn, ast.Lambda):
+            return env
+        if fn is not None and _wire_decoder(pf, fn):
+            args = fn.args
+            for a in (
+                list(args.posonlyargs) + list(args.args)
+                + list(args.kwonlyargs)
+            ):
+                if a.arg != "self":
+                    env[a.arg] = AbsVal(taints={WIRE})
+        body = pf.tree.body if fn is None else fn.body
+        self._walk_stmts(pf, body, env, _MAX_DEPTH)
+        return env
+
+    def _join_into(self, env, name: str, val: AbsVal) -> None:
+        cur = env.get(name)
+        if cur is None:
+            env[name] = val.copy()
+        else:
+            cur.join(val)
+
+    def _walk_stmts(self, pf, stmts, env, depth, flow=True) -> None:
+        """``flow`` marks straight-line code that unconditionally executes
+        on every path through the enclosing scope: plain-Name assignments
+        there are STRONG updates (the binding is replaced), while inside
+        a branch/loop/try body they join with the fall-through binding.
+        Without the strong update, `n = np.clip(n, lo, hi)` would join
+        the clipped value with the old unclamped one and (guards being
+        intersection-joined) strip the very guard the clip granted — a
+        GL601 false positive on its own recommended remediation. A
+        self-referencing RHS (``x = f(x)``) is strong even inside a
+        branch: the old binding already flowed into the evaluation, and
+        degrading the not-taken path to the refined value errs toward
+        silence, never noise."""
+        for st in stmts:
+            if isinstance(
+                st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            if isinstance(st, ast.Assign):
+                v = self._eval(pf, st.value, env, depth)
+                strong = flow or (
+                    len(st.targets) == 1
+                    and isinstance(st.targets[0], ast.Name)
+                    and _mentions_name(st.value, st.targets[0].id)
+                )
+                for tgt in st.targets:
+                    self._bind(pf, tgt, st.value, v, env, depth,
+                               strong=strong)
+            elif isinstance(st, ast.AnnAssign) and st.value is not None:
+                v = self._eval(pf, st.value, env, depth)
+                self._bind(pf, st.target, st.value, v, env, depth,
+                           strong=flow)
+            elif isinstance(st, ast.AugAssign):
+                # x += t joins the RECOMPUTED x ⊕ t with the old x — the
+                # branch-insensitive hull a clamp-saturation check needs
+                # (GL603 reads the final accumulated interval)
+                old = self._eval(pf, st.target, env, depth)
+                rhs = self._eval(pf, st.value, env, depth)
+                new = self._arith(type(st.op), old, rhs)
+                if isinstance(st.target, ast.Name):
+                    self._join_into(env, st.target.id, new)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                it = self._eval(pf, st.iter, env, depth)
+                self._bind_loop_target(pf, st.target, it, env)
+                self._walk_stmts(pf, st.body, env, depth, flow=False)
+                self._walk_stmts(pf, st.orelse, env, depth, flow=False)
+            elif isinstance(st, (ast.If, ast.While)):
+                if isinstance(st, ast.If):
+                    self._eval(pf, st.test, env, depth)
+                self._walk_stmts(pf, st.body, env, depth, flow=False)
+                self._walk_stmts(pf, st.orelse, env, depth, flow=False)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    if item.optional_vars is not None:
+                        v = self._eval(pf, item.context_expr, env, depth)
+                        self._bind(
+                            pf, item.optional_vars, item.context_expr, v,
+                            env, depth, strong=flow,
+                        )
+                # a with-body executes unconditionally: flow carries over
+                self._walk_stmts(pf, st.body, env, depth, flow=flow)
+            elif isinstance(st, ast.Try):
+                # a try body may execute PARTIALLY — bindings join
+                self._walk_stmts(pf, st.body, env, depth, flow=False)
+                for h in st.handlers:
+                    self._walk_stmts(pf, h.body, env, depth, flow=False)
+                self._walk_stmts(pf, st.orelse, env, depth, flow=False)
+                self._walk_stmts(pf, st.finalbody, env, depth, flow=False)
+            elif isinstance(st, (ast.Return, ast.Expr)):
+                if st.value is not None:
+                    self._eval(pf, st.value, env, depth)
+
+    def _bind_loop_target(self, pf, target, iter_val: AbsVal, env) -> None:
+        """Iterating an array yields elements with the array's dtype,
+        hull, taints and values (the evictable-plane row walk)."""
+        if isinstance(target, ast.Name):
+            self._join_into(env, target.id, iter_val)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for t in target.elts:
+                self._bind_loop_target(pf, t, iter_val, env)
+
+    def _bind(self, pf, target, value, val: AbsVal, env, depth,
+              strong=False) -> None:
+        if isinstance(target, ast.Name):
+            if strong:
+                env[target.id] = val.copy()
+            else:
+                self._join_into(env, target.id, val)
+        elif isinstance(target, ast.Starred):
+            self._bind(pf, target.value, value, val, env, depth,
+                       strong=strong)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            if isinstance(value, (ast.Tuple, ast.List)) and len(
+                value.elts
+            ) == len(target.elts):
+                for t, v in zip(target.elts, value.elts):
+                    self._bind(
+                        pf, t, v, self._eval(pf, v, env, depth), env,
+                        depth, strong=strong,
+                    )
+            else:
+                for t in target.elts:
+                    self._bind(pf, t, value, val, env, depth,
+                               strong=strong)
+        elif isinstance(target, ast.Attribute):
+            if not (isinstance(value, ast.Constant) and value.value is None):
+                cur = self.attr_summary.get(target.attr)
+                if cur is None:
+                    self.attr_summary[target.attr] = val.copy()
+                else:
+                    cur.join(val)
+        elif isinstance(target, ast.Subscript):
+            # element store: the base array absorbs the stored content
+            if isinstance(target.value, ast.Name):
+                base = env.get(target.value.id)
+                if base is not None:
+                    base.join_element(val)
+
+    # -- expression evaluation ---------------------------------------------
+
+    def _eval(self, pf, node: ast.AST, env, depth) -> AbsVal:
+        if isinstance(node, ast.Constant):
+            v = node.value
+            if isinstance(v, bool):
+                return AbsVal(lo=0, hi=1, values={int(v)})
+            if isinstance(v, int):
+                return AbsVal(lo=v, hi=v, values={v})
+            if isinstance(v, float):
+                return AbsVal(lo=v, hi=v)
+            return _unknown()
+        if isinstance(node, ast.Name):
+            out = env.get(node.id)
+            if out is None:
+                out = self.module_constants.get(node.id)
+            out = out.copy() if out is not None else _unknown()
+            dom = sentinel_domain_of(node.id)
+            if dom is not None:
+                out.sentinels.add(dom)
+            return out
+        if isinstance(node, ast.Attribute):
+            if node.attr in _METADATA_ATTRS:
+                return _unknown()
+            base = self._eval(pf, node.value, env, depth)
+            summary = self.attr_summary.get(node.attr)
+            if summary is None:
+                summary = self.module_constants.get(node.attr)
+            # the attribute summary is FIELD-sensitive (every recorded
+            # store of this name, project-wide) while the base's own
+            # abstract value conflates a struct's fields — prefer the
+            # summary whenever one exists, else carry the container's
+            # taints (a wire dict's unrecorded members are wire)
+            if summary is not None:
+                out = summary.copy()
+            elif base.taints or base.sentinels:
+                # a tainted container's field reads keep the taints (a
+                # wire dict's members are wire) but not its numeric state
+                out = AbsVal(taints=base.taints, sentinels=base.sentinels)
+            else:
+                out = _unknown()
+            dom = sentinel_domain_of(node.attr)
+            if dom is not None:
+                out.sentinels.add(dom)
+            return out
+        if isinstance(node, ast.Call):
+            return self._eval_call(pf, node, env, depth)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = None
+            for e in node.elts:
+                v = self._eval(pf, e, env, depth)
+                out = v if out is None else out.join(v)
+            return out if out is not None else _unknown()
+        if isinstance(node, ast.Dict):
+            out = None
+            for v_node in node.values:
+                if v_node is None:
+                    continue
+                v = self._eval(pf, v_node, env, depth)
+                out = v if out is None else out.join(v)
+            return out if out is not None else _unknown()
+        if isinstance(node, ast.Subscript):
+            base = self._eval(pf, node.value, env, depth)
+            self._eval(pf, node.slice, env, depth)
+            out = base.copy()
+            # slicing/indexing off an array is how padding is windowed
+            # away (the used-slot fetch) — drop the pad taint, keep the
+            # rest (an element of a wire dict is wire; an element of an
+            # int32 plane is an int32 scalar)
+            out.taints.discard(PAD)
+            return out
+        if isinstance(node, ast.IfExp):
+            self._eval(pf, node.test, env, depth)
+            return self._eval(pf, node.body, env, depth).join(
+                self._eval(pf, node.orelse, env, depth)
+            )
+        if isinstance(node, ast.BinOp):
+            left = self._eval(pf, node.left, env, depth)
+            right = self._eval(pf, node.right, env, depth)
+            return self._arith(type(node.op), left, right)
+        if isinstance(node, ast.UnaryOp):
+            v = self._eval(pf, node.operand, env, depth)
+            if isinstance(node.op, ast.USub):
+                lo, hi = v.lo, v.hi
+                v.lo, v.hi = -hi, -lo
+                if v.values is not None:
+                    v.values = {-x for x in v.values}
+            return v
+        if isinstance(node, ast.BoolOp):
+            out = None
+            for e in node.values:
+                v = self._eval(pf, e, env, depth)
+                out = v if out is None else out.join(v)
+            return out if out is not None else _unknown()
+        if isinstance(node, ast.Compare):
+            self._eval(pf, node.left, env, depth)
+            for c in node.comparators:
+                self._eval(pf, c, env, depth)
+            return AbsVal(lo=0, hi=1, values={0, 1})
+        if isinstance(node, ast.NamedExpr):
+            v = self._eval(pf, node.value, env, depth)
+            self._join_into(env, node.target.id, v)
+            return v
+        if isinstance(node, ast.Starred):
+            return self._eval(pf, node.value, env, depth)
+        if isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+        ):
+            # the codec's decode loops are comprehensions (`tuple(
+            # EvictablePod(...) for e in d.get("evictable", ()))`): bind
+            # each target from its iter so the element expression — and
+            # its constructor-recording side effects — evaluates in scope
+            for gen in node.generators:
+                it = self._eval(pf, gen.iter, env, depth)
+                self._bind_loop_target(pf, gen.target, it, env)
+                for cond in gen.ifs:
+                    self._eval(pf, cond, env, depth)
+            if isinstance(node, ast.DictComp):
+                self._eval(pf, node.key, env, depth)
+                return self._eval(pf, node.value, env, depth)
+            return self._eval(pf, node.elt, env, depth)
+        return _unknown()
+
+    @staticmethod
+    def _arith(op, left: AbsVal, right: AbsVal) -> AbsVal:
+        out = AbsVal(
+            taints=left.taints | right.taints,
+            sentinels=left.sentinels | right.sentinels,
+        )
+        if op is ast.Add:
+            out.lo, out.hi = left.lo + right.lo, left.hi + right.hi
+        elif op is ast.Sub:
+            out.lo, out.hi = left.lo - right.hi, left.hi - right.lo
+        elif op is ast.Mult and left.known and right.known:
+            prods = [left.lo * right.lo, left.lo * right.hi,
+                     left.hi * right.lo, left.hi * right.hi]
+            out.lo, out.hi = min(prods), max(prods)
+        elif op is ast.Div and right.known and (
+            right.lo > 0 or right.hi < 0
+        ) and left.known:
+            quots = [left.lo / right.lo, left.lo / right.hi,
+                     left.hi / right.lo, left.hi / right.hi]
+            out.lo, out.hi = min(quots), max(quots)
+        # every other operator: unknown interval, taints carried
+        return out
+
+    def _eval_call(self, pf, node: ast.Call, env, depth) -> AbsVal:
+        name = dotted_name(node.func)
+        tail = name.rsplit(".", 1)[-1] if name else ""
+
+        # registered normalizers: bounded codomain + the CLAMPED guard
+        norm = RANGE_NORMALIZERS.get(tail)
+        if norm is not None:
+            for a in node.args:
+                self._eval(pf, a, env, depth)
+            return AbsVal(lo=norm[0], hi=norm[1], guards={CLAMPED})
+
+        if tail in _CLIP_CALLS:
+            # np.clip(x, lo, hi) / x.clip(lo, hi): the explicit-clip form
+            args = list(node.args)
+            if isinstance(node.func, ast.Attribute) and name not in (
+                "np.clip", "jnp.clip", "numpy.clip", "jax.numpy.clip",
+            ):
+                args = [node.func.value] + args  # method form
+            vals = [self._eval(pf, a, env, depth) for a in args]
+            for kw in node.keywords:
+                self._eval(pf, kw.value, env, depth)
+            out = vals[0].copy() if vals else _unknown()
+            if len(vals) >= 2 and vals[1].known:
+                out.lo = max(out.lo, vals[1].lo)
+            if len(vals) >= 3 and vals[2].known:
+                out.hi = min(out.hi, vals[2].hi)
+                out.lo = min(out.lo, out.hi)
+            out.guards.add(CLAMPED)
+            out.values = None
+            return out
+
+        if tail in ("min", "max") and name in ("min", "max") and len(
+            node.args
+        ) >= 2:
+            vals = [self._eval(pf, a, env, depth) for a in node.args]
+            out = AbsVal(
+                taints=set().union(*(v.taints for v in vals)),
+                sentinels=set().union(*(v.sentinels for v in vals)),
+            )
+            if tail == "min":
+                out.lo = min(v.lo for v in vals)
+                out.hi = min(v.hi for v in vals)
+            else:
+                out.lo = max(v.lo for v in vals)
+                out.hi = max(v.hi for v in vals)
+            return out
+
+        if name == "abs" and node.args:
+            v = self._eval(pf, node.args[0], env, depth)
+            out = AbsVal(taints=v.taints, sentinels=v.sentinels)
+            if v.known:
+                mags = [abs(v.lo), abs(v.hi)]
+                out.hi = max(mags)
+                out.lo = 0.0 if v.lo <= 0 <= v.hi else min(mags)
+            else:
+                out.lo = 0.0
+            return out
+
+        if name in ("int", "float", "bool") and node.args:
+            v = self._eval(pf, node.args[0], env, depth)
+            out = v.copy()
+            out.dtype = None  # a python scalar has no storage width
+            return out
+
+        if tail in _MASK_CALLS and len(node.args) >= 2:
+            # jnp.where(cond, x, y): the masking step — padded content is
+            # neutralized by construction
+            self._eval(pf, node.args[0], env, depth)
+            out = self._eval(pf, node.args[1], env, depth)
+            for a in node.args[2:]:
+                out.join(self._eval(pf, a, env, depth))
+            out.guards.add(MASKED)
+            return out
+
+        if tail in _PAD_SIZE_CALLS:
+            for a in node.args:
+                self._eval(pf, a, env, depth)
+            return AbsVal(lo=0, taints={PADSIZE})
+
+        if tail in _PAD_ARRAY_CALLS:
+            args = [self._eval(pf, a, env, depth) for a in node.args]
+            out = args[0].copy() if args else _unknown()
+            out.lo, out.hi = -INF, INF  # the fill extends the hull
+            out.values = None
+            out.taints.add(PAD)
+            return out
+
+        if tail in _ARRAY_CTORS and (
+            name.startswith(_NP_PREFIXES) or name.startswith(_JNP_PREFIXES)
+        ):
+            return self._eval_array_ctor(pf, node, env, depth, tail)
+
+        if tail == "astype" and isinstance(node.func, ast.Attribute):
+            src = self._eval(pf, node.func.value, env, depth)
+            out = src.copy()
+            if node.args:
+                dt = _dtype_name(node.args[0])
+                if dt is not None:
+                    out.dtype = dt
+                    if not src.fits_dtype(dt):
+                        # astype WRAPS out-of-range values: the interval
+                        # is no longer the source's
+                        out.lo, out.hi = -INF, INF
+                        out.values = None
+            return out
+
+        if tail == "get" and isinstance(node.func, ast.Attribute):
+            base = self._eval(pf, node.func.value, env, depth)
+            out = AbsVal(taints=base.taints, sentinels=base.sentinels)
+            if len(node.args) >= 2:
+                out.join(self._eval(pf, node.args[1], env, depth))
+            return out
+
+        if tail == "_replace" and isinstance(node.func, ast.Attribute):
+            out = self._eval(pf, node.func.value, env, depth)
+            for kw in node.keywords:
+                out.join(self._eval(pf, kw.value, env, depth))
+            return out
+
+        # constructor call: record keyword fields in the attribute
+        # summary (the EvictablePod(priority=...) chain GL601 resolves)
+        cls = self.classes.get(tail)
+        if cls is not None or (tail[:1].isupper() and tail not in self.defs):
+            out = None
+            for a in node.args:
+                v = self._eval(pf, a, env, depth)
+                out = v if out is None else out.join(v)
+            for kw in node.keywords:
+                kv = self._eval(pf, kw.value, env, depth)
+                if kw.arg:
+                    cur = self.attr_summary.get(kw.arg)
+                    if cur is None:
+                        self.attr_summary[kw.arg] = kv.copy()
+                    else:
+                        cur.join(kv)
+                out = kv if out is None else out.join(kv)
+            return out if out is not None else _unknown()
+
+        # project function/method: join the return summaries
+        candidates = self.defs.get(tail, ())
+        if candidates and depth > 0:
+            out = None
+            for cpf, fn in candidates[:_MAX_CANDIDATES]:
+                s = self._summary(cpf, fn, depth - 1)
+                out = s.copy() if out is None else out.join(s)
+            for a in node.args:
+                self._eval(pf, a, env, depth)
+            for kw in node.keywords:
+                self._eval(pf, kw.value, env, depth)
+            return out if out is not None else _unknown()
+
+        for a in node.args:
+            self._eval(pf, a, env, depth)
+        for kw in node.keywords:
+            self._eval(pf, kw.value, env, depth)
+        return _unknown()
+
+    def _eval_array_ctor(self, pf, node, env, depth, tail) -> AbsVal:
+        out = AbsVal()
+        dt = None
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                dt = _dtype_name(kw.value)
+            else:
+                self._eval(pf, kw.value, env, depth)
+        args = [self._eval(pf, a, env, depth) for a in node.args]
+        if dt is None and len(node.args) >= 3 and tail == "full":
+            dt = _dtype_name(node.args[2])
+        out.dtype = dt
+        if tail in ("zeros", "ones", "zeros_like", "ones_like"):
+            fill = 0 if tail.startswith("zeros") else 1
+            out.lo = out.hi = float(fill)
+            out.values = {fill}
+        elif tail in ("full", "full_like") and len(args) >= 2:
+            fill = args[1]
+            out.lo, out.hi = fill.lo, fill.hi
+            out.values = set(fill.values) if fill.values is not None else None
+            out.taints |= fill.taints
+            out.sentinels |= fill.sentinels
+        elif tail == "arange":
+            out.lo = 0.0
+            out.values = None
+        elif tail in ("array", "asarray") and args:
+            src = args[0]
+            out.lo, out.hi = src.lo, src.hi
+            out.values = set(src.values) if src.values is not None else None
+            out.taints |= src.taints
+            out.guards = set(src.guards)
+            out.sentinels |= src.sentinels
+        # a PADSIZE-shaped constructor mints padded content
+        if args and PADSIZE in args[0].taints:
+            out.taints.add(PAD)
+        return out
+
+    def _summary(self, pf, fn, depth) -> AbsVal:
+        cached = self._summaries.get(fn)
+        if cached is not None:
+            return cached
+        if id(fn) in self._in_progress or depth <= 0:
+            # recursion (or the depth cap): widen to TOP immediately — the
+            # termination guarantee the engine tests pin
+            return _unknown()
+        self._in_progress.add(id(fn))
+        try:
+            env = self._env_for(pf, fn)
+            out = None
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    owner = pf.enclosing_function(node)
+                    if owner is fn:
+                        v = self._eval(pf, node.value, env, depth)
+                        out = v if out is None else out.join(v)
+            result = out if out is not None else _unknown()
+            self._summaries[fn] = result
+            return result
+        finally:
+            self._in_progress.discard(id(fn))
+
+
+_RANGE_CACHE: Dict[str, RangeDataflow] = {}
+
+
+def get_ranges(files: List[ParsedFile]) -> RangeDataflow:
+    """The (content-hash cached) range-domain index for one scanned set."""
+    key = _content_key(files)
+    df = _RANGE_CACHE.get(key)
+    if df is None:
+        df = RangeDataflow(files)
+        if len(_RANGE_CACHE) > 8:
+            _RANGE_CACHE.clear()
+        _RANGE_CACHE[key] = df
+    return df

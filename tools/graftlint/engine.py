@@ -64,8 +64,11 @@ REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
 # incremental-mode finding cache (gitignored): per-file results keyed on
 # (content hash, rule-set hash), so an unchanged file never re-runs the
-# file-scope rules. Project-scope rules re-run every pass by construction.
+# file-scope rules. Project-scope rules re-run whenever ANY scanned file
+# (or any rule source) changes — their joint verdict is cached under the
+# reserved _PROJECT_CACHE_KEY entry keyed on the whole scanned set.
 CACHE_PATH = Path(__file__).resolve().parent / ".finding_cache.json"
+_PROJECT_CACHE_KEY = "__project__"
 
 # single source of truth for the tier-1 wall-time budget: the test gate
 # (tests/test_graftlint.py) and bench.py --lint both enforce this value
@@ -385,6 +388,44 @@ def _load_cache(path: Path) -> dict:
     return data if isinstance(data, dict) else {}
 
 
+def changed_relpaths(base: Optional[str] = None) -> set:
+    """Repo-relative paths changed vs the merge-base (``--changed-only``).
+
+    ``base`` defaults to the merge-base of HEAD with the first of
+    origin/main, origin/master, main, master that resolves. The set is
+    working-tree honest: committed + staged + unstaged diffs against the
+    base, plus untracked files. Returns an empty set when git is
+    unavailable — the caller then lints nothing file-scoped, which is the
+    right answer for "what did I change" on a clean tree."""
+    import subprocess
+
+    def _git(*args) -> Optional[str]:
+        try:
+            r = subprocess.run(
+                ["git", *args], cwd=REPO_ROOT, capture_output=True,
+                text=True, timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return r.stdout if r.returncode == 0 else None
+
+    if base is None:
+        for cand in ("origin/main", "origin/master", "main", "master"):
+            out = _git("merge-base", "HEAD", cand)
+            if out and out.strip():
+                base = out.strip()
+                break
+    changed = set()
+    if base is not None:
+        out = _git("diff", "--name-only", base)
+        if out:
+            changed |= {ln.strip() for ln in out.splitlines() if ln.strip()}
+    out = _git("ls-files", "--others", "--exclude-standard")
+    if out:
+        changed |= {ln.strip() for ln in out.splitlines() if ln.strip()}
+    return {p for p in changed if p.endswith(".py")}
+
+
 def run(
     paths: List[str],
     use_baseline: bool = True,
@@ -392,6 +433,7 @@ def run(
     baseline_path: Optional[Path] = None,
     jobs: int = 1,
     cache_path: Optional[Path] = None,
+    restrict_to: Optional[set] = None,
 ) -> RunResult:
     """Run every registered rule over ``paths``; returns the partitioned
     findings. ``rule_ids`` restricts the pass (rule unit tests).
@@ -399,9 +441,16 @@ def run(
     Incremental mode: with ``cache_path`` set (and no rule restriction),
     file-scope findings are cached per file keyed on (content hash,
     rule-set hash) — an unchanged file costs one dict lookup. Project-
-    scope rules (cross-file parity, the sharding dataflow family) re-run
-    every pass: their verdicts depend on the whole scanned set.
-    ``jobs > 1`` fans the uncached file-scope work over a process pool.
+    scope rules (cross-file parity, the sharding/rangecheck dataflow
+    families) re-run whenever any scanned file or rule changes; their
+    joint verdict is cached per scanned-set content. ``jobs > 1`` fans
+    the uncached file-scope work over a process pool.
+
+    ``restrict_to`` (a set of repo-relative paths — ``--changed-only``
+    passes the merge-base diff) limits the FILE-scope rules to those
+    files; project-scope rules still parse and check the full ``paths``
+    set, because their verdicts (wire locks, cross-file routing, the
+    attribute-summary joins) depend on files the diff didn't touch.
     """
     from tools.graftlint import rules as _rules  # noqa: F401 (registration)
 
@@ -421,11 +470,30 @@ def run(
     caching = cache_path is not None and rule_ids is None
     cache_data = _load_cache(cache_path) if caching else {}
     rhash = _rules_hash() if caching else ""
+    # project-scope verdict cache: the project rules' findings depend on
+    # exactly (the full scanned set's content, the rule-set hash) — with
+    # both unchanged, a warm run skips the dataflow index builds and the
+    # cross-file fixpoints entirely (what keeps the warm incremental run
+    # ≈1s as the project-rule families grow). Any file edit, add, delete
+    # or rule change flips the key.
+    project_key = None
+    project_cached = None
+    if caching and all(not pf.relpath.startswith("/") for pf in files):
+        ph = hashlib.sha256()
+        for pf in sorted(files, key=lambda p: p.relpath):
+            ph.update(pf.relpath.encode())
+            ph.update(hashlib.sha256(pf.source.encode()).digest())
+        project_key = ph.hexdigest() + ":" + rhash
+        ent = cache_data.get(_PROJECT_CACHE_KEY)
+        if isinstance(ent, dict) and ent.get("key") == project_key:
+            project_cached = ent
     per_file: Dict[str, dict] = {}
     file_keys: Dict[str, str] = {}
     cache_hits = cache_misses = 0
     pending: List[ParsedFile] = []
     for pf in files:
+        if restrict_to is not None and pf.relpath not in restrict_to:
+            continue  # --changed-only: file-scope skipped, not cached
         if caching:
             if pf.relpath.startswith("/"):
                 # out-of-repo path (ad-hoc lint of tmp fixtures): lint
@@ -468,6 +536,39 @@ def run(
         for rid, dt in res.get("rule_seconds", {}).items():
             rule_seconds[rid] = rule_seconds.get(rid, 0.0) + dt
 
+    # -- project-scope rules: over the full parsed set (verdict-cached) ----
+    proj_new_rows: List[list] = []
+    proj_sup_rows: List[list] = []
+    if project_cached is not None:
+        proj_new_rows = list(project_cached.get("new", []))
+        proj_sup_rows = list(project_cached.get("suppressed", []))
+        # keep every project rule id present in the timing report at 0.0:
+        # a warm bench.py --lint must show the shardcheck/rangecheck
+        # families as cached-cheap, not as silently vanished — warm and
+        # cold JSON lines stay shape-comparable
+        for rid, r in sorted(RULES.items()):
+            if r.scope == "project" and (rule_ids is None or rid in rule_ids):
+                rule_seconds[rid] = 0.0
+    else:
+        active_project = [
+            r for rid, r in sorted(RULES.items())
+            if r.scope == "project" and (rule_ids is None or rid in rule_ids)
+        ]
+        for rule in active_project:
+            t0 = time.perf_counter()
+            for f in rule.check_project(files):
+                pf = by_rel.get(f.path)
+                if pf is None:
+                    continue
+                if pf.is_suppressed(f):
+                    proj_sup_rows.append([f.rule, f.path, f.line, f.message])
+                else:
+                    proj_new_rows.append(
+                        [f.rule, f.path, f.line, f.message,
+                         pf.source_line(f.line)]
+                    )
+            rule_seconds[rule.id] = time.perf_counter() - t0
+
     if caching:
         fresh = {
             rel: {
@@ -487,30 +588,23 @@ def run(
             rel: ent
             for rel, ent in cache_data.items()
             if isinstance(ent, dict)
+            and rel != _PROJECT_CACHE_KEY
             and not rel.startswith("/")
             and (REPO_ROOT / rel).exists()
         }
         merged_cache.update(fresh)
+        if project_key is not None:
+            merged_cache[_PROJECT_CACHE_KEY] = {
+                "key": project_key,
+                "new": proj_new_rows,
+                "suppressed": proj_sup_rows,
+            }
         try:
             cache_path.write_text(json.dumps(merged_cache, sort_keys=True))
         except OSError:
             pass  # a read-only checkout lints fine, just never warm
 
-    # -- project-scope rules: always fresh, over the full parsed set -------
-    active_project = [
-        r for rid, r in sorted(RULES.items())
-        if r.scope == "project" and (rule_ids is None or rid in rule_ids)
-    ]
-    raw_project: List[Tuple[Finding, ParsedFile]] = []
-    for rule in active_project:
-        t0 = time.perf_counter()
-        for f in rule.check_project(files):
-            pf = by_rel.get(f.path)
-            if pf is not None:
-                raw_project.append((f, pf))
-        rule_seconds[rule.id] = time.perf_counter() - t0
-
-    # -- merge, suppress (project side), baseline --------------------------
+    # -- merge, baseline ---------------------------------------------------
     merged_new: List[Tuple[Finding, str]] = []
     suppressed: List[Finding] = []
     for rel, res in per_file.items():
@@ -518,11 +612,10 @@ def run(
             merged_new.append((Finding(rid, rel, line, msg), src))
         for rid, line, msg in res["suppressed"]:
             suppressed.append(Finding(rid, rel, line, msg))
-    for f, pf in raw_project:
-        if pf.is_suppressed(f):
-            suppressed.append(f)
-        else:
-            merged_new.append((f, pf.source_line(f.line)))
+    for rid, path, line, msg, src in proj_new_rows:
+        merged_new.append((Finding(rid, path, line, msg), src))
+    for rid, path, line, msg in proj_sup_rows:
+        suppressed.append(Finding(rid, path, line, msg))
 
     baseline = _load_baseline(baseline_path) if use_baseline else {}
     budget = dict(baseline)
@@ -693,6 +786,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="disable the incremental per-file finding cache",
     )
     ap.add_argument(
+        "--changed-only", action="store_true",
+        help="file-scope rules run only over files changed vs the"
+        " merge-base (committed+staged+unstaged+untracked);"
+        " project-scope rules still check the full tree",
+    )
+    ap.add_argument(
+        "--base", default=None, metavar="REF",
+        help="diff base for --changed-only (default: merge-base of HEAD"
+        " with origin/main or main)",
+    )
+    ap.add_argument(
         "--update-wire-lock", action="store_true",
         help="regenerate tools/graftlint/wire_schema.lock.json from"
         " solver/codec.py (refuses a field-set change without a wire"
@@ -729,12 +833,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
 
     paths = args.paths or ["karpenter_core_tpu"]
+    restrict = None
+    if args.changed_only:
+        restrict = changed_relpaths(args.base)
     result = run(
         paths,
         use_baseline=not args.baseline,
         rule_ids=args.rule,
         jobs=max(1, args.jobs),
         cache_path=None if (args.no_cache or args.rule) else CACHE_PATH,
+        restrict_to=restrict,
     )
 
     if args.baseline:

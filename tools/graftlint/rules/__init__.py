@@ -9,5 +9,6 @@ from tools.graftlint.rules import (  # noqa: F401
     determinism,
     jaxpurity,
     parity,
+    rangecheck,
     sharding,
 )

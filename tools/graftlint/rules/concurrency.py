@@ -13,11 +13,18 @@ GL302 unlocked-rmw           — read-modify-write on self attributes
                                outside the owning lock
 GL303 mixed-lock-discipline  — attribute written both under a lock and
                                bare in the same class
+GL304 blocking-io-under-grant — file/network I/O statically reachable
+                               while the FleetGateway device grant or the
+                               SolverDaemon ``_state_lock`` is held (the
+                               lint form of the PR 8/9 review findings:
+                               journal I/O off the exclusive device
+                               window, disk-full begin() wedging the
+                               gateway)
 """
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from tools.graftlint.engine import ParsedFile, Rule, dotted_name, register
 
@@ -252,4 +259,232 @@ class MixedLockDiscipline(Rule):
                             f"self.{attr} is written under {want}"
                             f" elsewhere in {cls.name!r} but under"
                             f" {have} here — pick one discipline",
+                        )
+
+
+# ---------------------------------------------------------------------------
+# GL304: blocking I/O under the device grant / the daemon state lock.
+#
+# The exclusive device window is the scarcest resource in the whole tier:
+# every queued tenant is waiting on it, and the watchdog kills the process
+# when it runs long. File and network I/O have unbounded tails (disk-full,
+# NFS stall, DNS hang), so any I/O reachable while the grant is held turns
+# one slow disk into a fleet-wide stall — exactly the PR 8/9 review
+# findings (quarantine journal writes moved off the window; a disk-full
+# begin() after collect_batch would have wedged the gateway). This rule
+# rides the project call graph (the ISSUE 11 engine growth): a per-def
+# does-I/O summary is iterated to a fixpoint, then every call inside a
+# grant-held or _state_lock-held region is checked against it.
+
+# NOTE: no "requests." prefix — in this codebase `requests` is the
+# ubiquitous resource-vector variable name, not the HTTP library (which
+# the tree does not use); http rides httpclient/socket instead
+_IO_CALL_PREFIXES = (
+    "shutil.", "socket.", "urllib.", "subprocess.",
+)
+_IO_OS_TAILS = {
+    "replace", "rename", "remove", "unlink", "fsync", "write", "makedirs",
+    "mkdir", "rmdir", "truncate",
+}
+_IO_PATH_TAILS = {"write_text", "read_text", "write_bytes", "read_bytes"}
+# ubiquitous method names the call-graph propagation must not resolve
+# through: name-tail resolution would connect `cache.get` to an HTTP
+# client's `get` and drown the rule in noise
+_IO_PROPAGATION_STOPLIST = {
+    "get", "put", "set", "update", "add", "pop", "remove", "clear",
+    "close", "run", "send", "solve", "encode", "decode", "items",
+    "values", "keys", "next", "check", "info", "debug", "warning",
+    "error", "exception", "log", "observe", "inc", "append", "join",
+    "main", "start", "stop",
+}
+_IO_MAX_CANDIDATES = 2
+_GRANT_ACQUIRE_TAILS = {"await_grant"}
+_GRANT_RELEASE_TAILS = {"release", "release_batch"}
+
+
+def _direct_io_call(name: str, tail: str) -> bool:
+    if name in ("open", "io.open", "urlopen", "os.open"):
+        return True
+    if name.startswith("os.") and tail in _IO_OS_TAILS:
+        return True
+    if name.startswith(_IO_CALL_PREFIXES):
+        return True
+    if tail in _IO_PATH_TAILS:
+        return True
+    return False
+
+
+def _io_summaries(files: List[ParsedFile]) -> Set[int]:
+    """ids of every def that (transitively) performs blocking I/O.
+
+    One AST walk per def collects its direct-I/O verdict and the compact
+    set of propagatable call tails; the fixpoint then iterates over those
+    precomputed edge lists (each pass only grows the set, so it
+    terminates; real chains are 2-3 deep)."""
+    defs: Dict[str, List[ast.AST]] = {}
+    # id(fn) -> the call tails propagation may resolve through
+    edges: Dict[int, Set[str]] = {}
+    does_io: Set[int] = set()
+    for pf in files:
+        for fn in pf.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+            defs.setdefault(fn.name, []).append(fn)
+            tails: Set[str] = set()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                tail = name.rsplit(".", 1)[-1] if name else ""
+                if _direct_io_call(name, tail):
+                    does_io.add(id(fn))
+                elif tail and tail not in _IO_PROPAGATION_STOPLIST:
+                    tails.add(tail)
+            edges[id(fn)] = tails
+    while True:
+        grew = False
+        for cands in defs.values():
+            for fn in cands:
+                if id(fn) in does_io:
+                    continue
+                for tail in edges[id(fn)]:
+                    callees = defs.get(tail, ())
+                    if not callees or len(callees) > _IO_MAX_CANDIDATES:
+                        continue
+                    if any(id(c) in does_io for c in callees):
+                        does_io.add(id(fn))
+                        grew = True
+                        break
+        if not grew:
+            break
+    return does_io
+
+
+def _grant_region(fn: ast.AST) -> Optional[Tuple[int, float]]:
+    """(first held line EXCLUSIVE, last held line INCLUSIVE) of the device
+    grant inside one function, or None.
+
+    Two idioms: a function that calls ``await_grant`` holds the grant from
+    that call to its last ``release``/``release_batch`` call (or to the
+    end when it never releases — the release happens in a callee); a
+    function that releases WITHOUT acquiring (``_solve_as_leader``) was
+    handed the grant by its caller and holds it from entry."""
+    acquire = None
+    release_end = None
+    submits = False
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = dotted_name(node.func).rsplit(".", 1)[-1]
+        if tail in _GRANT_ACQUIRE_TAILS:
+            ln = node.lineno
+            acquire = ln if acquire is None else min(acquire, ln)
+        elif tail in _GRANT_RELEASE_TAILS:
+            ln = getattr(node, "end_lineno", node.lineno)
+            release_end = (
+                ln if release_end is None else max(release_end, ln)
+            )
+        elif tail == "submit":
+            submits = True
+    if acquire is not None:
+        return (acquire, release_end or float("inf"))
+    if release_end is not None and not submits:
+        # grant-entered-from-entry: the leader path
+        return (fn.lineno, release_end)
+    return None
+
+
+@register
+class BlockingIoUnderGrant(Rule):
+    id = "GL304"
+    name = "blocking-io-under-grant"
+    rationale = (
+        "file/network I/O while the exclusive device grant (or the"
+        " daemon's _state_lock) is held turns one slow disk into a"
+        " fleet-wide stall: every queued tenant waits on the window and"
+        " the watchdog kills the process when it runs long — do the I/O"
+        " on the handler thread before the grant or after release"
+    )
+    scope = "project"
+
+    def _applies(self, pf: ParsedFile) -> bool:
+        return "/solver/" in f"/{pf.relpath}" or "gl304" in pf.relpath
+
+    def check_project(self, files: List[ParsedFile]):
+        targets = [pf for pf in files if self._applies(pf)]
+        if not targets:
+            return
+        does_io = _io_summaries(files)
+        defs: Dict[str, List[ast.AST]] = {}
+        for pf in files:
+            for node in pf.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+                defs.setdefault(node.name, []).append(node)
+
+        def call_does_io(node: ast.Call) -> Optional[str]:
+            name = dotted_name(node.func)
+            tail = name.rsplit(".", 1)[-1] if name else ""
+            if _direct_io_call(name, tail):
+                return name or tail
+            if tail in _IO_PROPAGATION_STOPLIST:
+                return None
+            callees = defs.get(tail, ())
+            if callees and len(callees) <= _IO_MAX_CANDIDATES and any(
+                id(c) in does_io for c in callees
+            ):
+                return tail
+            return None
+
+        for pf in targets:
+            for fn in pf.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+                region = _grant_region(fn)
+                # _state_lock-held spans inside this function
+                locked_spans: List[Tuple[int, int]] = []
+                for w in ast.walk(fn):
+                    if not isinstance(w, (ast.With, ast.AsyncWith)):
+                        continue
+                    for item in w.items:
+                        expr = item.context_expr
+                        if isinstance(expr, ast.Call):
+                            expr = expr.func
+                        if (
+                            isinstance(expr, ast.Attribute)
+                            and expr.attr == "_state_lock"
+                        ):
+                            locked_spans.append(
+                                (w.lineno, getattr(w, "end_lineno", w.lineno))
+                            )
+                if region is None and not locked_spans:
+                    continue
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    tail = dotted_name(node.func).rsplit(".", 1)[-1]
+                    if tail in _GRANT_RELEASE_TAILS | _GRANT_ACQUIRE_TAILS:
+                        continue
+                    held_by = None
+                    if region is not None and (
+                        region[0] < node.lineno <= region[1]
+                    ):
+                        held_by = "the exclusive device grant"
+                    for lo, hi in locked_spans:
+                        if lo < node.lineno <= hi:
+                            held_by = "_state_lock"
+                            break
+                    if held_by is None:
+                        continue
+                    callees = defs.get(tail, ())
+                    if callees and len(callees) <= _IO_MAX_CANDIDATES and any(
+                        _grant_region(c) is not None for c in callees
+                    ):
+                        # the callee is itself a grant-holding function
+                        # (the leader path): its interior is analyzed on
+                        # its own — flagging the call site too would
+                        # double-report every finding at the caller
+                        continue
+                    culprit = call_does_io(node)
+                    if culprit is not None:
+                        yield self.finding(
+                            pf, node,
+                            f"call to {culprit!r} reaches blocking"
+                            f" file/network I/O while {held_by} is held —"
+                            " move the I/O off the exclusive window"
+                            " (before the grant or after release)",
                         )

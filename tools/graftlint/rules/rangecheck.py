@@ -1,0 +1,428 @@
+"""Family 6 — rangecheck: numeric contracts on the kernel/wire boundary.
+
+Three PRs in a row hand-fixed a numeric-contract bug no rule could see: a
+hostile int64 wire priority overflowing the int32 EvPlanes store (the
+ISSUE 10 decode net), a ±9 priority clamp saturating the [-10, 10]
+eviction-cost contract and erasing deletion-cost tiebreaks, and
+sentinel-domain confusion around gang_of_class (-1 gang-free vs -2
+fallback-straddling). These rules machine-check those contracts on the
+second abstract domain in tools/graftlint/dataflow.py — per-value integer
+intervals, dtype width, pad provenance and sentinel-domain tags,
+propagated through the same project-wide call-graph fixpoint as the PR 7
+provenance lattice (branch-insensitive joins; every rule flags on
+positive evidence only, so imprecision degrades to silence).
+
+GL601 narrowing-store-unclamped — a wire-derived integer flowing into a
+                                  narrower-dtype array store/cast in
+                                  solver//models/ without a registered
+                                  normalizer (priority_tier, _clamp_slots)
+                                  or an explicit clip: the astype/element
+                                  coercion WRAPS, flipping hostile values
+                                  inside the exclusive device window
+GL602 sentinel-domain-mixing    — comparisons/arithmetic mixing values of
+                                  different registered sentinel domains;
+                                  zero-boundary tests (`< 0` / `>= 0`)
+                                  where a deeper sentinel (-2) is
+                                  positively live; ordered or unknown-
+                                  sentinel comparisons inside a domain
+GL603 clamp-saturation          — a summed cost whose per-term static
+                                  intervals exceed the outer clamp bound:
+                                  the clamp stops being a backstop and
+                                  starts erasing lower-order tiebreaks
+GL604 padding-inertness         — pad-provenance content (pad_to_devices
+                                  sizing, the power-of-two batch pad,
+                                  np/jnp.pad) reaching a reduction/argmin
+                                  inside a traced (jit) region without a
+                                  masking step: inert rows vote
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from tools.graftlint import dataflow
+from tools.graftlint.dataflow import (
+    CLAMPED,
+    MASKED,
+    NARROW_INT_DTYPES,
+    PAD,
+    SENTINEL_DOMAINS,
+    WIRE,
+    _literal_number,
+)
+from tools.graftlint.engine import ParsedFile, Rule, dotted_name, register
+
+
+def _range_file(pf: ParsedFile) -> bool:
+    p = f"/{pf.relpath}"
+    return "/solver/" in p or "/models/" in p
+
+
+def _kernel_file(pf: ParsedFile) -> bool:
+    p = f"/{pf.relpath}"
+    return "/ops/" in p or "/models/" in p or "/solver/" in p
+
+
+@register
+class NarrowingStoreUnclamped(Rule):
+    id = "GL601"
+    name = "narrowing-store-unclamped"
+    rationale = (
+        "a wire/host-derived integer flowing into a narrower-dtype array"
+        " construction without a registered normalizer or explicit clip"
+        " WRAPS on overflow — a hostile int64 flips sign inside the int32"
+        " device planes (the ISSUE 10 evictable-priority fix, frozen as"
+        " an invariant)"
+    )
+    scope = "project"
+
+    def _flaggable(self, v: dataflow.AbsVal, dtype: str) -> bool:
+        """Positive evidence of an unsafe narrowing: the value is
+        positively wire-derived, no contributing path clamped it, and its
+        static interval cannot be shown to fit the target width."""
+        return (
+            WIRE in v.taints
+            and CLAMPED not in v.guards
+            and not v.fits_dtype(dtype)
+        )
+
+    def check_project(self, files: List[ParsedFile]) -> Iterable:
+        targets = [pf for pf in files if _range_file(pf)]
+        if not targets:
+            return
+        df = dataflow.get_ranges(files)
+        for pf in targets:
+            for node in pf.walk(ast.Assign):
+                if len(node.targets) != 1:
+                    continue
+                tgt = node.targets[0]
+                if not isinstance(tgt, ast.Subscript):
+                    continue
+                fn = pf.enclosing_function(node)
+                base = df.absval(pf, tgt.value, fn)
+                if base.dtype not in NARROW_INT_DTYPES:
+                    continue
+                v = df.absval(pf, node.value, fn)
+                if self._flaggable(v, base.dtype):
+                    yield self.finding(
+                        pf, node,
+                        f"wire-derived integer stored into a {base.dtype}"
+                        " array element without a registered normalizer"
+                        " (priority_tier/_clamp_slots) or an explicit clip"
+                        " — the element coercion wraps on overflow; clamp"
+                        " at the decode net",
+                    )
+            for node in pf.walk(ast.Call):
+                name = dotted_name(node.func)
+                tail = name.rsplit(".", 1)[-1] if name else ""
+                fn = pf.enclosing_function(node)
+                if tail == "astype" and isinstance(node.func, ast.Attribute):
+                    dt = (
+                        dataflow._dtype_name(node.args[0])
+                        if node.args else None
+                    )
+                    if dt not in NARROW_INT_DTYPES:
+                        continue
+                    src = df.absval(pf, node.func.value, fn)
+                    if self._flaggable(src, dt):
+                        yield self.finding(
+                            pf, node,
+                            f"astype({dt}) on a wire-derived integer value"
+                            " with no clamp on the path — astype wraps"
+                            " out-of-range values; np.clip to the"
+                            " contract bounds first",
+                        )
+                elif tail in ("array", "asarray", "full") and (
+                    name.startswith(("np.", "numpy.", "jnp.", "jax.numpy."))
+                ):
+                    dt = None
+                    for kw in node.keywords:
+                        if kw.arg == "dtype":
+                            dt = dataflow._dtype_name(kw.value)
+                    if dt not in NARROW_INT_DTYPES:
+                        continue
+                    payload: Optional[ast.AST] = None
+                    if tail == "full" and len(node.args) >= 2:
+                        payload = node.args[1]
+                    elif tail in ("array", "asarray") and node.args:
+                        payload = node.args[0]
+                    if payload is None:
+                        continue
+                    v = df.absval(pf, payload, fn)
+                    if self._flaggable(v, dt):
+                        yield self.finding(
+                            pf, node,
+                            f"{tail}(dtype={dt}) over a wire-derived"
+                            " integer payload with no clamp on the path —"
+                            " the construction wraps on overflow",
+                        )
+
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    v = _literal_number(node)
+    return v if isinstance(v, int) else None
+
+
+_ORDERED_OPS = (ast.Lt, ast.LtE, ast.Gt, ast.GtE)
+
+
+@register
+class SentinelDomainMixing(Rule):
+    id = "GL602"
+    name = "sentinel-domain-mixing"
+    rationale = (
+        "sentinel integers are categorical tags, not magnitudes: mixing"
+        " two registered domains in one comparison, or testing `< 0`"
+        " where a -2 sentinel is live, silently conflates gang-free with"
+        " fallback-straddling (the ISSUE 10 preemption-gate bug class)"
+    )
+    scope = "project"
+
+    @staticmethod
+    def _deep_sentinels(v: dataflow.AbsVal) -> List[int]:
+        """Live values of v that are NON-DEFAULT sentinels (below -1) of
+        one of v's domains — the positive evidence that a zero-boundary
+        test conflates two meanings."""
+        out = []
+        for dom in v.sentinels:
+            spec = SENTINEL_DOMAINS.get(dom, {})
+            svals = set(spec.get("values", {}).values())
+            for val in v.live_values():
+                if val in svals and val <= -2:
+                    out.append(val)
+        return sorted(set(out))
+
+    @staticmethod
+    def _domain_values(v: dataflow.AbsVal) -> set:
+        out = set()
+        for dom in v.sentinels:
+            out |= set(
+                SENTINEL_DOMAINS.get(dom, {}).get("values", {}).values()
+            )
+        return out
+
+    def check_project(self, files: List[ParsedFile]) -> Iterable:
+        targets = [
+            pf for pf in files
+            if _kernel_file(pf) or "gl602" in pf.relpath
+        ]
+        if not targets:
+            return
+        df = dataflow.get_ranges(files)
+        for pf in targets:
+            for node in pf.walk(ast.Compare):
+                if len(node.ops) != 1:
+                    continue
+                fn = pf.enclosing_function(node)
+                left = df.absval(pf, node.left, fn)
+                right = df.absval(pf, node.comparators[0], fn)
+                op = node.ops[0]
+                # cross-domain mixing: both sides tagged, no domain shared
+                if (
+                    left.sentinels and right.sentinels
+                    and left.sentinels.isdisjoint(right.sentinels)
+                ):
+                    yield self.finding(
+                        pf, node,
+                        "comparison mixes values from different sentinel"
+                        f" domains ({'/'.join(sorted(left.sentinels))} vs"
+                        f" {'/'.join(sorted(right.sentinels))}) — their"
+                        " negative magic numbers are unrelated tags",
+                    )
+                    continue
+                # orient: sentinel-tagged side vs a constant side
+                for sent, const_node in (
+                    (left, node.comparators[0]), (right, node.left),
+                ):
+                    if not sent.sentinels:
+                        continue
+                    c = _const_int(const_node)
+                    if c is None:
+                        continue
+                    deep = self._deep_sentinels(sent)
+                    if c == 0 and isinstance(op, (ast.Lt, ast.GtE)) and deep:
+                        yield self.finding(
+                            pf, node,
+                            "zero-boundary test on a"
+                            f" {'/'.join(sorted(sent.sentinels))}-domain"
+                            f" value while sentinel(s) {deep} are live —"
+                            " `< 0`/`>= 0` conflates gang-free with"
+                            " fallback-straddling; compare against the"
+                            " named sentinel (== GANG_FREE) instead",
+                        )
+                        break
+                    if c < 0 and isinstance(op, _ORDERED_OPS):
+                        yield self.finding(
+                            pf, node,
+                            f"ordered comparison against {c} on a"
+                            f" {'/'.join(sorted(sent.sentinels))}-domain"
+                            " value treats categorical sentinels as"
+                            " magnitudes — compare with == / != against"
+                            " the named constants",
+                        )
+                        break
+                    if (
+                        c < 0
+                        and isinstance(op, (ast.Eq, ast.NotEq))
+                        and self._domain_values(sent)
+                        and c not in self._domain_values(sent)
+                    ):
+                        yield self.finding(
+                            pf, node,
+                            f"equality test against {c}, which is not a"
+                            " registered sentinel of domain"
+                            f" {'/'.join(sorted(sent.sentinels))} —"
+                            " add it to the registry (solver/gangs) or"
+                            " fix the literal",
+                        )
+                        break
+            for node in pf.walk(ast.BinOp):
+                if not isinstance(node.op, (ast.Add, ast.Sub, ast.Mult)):
+                    continue
+                fn = pf.enclosing_function(node)
+                left = df.absval(pf, node.left, fn)
+                right = df.absval(pf, node.right, fn)
+                if (
+                    left.sentinels and right.sentinels
+                    and left.sentinels.isdisjoint(right.sentinels)
+                ):
+                    yield self.finding(
+                        pf, node,
+                        "arithmetic mixes values from different sentinel"
+                        f" domains ({'/'.join(sorted(left.sentinels))} vs"
+                        f" {'/'.join(sorted(right.sentinels))})",
+                    )
+
+
+def _clip_pattern(node: ast.AST):
+    """(inner expr, lo, hi) of a `min(max(x, lo), hi)` / `max(min(x, hi),
+    lo)` / np.clip(x, lo, hi) expression with literal bounds, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    tail = name.rsplit(".", 1)[-1] if name else ""
+    if tail == "clip" and len(node.args) >= 3:
+        lo, hi = _literal_number(node.args[1]), _literal_number(node.args[2])
+        if lo is not None and hi is not None:
+            return node.args[0], lo, hi
+        return None
+    if name not in ("min", "max") or len(node.args) != 2:
+        return None
+    outer_bound = _literal_number(node.args[1])
+    inner = node.args[0]
+    if outer_bound is None or not isinstance(inner, ast.Call):
+        return None
+    iname = dotted_name(inner.func)
+    if iname not in ("min", "max") or iname == name or len(inner.args) != 2:
+        return None
+    inner_bound = _literal_number(inner.args[1])
+    if inner_bound is None:
+        return None
+    lo, hi = sorted((outer_bound, inner_bound))
+    return inner.args[0], lo, hi
+
+
+@register
+class ClampSaturation(Rule):
+    id = "GL603"
+    name = "clamp-saturation"
+    rationale = (
+        "when a summed cost's per-term static intervals can exceed the"
+        " outer clamp bound, the clamp stops being a backstop and starts"
+        " collapsing distinct costs onto the bound — erasing every"
+        " lower-order tiebreak term (the eviction_cost ±9 regression)"
+    )
+    scope = "project"
+
+    def check_project(self, files: List[ParsedFile]) -> Iterable:
+        df = dataflow.get_ranges(files)
+        for pf in files:
+            for node in pf.walk(ast.Return):
+                if node.value is None:
+                    continue
+                pat = _clip_pattern(node.value)
+                if pat is None:
+                    continue
+                inner, lo, hi = pat
+                fn = pf.enclosing_function(node)
+                if fn is None:
+                    continue
+                v = df.absval(pf, inner, fn)
+                # positive evidence only: a fully-known finite hull that
+                # strictly exceeds the clamp. Reaching the bound exactly
+                # is fine (nothing collapses); exceeding it is not.
+                if v.lo == -dataflow.INF or v.hi == dataflow.INF:
+                    continue
+                if v.hi > hi or v.lo < lo:
+                    yield self.finding(
+                        pf, node,
+                        f"clamped return: the interior's static interval"
+                        f" [{v.lo:g}, {v.hi:g}] exceeds the clamp bounds"
+                        f" [{lo:g}, {hi:g}] — values past the bound"
+                        " collapse onto it, erasing lower-order tiebreak"
+                        " terms; tighten the per-term clamps so their sum"
+                        " stays inside the contract",
+                    )
+
+
+_REDUCTIONS = {"argmin", "argmax", "min", "max", "sum", "prod", "mean",
+               "any", "all"}
+
+
+@register
+class PaddingInertness(Rule):
+    id = "GL604"
+    name = "padding-inertness"
+    rationale = (
+        "padded rows exist to make shapes divide meshes and buckets — an"
+        " unmasked reduction/argmin over pad-provenance content inside a"
+        " jit region lets inert slots vote (a padded slot wins the"
+        " argmin, a padded row inflates the sum); route through"
+        " jnp.where with a validity mask first"
+    )
+    scope = "project"
+
+    def _targets(self, files: List[ParsedFile]) -> List[ParsedFile]:
+        out = []
+        for pf in files:
+            p = f"/{pf.relpath}"
+            if "/ops/" in p or "/models/" in p or "gl604" in pf.relpath:
+                out.append(pf)
+        return out
+
+    def check_project(self, files: List[ParsedFile]) -> Iterable:
+        targets = self._targets(files)
+        if not targets:
+            return
+        from tools.graftlint.rules import jaxpurity as _jp
+
+        df = dataflow.get_ranges(files)
+        for pf in targets:
+            traced = _jp._index(pf).traced
+            for node in pf.walk(ast.Call):
+                fn = pf.enclosing_function(node)
+                if fn is None or fn not in traced:
+                    continue  # host-side reductions window padding freely
+                name = dotted_name(node.func)
+                tail = name.rsplit(".", 1)[-1] if name else ""
+                if tail not in _REDUCTIONS:
+                    continue
+                operand: Optional[ast.AST] = None
+                if name.startswith(("jnp.", "jax.numpy.")) and node.args:
+                    operand = node.args[0]
+                elif isinstance(node.func, ast.Attribute) and not (
+                    name.startswith(("np.", "numpy."))
+                ):
+                    operand = node.func.value
+                if operand is None:
+                    continue
+                v = df.absval(pf, operand, fn)
+                if PAD in v.taints and MASKED not in v.guards:
+                    yield self.finding(
+                        pf, node,
+                        f"{tail} over pad-provenance content inside a"
+                        " traced region with no masking step — the inert"
+                        " padded rows participate in the reduction; wrap"
+                        " the operand in jnp.where(valid, x, neutral)"
+                        " first",
+                    )
