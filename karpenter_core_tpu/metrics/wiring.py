@@ -153,6 +153,13 @@ SOLVER_RELAX_ROUNDS = REGISTRY.counter(
     "solver_relaxation_rounds_total",
     "Preference-relaxation re-solves",
 )
+SOLVER_RELAX_BACKEND = REGISTRY.counter(
+    "solver_relax_backend_total",
+    "relaxsolve backend outcomes per solve (won|lost|noop|cached|deadline"
+    "|overflow|infeasible) — won/lost judge the convex-relaxation"
+    " candidate against the FFD anytime answer; deadline means the"
+    " budget expired and the FFD answer served",
+)
 SOLVER_PREP_CACHE = REGISTRY.counter(
     "solver_prepared_cache_total",
     "Prepared-state (class batch) cache lookups by outcome (hit|miss) —"

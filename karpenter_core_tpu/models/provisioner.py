@@ -72,6 +72,7 @@ from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
 )
 from karpenter_core_tpu.ops import gangsched
 from karpenter_core_tpu.ops import masks as mops
+from karpenter_core_tpu.ops import relax as relax_ops
 from karpenter_core_tpu.ops import topoplan
 from karpenter_core_tpu.parallel import mesh as pmesh
 from karpenter_core_tpu.ops.ffd import (
@@ -260,6 +261,12 @@ class _Prepared:
     ev: object = None
     ev_uids: list = field(default_factory=list)
     ev_freed: list = field(default_factory=list)
+    # relaxsolve (ISSUE 13): the candidate dispatch re-runs the FFD scan
+    # from a FRESH init state (the baseline's was donated), so the
+    # builder args are stashed here; tmpl_price_d is the [Sp] per-template
+    # min node price the scored fallback ranks candidates with.
+    init_args: tuple = None
+    tmpl_price_d: object = None
 
 
 # ---------------------------------------------------------------------------
@@ -284,7 +291,9 @@ class _KernelRequest:
     gang-atomic twin dispatches when gang_of_step is set) answered with
     (final state, takes_bc, unplaced_bc, seconds); ``"preempt"`` (the
     gangsched eviction pass over a FINISHED solve's state) answered with
-    (extra_takes_bc, unplaced_bc', evicted [N, P], seconds)."""
+    (extra_takes_bc, unplaced_bc', evicted [N, P], seconds); ``"relax"``
+    (the relaxsolve assignment + rounding, ops/relax.relax_choose)
+    answered with (new_template [Cp], kstar [Cp], n_changed, seconds)."""
 
     init_state: SlotState
     steps: ClassStep
@@ -295,6 +304,13 @@ class _KernelRequest:
     devices: int
     n_slots: int
     kind: str = "solve"
+    # solver backend that issued this request ("ffd" | "relax"): a pure
+    # shape_key component, so a relax problem's dispatches — including its
+    # plain-FFD anytime baseline, which compiles to the *same* jit entry
+    # an ffd problem's solve does — can never coalesce into an ffd
+    # problem's vmapped batch (the kernel-seam half of the
+    # codec.problem_bucket solver-mode component)
+    mode: str = "ffd"
     # gang-atomic solve (both None for plain problems — same kernels,
     # same jit entries, byte-identical results as pre-gang)
     # [Jp] int32 gang step index (gangmod.GANG_FREE outside any gang,
@@ -307,6 +323,12 @@ class _KernelRequest:
     unplaced: object = None  # [Jp] int32 still-unplaced per step
     ev: object = None  # ops/gangsched.EvPlanes
     node_rounds: int = gangsched.NODE_ROUNDS
+    # relaxsolve assignment inputs (kind == "relax"): the ops/relax
+    # constraint planes (viable, k_cs, podcost, counts, gang_id,
+    # base_template, base_kstar) plus the static iteration/gang counts
+    relax: tuple = None
+    relax_iters: int = 0
+    relax_gangs: int = 0
 
     def shape_key(self) -> tuple:
         """Exact compile-shape identity: requests with equal keys ride one
@@ -322,14 +344,18 @@ class _KernelRequest:
             self.init_state, self.steps, self.statics,
             self.gang_of_step, self.gang_min,
             self.step_tier, self.step_gang, self.unplaced, self.ev,
+            self.relax,
         ))
         return (
             self.kind,
+            self.mode,
             tuple((tuple(x.shape), str(x.dtype)) for x in leaves),
             self.level_iters,
             self.num_classes,
             self.devices,
             self.node_rounds,
+            self.relax_iters,
+            self.relax_gangs,
         )
 
 
@@ -339,6 +365,11 @@ def _run_kernel_solo(req: _KernelRequest):
     driver owns dispatch timing because a timer held open across the
     generator's yield would charge batch-mates' work to this problem."""
     t0 = time.perf_counter()
+    if req.kind == "relax":
+        nt, ks, changed = relax_ops.relax_choose(
+            *req.relax, iters=req.relax_iters, num_gangs=req.relax_gangs
+        )
+        return nt, ks, int(changed), time.perf_counter() - t0
     if req.kind == "preempt":
         extra, m_left, evicted = gangsched.preempt_pass(
             req.init_state, req.steps, req.statics,
@@ -399,6 +430,27 @@ def _run_kernel_batched(reqs: List[_KernelRequest]):
     t0 = time.perf_counter()
     Bp = _bucket(B, lo=_BATCH_PAD_LO)
     reqs_p = list(reqs) + [head] * (Bp - B)
+    if head.kind == "relax":
+        # the assignment planes carry no slot axis: stack the problem
+        # axis, commit replicated on a multi-device mesh (the sanctioned
+        # parallel.mesh route), one vmapped choose dispatch
+        stacked = tuple(
+            jnp.stack([r.relax[i] for r in reqs_p])
+            for i in range(len(head.relax))
+        )
+        if head.devices > 1:
+            mesh = pmesh.slot_mesh(head.devices)
+            stacked = jax.device_put(
+                stacked, pmesh.relax_plane_shardings(mesh, stacked)
+            )
+        nt_b, ks_b, changed_b = relax_ops.relax_choose_batched(
+            *stacked, iters=head.relax_iters, num_gangs=head.relax_gangs
+        )
+        changed_h = jax.device_get(changed_b)
+        share = (time.perf_counter() - t0) / B
+        return [
+            (nt_b[b], ks_b[b], int(changed_h[b]), share) for b in range(B)
+        ], Bp
     state = _stack_trees([r.init_state for r in reqs_p])
     steps = _stack_trees([r.steps for r in reqs_p])
     statics = _stack_trees([r.statics for r in reqs_p])
@@ -609,7 +661,25 @@ class DeviceScheduler:
         devices: int = 1,
         verify: bool = True,
         recorder=None,
+        solver_mode: str = "ffd",
+        relax_iters: Optional[int] = None,
+        relax_budget_s: Optional[float] = None,
     ):
+        # relaxsolve (ISSUE 13): "ffd" is the classic first-fit-decreasing
+        # backend, byte-untouched; "relax" layers the convex-relaxation
+        # template optimizer over the same scan (ops/relax.py) with the
+        # FFD result as the scored/anytime fallback. relax_budget_s is
+        # the wall budget (from solve start) after which relax work is
+        # skipped and the FFD answer serves — the anytime contract.
+        if solver_mode not in ("ffd", "relax"):
+            raise ValueError(f"unknown solver mode {solver_mode!r}")
+        self.solver_mode = solver_mode
+        self.relax_iters = (
+            relax_iters
+            if relax_iters is not None
+            else relax_ops.DEFAULT_ITERS
+        )
+        self.relax_budget_s = relax_budget_s
         # ICE'd offerings project onto the catalog exactly like the greedy
         # path (apply_unavailable), so the host-side machinery — template
         # prefilter, decode refit, host fallback, price ordering — all see
@@ -849,6 +919,10 @@ class DeviceScheduler:
         else:
             max_slots = base_slots
         self._round_frozen = None  # vocab union seed is per solve() call
+        # anytime clock: every relax-budget check measures from the
+        # moment THIS solve started, so "budget expired" always leaves
+        # the already-computed FFD answer as the serve
+        self._solve_t0 = time.perf_counter()
         self.last_phase_stats = stats = {
             "plan_s": 0.0, "prepare_s": 0.0, "kernel_s": 0.0,
             "decode_s": 0.0, "fetch_bytes": 0, "h2d_bytes": 0,
@@ -859,7 +933,11 @@ class DeviceScheduler:
             # single- vs multi-device runs compare like for like
             "n_devices": self.devices,
             "h2d_dev_bytes": 0, "fetch_dev_bytes": 0,
+            # which backend served this solve (bench/ops attribution)
+            "solver_mode": self.solver_mode,
         }
+        if self.solver_mode == "relax":
+            stats["relax"] = {}
 
         from karpenter_core_tpu.metrics import wiring as m
 
@@ -1046,6 +1124,21 @@ class DeviceScheduler:
         stats["h2d_bytes"] += self._h2d_bytes
         stats["h2d_dev_bytes"] += self._h2d_dev_bytes
 
+        # relaxsolve (ISSUE 13): a cached WON verdict for this exact
+        # class batch applies the rounded template override to the ONE
+        # dispatch below — warm relax solves cost a single scan, exactly
+        # like ffd mode, and pack the relaxation's better answer. An
+        # unevaluated batch dispatches plain first (the anytime answer)
+        # and _relax_improve runs the optimizer after.
+        relax_verdict = None
+        if self.solver_mode == "relax":
+            relax_verdict = prep._batch.get("relax_verdict")
+            if relax_verdict is not None and relax_verdict.get("won"):
+                steps = self._override_steps(
+                    prep, steps,
+                    relax_verdict["new_template"], relax_verdict["kstar"],
+                )
+
         # the device dispatch is the generator's yield point: the solo
         # driver answers with ffd_solve_donated + aggregate_takes, the
         # batch driver stacks compatible requests and answers from one
@@ -1070,6 +1163,7 @@ class DeviceScheduler:
                 prep.step_gang if prep.gang_min is not None else None
             ),
             gang_min=prep.gang_min,
+            mode=self.solver_mode,
         )
         prep.init_state = None
         t0 = time.perf_counter()
@@ -1086,6 +1180,37 @@ class DeviceScheduler:
             m.SOLVER_KERNEL_DURATION.observe(kdt)
             stats["kernel_s"] += kdt
             return None
+
+        # -- relaxsolve improve pass (ISSUE 13) ----------------------------
+        # With the baseline (anytime) answer in hand, run the convex-
+        # relaxation optimizer and adopt its packing only when the scored
+        # comparison says it strictly wins; the preemption pass and decode
+        # below then operate on the winner, so tiers/gangs/evictions are
+        # relaxation-composed, not special-cased.
+        if self.solver_mode == "relax":
+            if relax_verdict is not None:
+                rstats = stats.get("relax")
+                if rstats is not None:
+                    rstats["outcome"] = (
+                        "cached_won"
+                        if relax_verdict.get("won")
+                        else "cached_kept_ffd"
+                    )
+                    rstats["cached"] = True
+                m.SOLVER_RELAX_BACKEND.inc({"outcome": "cached"})
+            else:
+                state, takes_bc, unplaced_bc, rdt = yield from (
+                    self._relax_improve(
+                        prep, steps, state, takes_bc, unplaced_bc
+                    )
+                )
+                kernel_share_s += rdt
+                # the adopted packing may differ from the baseline whose
+                # head was fetched above: the used-slot fetch window (and
+                # the adaptive slot hint) must follow the WINNER's state
+                head = jax.device_get(
+                    {"overflow": state.overflow, "next_free": state.next_free}
+                )
 
         # -- preemption pass (gangsched, ISSUE 10) -------------------------
         # Still-unplaced positive-tier gang-free classes get one more
@@ -1229,6 +1354,141 @@ class DeviceScheduler:
                 failed.append((p, err))
         stats["decode_s"] += time.perf_counter() - t0
         return claims, existing_sims, failed, evictions
+
+    # -- relaxsolve (ISSUE 13) -----------------------------------------
+
+    def _override_steps(self, prep: _Prepared, steps: ClassStep,
+                        nt, ks) -> ClassStep:
+        """Lift a per-class (new_template, kstar) override onto the
+        scanned step axis: gather by the step->class index, keep pad
+        steps inert. A cheap local copy — the cached ClassStep on
+        prep._batch is never mutated."""
+        Jp = int(prep.step_class.shape[0])
+        J = len(prep.plan.steps)
+        valid = jnp.arange(Jp) < J
+        return steps._replace(
+            new_template=jnp.where(valid, nt[prep.step_class], -1),
+            kstar=jnp.where(valid, ks[prep.step_class], 0),
+        )
+
+    def _relax_expired(self) -> bool:
+        return (
+            self.relax_budget_s is not None
+            and time.perf_counter() - self._solve_t0 > self.relax_budget_s
+        )
+
+    def _relax_improve(self, prep: _Prepared, steps: ClassStep,
+                       state, takes_bc, unplaced_bc):
+        """The relax backend's optimizing pass, as a generator riding the
+        same kernel-dispatch seam as the solve itself.
+
+        The caller holds the finished plain-FFD dispatch — the ANYTIME
+        answer. This pass (1) checks the wall budget (expired -> serve
+        FFD), (2) dispatches the projected-gradient assignment + rounding
+        (ops/relax.relax_choose; a no-change rounding short-circuits),
+        (3) re-runs the unmodified FFD/gang scan from a fresh init state
+        with the rounded (new_template, kstar) override, and (4) adopts
+        the candidate only when the on-device score (unplaced, fresh
+        nodes, $-cost proxy) strictly improves — rounding that loses
+        falls back to the FFD result. The verdict caches on the class
+        batch, so warm re-solves of the same problem dispatch ONCE with
+        the winning override (p50 parity with ffd mode) until the
+        fingerprint/plan/class mix changes.
+
+        Returns (state, takes_bc, unplaced_bc, kernel_seconds) — the
+        winner's."""
+        from karpenter_core_tpu.metrics import wiring as m
+
+        rstats = self.last_phase_stats.setdefault("relax", {})
+        extra = 0.0
+
+        def outcome(tag: str):
+            rstats["outcome"] = tag
+            m.SOLVER_RELAX_BACKEND.inc({"outcome": tag})
+
+        planes = prep._batch.get("relax")
+        if planes is None:
+            # no fresh-node axis (catalog/template-free problem): nothing
+            # to optimize, the FFD answer is the answer
+            outcome("infeasible")
+            return state, takes_bc, unplaced_bc, extra
+        if self._relax_expired():
+            outcome("deadline")
+            return state, takes_bc, unplaced_bc, extra
+        nt, ks, changed, dt = yield _KernelRequest(
+            init_state=None, steps=None, statics=None,
+            level_iters=prep.level_iters, step_class=None,
+            num_classes=prep.n_classes_padded, devices=self.devices,
+            n_slots=prep.n_slots, kind="relax", mode="relax",
+            relax=(
+                planes["viable"], planes["k_cs"], planes["k_node"],
+                planes["podcost"], planes["counts"], planes["gang_id"],
+                prep.new_template, prep.kstar,
+            ),
+            relax_iters=self.relax_iters, relax_gangs=planes["n_gangs"],
+        )
+        extra += dt
+        rstats["template_moves"] = int(changed)
+        if int(changed) == 0:
+            # rounding agrees with first-template-wins: the FFD packing
+            # IS the relaxation's packing; remember so warm solves skip
+            # even the assignment dispatch
+            prep._batch["relax_verdict"] = {"won": False}
+            outcome("noop")
+            return state, takes_bc, unplaced_bc, extra
+        if self._relax_expired():
+            outcome("deadline")
+            return state, takes_bc, unplaced_bc, extra
+        # candidate: the byte-identical scan (gang twin included) from a
+        # fresh init state with the rounded override riding ClassStep
+        init2 = self._make_init_state(*prep.init_args)
+        steps2 = self._override_steps(prep, steps, nt, ks)
+        state2, takes2_bc, unplaced2_bc, dt2 = yield _KernelRequest(
+            init_state=init2, steps=steps2, statics=prep.statics,
+            level_iters=prep.level_iters, step_class=prep.step_class,
+            num_classes=prep.n_classes_padded, devices=self.devices,
+            n_slots=prep.n_slots,
+            gang_of_step=(
+                prep.step_gang if prep.gang_min is not None else None
+            ),
+            gang_min=prep.gang_min,
+            mode="relax",
+        )
+        extra += dt2
+        t0 = time.perf_counter()
+        if bool(jax.device_get(state2.overflow)):
+            # the override needed more slots than the baseline's axis —
+            # keep the FFD packing rather than re-growing for a candidate
+            prep._batch["relax_verdict"] = {"won": False}
+            outcome("overflow")
+            extra += time.perf_counter() - t0
+            return state, takes_bc, unplaced_bc, extra
+        score_f = relax_ops.relax_score(
+            state, prep.tmpl_price_d, unplaced_bc
+        )
+        score_r = relax_ops.relax_score(
+            state2, prep.tmpl_price_d, unplaced2_bc
+        )
+        sf = jax.device_get(score_f)
+        sr = jax.device_get(score_r)
+        extra += time.perf_counter() - t0
+        key_f = (int(sf[0]), int(sf[1]), float(sf[2]))
+        key_r = (int(sr[0]), int(sr[1]), float(sr[2]))
+        rstats.update(
+            unplaced_ffd=key_f[0], nodes_ffd=key_f[1],
+            cost_ffd=round(key_f[2], 3),
+            unplaced_relax=key_r[0], nodes_relax=key_r[1],
+            cost_relax=round(key_r[2], 3),
+        )
+        if key_r < key_f:
+            prep._batch["relax_verdict"] = {
+                "won": True, "new_template": nt, "kstar": ks,
+            }
+            outcome("won")
+            return state2, takes2_bc, unplaced2_bc, extra
+        prep._batch["relax_verdict"] = {"won": False}
+        outcome("lost")
+        return state, takes_bc, unplaced_bc, extra
 
     # ------------------------------------------------------------------
 
@@ -1563,6 +1823,11 @@ class DeviceScheduler:
         Z = max(len(frozen.value_names[zone_kid]), 1)
         CT = max(len(frozen.value_names[ct_kid]), 1)
         off_avail = np.zeros((pad_T, Z, CT), dtype=bool)
+        # relaxsolve price planes (ops/relax.py): per-IT min AVAILABLE
+        # offering price (the relaxation's $/pod numerator), ICE'd rows
+        # excluded exactly like the availability mask
+        _PRICE_NONE = np.float32(1e12)  # == ops/relax.BIG_PRICE
+        it_price = np.full((pad_T,), _PRICE_NONE, dtype=np.float32)
         for ti, it in enumerate(catalog):
             for off in it.offerings:
                 if not off.available:
@@ -1573,6 +1838,7 @@ class DeviceScheduler:
                 # handed in pre-built, e.g. over the sidecar wire)
                 if off.key(it.name) in self.unavailable_offerings:
                     continue
+                it_price[ti] = min(it_price[ti], np.float32(off.price))
                 z = frozen.values[zone_kid].get(off.zone)
                 c_ = frozen.values[ct_kid].get(off.capacity_type)
                 if z is not None and c_ is not None:
@@ -1585,6 +1851,15 @@ class DeviceScheduler:
         for si, t in enumerate(self.templates):
             for it in t.instance_type_options:
                 tmpl_it[si, it_index[id(it)]] = True
+        # per-template min node price (the scored-fallback comparator's
+        # $-cost proxy): the cheapest priced IT the template could open
+        tmpl_price = np.full((pad_S,), _PRICE_NONE, dtype=np.float32)
+        for si in range(S):
+            viable = tmpl_it[si]
+            if viable.any():
+                tmpl_price[si] = float(
+                    np.min(np.where(viable, it_price, _PRICE_NONE))
+                )
         tmpl_overhead = np.stack(
             [rvec(o) for o in self.daemon_overhead]
         ) if S else np.zeros((pad_S, R), dtype=np.float32)
@@ -1686,8 +1961,16 @@ class DeviceScheduler:
             ex_complement=ex_complement, ex_negative=ex_negative,
             ex_gt=ex_gt, ex_lt=ex_lt,
             ex_requests=ex_requests, ex_capacity=ex_capacity,
+            it_price=it_price,
+            tmpl_price=tmpl_price,
             # device-resident copies (reused across solves via this cache)
             it_alloc_d=self._dev(_pad(it_alloc, {0: Tp, 1: Rp}, 0.0)),
+            it_price_d=self._dev(
+                _pad(it_price, {0: Tp}, float(_PRICE_NONE))
+            ),
+            tmpl_price_d=self._dev(
+                _pad(tmpl_price, {0: Sp}, float(_PRICE_NONE))
+            ),
             off_avail_d=self._dev(_pad(off_avail, {0: Tp}, False)),
             zone_key_d=jnp.int32(zone_kid),
             ct_key_d=jnp.int32(ct_kid),
@@ -1951,24 +2234,78 @@ class DeviceScheduler:
             # n_tmpl_gangs == 0 gate keeps plain problems off the extra
             # kernel entirely (byte parity).
             tmpl_gang_id, n_tmpl_gangs = _same_template_gang_ids(classes, Cp)
+            gang_id_d = None
             if n_tmpl_gangs:
+                gang_id_d = self._dev(tmpl_gang_id)
                 tmpl_ok_b = mops.gang_joint_templates(
-                    tmpl_ok_b, self._dev(tmpl_gang_id),
-                    num_gangs=n_tmpl_gangs,
+                    tmpl_ok_b, gang_id_d, num_gangs=n_tmpl_gangs,
                 )
+            cz = self._dev(cpad(cm.mask[:, zone_kid, :Z], False))
+            cct = self._dev(cpad(cm.mask[:, ct_kid, :CT], False))
+            tz = self._dev(_pad(entry["tmpl_zone_mask"], {0: Sp}, False))
+            tct = self._dev(_pad(entry["tmpl_ct_mask"], {0: Sp}, False))
+            creq = self._dev(cpad(_pad(class_requests, {1: Rp}, 0.0), 0.0))
             new_template, kstar = mops.fresh_viability(
                 class_it_b,
                 tmpl_ok_b,
                 entry["tmpl_it_d"],
-                self._dev(cpad(cm.mask[:, zone_kid, :Z], False)),
-                self._dev(cpad(cm.mask[:, ct_kid, :CT], False)),
-                self._dev(_pad(entry["tmpl_zone_mask"], {0: Sp}, False)),
-                self._dev(_pad(entry["tmpl_ct_mask"], {0: Sp}, False)),
+                cz, cct, tz, tct,
                 entry["off_avail_d"],
                 entry["it_alloc_d"],
                 entry["tmpl_overhead_d"],
-                self._dev(cpad(_pad(class_requests, {1: Rp}, 0.0), 0.0)),
+                creq,
             )
+            if self.solver_mode == "relax":
+                # relaxsolve constraint planes (ops/relax.py), cached on
+                # the class batch alongside the FFD viability results —
+                # warm re-solves (and every verdict-cached dispatch)
+                # rebuild nothing. Same-template gangs AND-reduce the
+                # relax support like the FFD mask, so the consensus rows
+                # iterate over identical feasible sets. Hostname-keyed
+                # topology (spread maxSkew / anti-affinity) lowers to a
+                # per-class pods-per-host cap so host-floor classes never
+                # estimate dense nodes they cannot fill.
+                kcap = np.full((C,), BIGI, dtype=np.int32)
+                for gi in range(plan.Gh):
+                    ht = int(plan.h_type[gi])
+                    if ht == 2:  # affinity: no per-host count cap
+                        continue
+                    cap = 1 if ht == 1 else max(int(plan.h_skew[gi]), 1)
+                    owned = plan.h_owner[:, gi]
+                    kcap[owned] = np.minimum(kcap[owned], cap)
+                viable_r, k_cs_r, k_node_r, podcost_r = (
+                    relax_ops.relax_viability(
+                        class_it_b, tmpl_ok_b, entry["tmpl_it_d"],
+                        cz, cct, tz, tct,
+                        entry["off_avail_d"], entry["it_alloc_d"],
+                        entry["tmpl_overhead_d"], creq,
+                        entry["it_price_d"],
+                        self._dev(cpad(kcap, BIGI)),
+                    )
+                )
+                if n_tmpl_gangs:
+                    viable_r = mops.gang_joint_templates(
+                        viable_r, gang_id_d, num_gangs=n_tmpl_gangs,
+                    )
+                relax_planes = dict(
+                    viable=viable_r,
+                    k_cs=k_cs_r,
+                    k_node=k_node_r,
+                    podcost=podcost_r,
+                    counts=self._dev(
+                        cpad(
+                            np.array(
+                                [c.count for c in classes],
+                                dtype=np.float32,
+                            ),
+                            0.0,
+                        )
+                    ),
+                    gang_id=self._dev(tmpl_gang_id),
+                    n_gangs=n_tmpl_gangs,
+                )
+            else:
+                relax_planes = None
             class_it = class_it_b  # [Cp, Tp] device-resident
             tmpl_ok = tmpl_ok_b  # [Cp, Sp] device-resident
         else:
@@ -1976,8 +2313,10 @@ class DeviceScheduler:
             tmpl_ok = jnp.zeros((Cp, Sp), dtype=bool)
             new_template = jnp.full((Cp,), -1, dtype=jnp.int32)
             kstar = jnp.zeros((Cp,), dtype=jnp.int32)
+            relax_planes = None
 
         b = dict(
+            relax=relax_planes,
             class_masks=class_masks,
             smask=smask,
             class_requests=class_requests,
@@ -2213,6 +2552,11 @@ class DeviceScheduler:
             level_iters=level_iters,
             n_classes_padded=batch["Cp"],
             _batch=batch,
+            # relaxsolve (ISSUE 13): the candidate dispatch rebuilds its
+            # own init state (the baseline's was donated) from the same
+            # cached rows; the per-template price vec ranks candidates
+            init_args=(entry, plan, N, hcount0, Ghp, Gzp),
+            tmpl_price_d=entry["tmpl_price_d"],
         )
         self._prepare_gangsched(prep, plan, entry, N)
         return prep

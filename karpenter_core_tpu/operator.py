@@ -91,6 +91,17 @@ class Options:
     # degradation (solver/remote.py). solver_addr="" spawns a supervised
     # local sidecar (solver/supervisor.py); set it to reach an external one.
     solver_mode: str = "inproc"  # inproc | sidecar
+    # which solve BACKEND runs behind the Solver seam (relaxsolve,
+    # ISSUE 13): ffd = first-fit-decreasing (classic), relax = the
+    # convex-relaxation optimizer with FFD as the scored/anytime
+    # fallback. (--solver-mode was already taken by the inproc|sidecar
+    # process topology above, so the backend selector is
+    # --solver-backend; on the solverd child and the wire it IS named
+    # solver mode — X-Solver-Mode / solverd --solver-mode.) In-proc it
+    # threads into DeviceScheduler(solver_mode=); in sidecar mode it
+    # rides every RPC (wire field + header) AND the spawned child's
+    # argv as its default for mode-less clients.
+    solver_backend: str = "ffd"  # ffd | relax
     solver_addr: str = ""
     solver_timeout: float = 30.0  # per-RPC deadline, seconds
     # host-side verification of every device/sidecar solve result
@@ -153,6 +164,9 @@ class Options:
         "health_port": ("--health-port", "KARPENTER_HEALTH_PORT", int),
         "solver": ("--solver", "KARPENTER_SOLVER", str),
         "solver_mode": ("--solver-mode", "KARPENTER_SOLVER_MODE", str),
+        "solver_backend": (
+            "--solver-backend", "KARPENTER_SOLVER_BACKEND", str,
+        ),
         "solver_addr": ("--solver-addr", "KARPENTER_SOLVER_ADDR", str),
         "solver_timeout": (
             "--solver-timeout", "KARPENTER_SOLVER_TIMEOUT", float,
@@ -287,6 +301,10 @@ class Options:
             raise ValueError(f"unknown solver {opts.solver!r}")
         if opts.solver_mode not in ("inproc", "sidecar"):
             raise ValueError(f"unknown solver mode {opts.solver_mode!r}")
+        if opts.solver_backend not in ("ffd", "relax"):
+            raise ValueError(
+                f"unknown solver backend {opts.solver_backend!r}"
+            )
         if opts.solver_mode == "sidecar" and opts.solver != "tpu":
             # the sidecar hosts the DEVICE solver; accepting this combo
             # would silently run greedy in-proc while logging sidecar mode
@@ -388,6 +406,13 @@ class Operator:
                     quarantine_journal=(
                         self.options.solver_quarantine_journal or None
                     ),
+                    # the child's default solve backend; per-request
+                    # selection still rides every RPC's wire field
+                    solve_mode=(
+                        self.options.solver_backend
+                        if self.options.solver_backend != "ffd"
+                        else None
+                    ),
                 )
                 addr = self.solver_supervisor.start()
             self.solver_client = SolverClient(
@@ -401,6 +426,14 @@ class Operator:
         # the device choice to the child, which owns the chips); an
         # explicit device_scheduler_opts["devices"] wins over the flag
         device_opts = dict(self.options.device_scheduler_opts)
+        if self.options.solver == "tpu":
+            # the backend selector reaches BOTH scheduler constructions:
+            # DeviceScheduler(solver_mode=) in-proc, and RemoteScheduler
+            # reads it out of device_scheduler_opts for the wire field +
+            # X-Solver-Mode header
+            device_opts.setdefault(
+                "solver_mode", self.options.solver_backend
+            )
         if self.options.solver == "tpu" and self.solver_client is None:
             device_opts.setdefault("devices", self.options.solver_devices)
         self.provisioner = Provisioner(

@@ -1,0 +1,293 @@
+"""relaxsolve: the optimizing convex-relaxation solver backend's kernels.
+
+The FFD scan (ops/ffd.py) inherits the reference's template policy:
+``fresh_viability`` picks the FIRST workable template per class
+(first-template-wins over the weight/name-ordered pool list). That is the
+greedy choice the r05 bench shows costing real nodes — cfg3_topology's
+parity_nodes_delta (-30/-80 vs greedy) is evidence an *optimizing*
+formulation has headroom the heuristic leaves on the table. CvxCluster
+(PAPERS.md) shows granular allocation problems of exactly this pod-class ×
+instance-shape decompose into convex relaxations that solve as batched
+tensor ops; "Priority Matters" shows constraint-based packing beating
+heuristic packers on real node-count/$-cost. This module is that
+formulation, sized to the existing device encoding:
+
+* ``relax_viability`` — lower the prepared tensors (class×IT compat,
+  template prefilters, offering availability, quantized capacity floors,
+  offering prices) to the relaxation's constraint planes: per
+  (class, template) feasibility, pods-per-fresh-node, and $-per-pod.
+* ``relax_choose`` — the relaxation itself: a fractional assignment
+  matrix x[c, s] (class c's pod mass on template s) over the per-class
+  simplex ∩ feasibility mask, minimized by jit-compiled projected-gradient
+  iterations on device (linear $-cost + a small strongly-convex term so
+  the iterates converge to a unique point), with same-node-template gang
+  rows held to consensus by an ADMM-style averaging projection each step
+  — gang atomicity is a CONSTRAINT of the relaxation, not a special case.
+  A rounding pass repairs integrality on device: each class takes its
+  argmax template when feasible and falls back to the FFD choice
+  otherwise, so the output is always a valid per-class
+  (new_template, kstar) override for the unmodified FFD scan.
+* ``relax_score`` — the scored-fallback comparator: (unplaced pods,
+  fresh nodes, $-cost proxy) of a finished solve's SlotState, so the
+  driver keeps the FFD answer whenever rounding loses. Consumes the
+  final SlotState — a SlotState jit entry for graftlint GL501 routing.
+
+The integral solution is ALWAYS materialized by the unmodified FFD scan
+(ffd_solve/gang_solve with the override riding ClassStep.new_template/
+kstar), so every topology, tier, eviction, and gang invariant — and the
+unmodified ResultVerifier — hold by construction, and the plain FFD
+result remains the anytime answer when the iteration budget or the
+request deadline expires (models/provisioner._relax_improve).
+
+Batched twins ride the PR 9 vmap seam: a leading problem axis over every
+plane, so compatible relax problems coalesce their assignment dispatches
+exactly like their solve dispatches (never with ffd-mode problems — the
+mode rides _KernelRequest.shape_key and codec.problem_bucket).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# price sentinel for infeasible (class, template) cells and templates with
+# no priced offering; far past any real $/node yet small enough that
+# float32 sums over a full slot axis stay finite
+BIG_PRICE = jnp.float32(1e12)
+
+# default projected-gradient iteration count: the objective is linear +
+# a small quadratic, so the iterates contract geometrically and 32 rounds
+# land within rounding distance of the optimum at any realistic C×S
+DEFAULT_ITERS = 32
+
+# strong-convexity weight and step size for the projected-gradient loop:
+# costs are normalized to [0, 1] before the loop, so these are
+# scale-free. mu keeps the fixed point unique (pure linear objectives
+# ride the simplex boundary and oscillate under finite steps); eta < 1/mu
+# keeps the quadratic term contractive.
+_MU = jnp.float32(0.05)
+_ETA = jnp.float32(0.5)
+# mix weight of the fractional-node term against the $-cost term in the
+# objective (both normalized to [0, 1]): $-cost leads, node pressure
+# breaks $-ties toward denser packings
+_NODE_WEIGHT = jnp.float32(0.5)
+
+
+@jax.jit
+def relax_viability(
+    class_it,  # [C, T] bool — class × instance-type compat
+    tmpl_ok,  # [C, S] bool — class × template compat ∧ taints (∧ gang joint)
+    tmpl_it,  # [S, T] bool — template's prefiltered instance types
+    class_zmask,  # [C, Z] bool
+    class_ctmask,  # [C, CT] bool
+    tmpl_zmask,  # [S, Z] bool
+    tmpl_ctmask,  # [S, CT] bool
+    off_avail,  # [T, Z, CT] bool — offering availability lattice
+    it_alloc,  # [T, R] float32 (quantized integer units)
+    tmpl_overhead,  # [S, R] float32
+    class_requests,  # [C, R] float32
+    it_price,  # [T] float32 — min available offering price per IT
+    k_cap,  # [C] int32 — topology pods-per-host cap (host-floor classes)
+):
+    """The relaxation's constraint planes: (viable [C, S] bool,
+    k_cs [C, S] int32 — max pods per fresh node via template s, k_node
+    [C, S] int32 — topology-EFFECTIVE pods per node, podcost [C, S]
+    float32 — min $/pod over the viable instance types).
+
+    ``k_cap`` lowers the hostname-keyed topology constraints into the
+    relaxation: a class owning a hostname spread (cap maxSkew) or
+    anti-affinity (cap 1) group can never stack more than the cap on one
+    node no matter the capacity, so its EFFECTIVE pods-per-node — the
+    $/pod denominator and the fractional-node estimate — is
+    min(capacity k, cap). Without it the relaxation would route
+    host-floor classes onto dense expensive nodes they can never fill
+    (capacity-only k lies for them). The returned k_cs stays the
+    CAPACITY k: it rides the scan's kstar override, and the scan itself
+    enforces the topology caps at placement time.
+
+    Same O(C*S*T) memory discipline and margin-free quantized floor
+    arithmetic as ops/masks.fresh_viability (k_cs for the chosen template
+    is bit-identical to the kstar fresh_viability would report had that
+    template been first), so a rounded override never admits a packing
+    the FFD scan's own capacity algebra would reject. The $/pod uses the
+    per-IT fleet-min offering price (the zone/capacity-type-conditional
+    price is approximated by the IT's cheapest available offering — the
+    decode refit picks the truly cheapest IT anyway, and the scored
+    fallback bounds any mis-estimate at zero regression)."""
+    T = off_avail.shape[0]
+    viable_it = tmpl_it[None, :, :] & class_it[:, None, :]  # [C, S, T]
+    zjoin = class_zmask[:, None, :] & tmpl_zmask[None, :, :]  # [C, S, Z]
+    ctjoin = class_ctmask[:, None, :] & tmpl_ctmask[None, :, :]
+    joined = (
+        zjoin[:, :, :, None] & ctjoin[:, :, None, :]
+    ).astype(jnp.float32)  # [C, S, Z, CT] (Z/CT tiny)
+    off_flat = off_avail.astype(jnp.float32).reshape(T, -1)
+    off_ok = jnp.einsum(
+        "tm,csm->cst", off_flat, joined.reshape(*joined.shape[:2], -1)
+    ) > 0
+    head = it_alloc[None, :, :] - tmpl_overhead[:, None, :]  # [S, T, R]
+    r = class_requests
+    safe_r = jnp.where(r > 0, r, 1.0)
+    k_min = jnp.full(
+        (r.shape[0],) + head.shape[:2], jnp.inf, dtype=jnp.float32
+    )  # [C, S, T]
+    for ri in range(r.shape[1]):  # static unroll, R is small
+        ratio_r = head[None, :, :, ri] / safe_r[:, None, None, ri]
+        ratio_r = jnp.where(r[:, None, None, ri] > 0, ratio_r, jnp.inf)
+        k_min = jnp.minimum(k_min, ratio_r)
+    k_it = jnp.floor(k_min)  # [C, S, T]
+    ok = viable_it & off_ok & tmpl_ok[:, :, None] & (k_it >= 1.0)
+    k_s = jnp.max(jnp.where(ok, k_it, -1.0), axis=-1)  # [C, S]
+    viable = k_s >= 1.0
+    k_eff = jnp.minimum(k_it, k_cap.astype(jnp.float32)[:, None, None])
+    ppod = jnp.where(
+        ok, it_price[None, None, :] / jnp.maximum(k_eff, 1.0), BIG_PRICE
+    )
+    podcost = jnp.min(ppod, axis=-1)  # [C, S]
+    # effective pods-per-node per (class, template) — the fractional-node
+    # estimate's denominator (the $/pod already folded the cap in)
+    k_node = jnp.max(jnp.where(ok, k_eff, -1.0), axis=-1)  # [C, S]
+    return (
+        viable,
+        jnp.clip(k_s, 0, 2**30).astype(jnp.int32),
+        jnp.clip(k_node, 0, 2**30).astype(jnp.int32),
+        podcost,
+    )
+
+
+def _project_rows(y, viable):
+    """Euclidean projection of each row onto the probability simplex
+    restricted to its viable support (sort-based, vectorized over rows;
+    S is small). Rows with empty support project to zero — the rounding
+    pass hands them back to the FFD choice."""
+    S = y.shape[1]
+    neg = jnp.float32(-3e30)
+    yv = jnp.where(viable, y, neg)
+    u = -jnp.sort(-yv, axis=1)  # descending; viable entries sort first
+    css = jnp.cumsum(u, axis=1)
+    j = jnp.arange(1, S + 1, dtype=jnp.float32)
+    cond = (u + (1.0 - css) / j[None, :] > 0) & (u > neg / 2)
+    rho = jnp.clip(jnp.sum(cond.astype(jnp.int32), axis=1), 1)
+    css_rho = jnp.take_along_axis(css, (rho - 1)[:, None], axis=1)[:, 0]
+    tau = (css_rho - 1.0) / rho.astype(jnp.float32)
+    x = jnp.clip(y - tau[:, None], 0.0) * viable.astype(y.dtype)
+    return jnp.where(jnp.any(viable, axis=1)[:, None], x, 0.0)
+
+
+def _gang_consensus(x, gang_id, num_gangs: int):
+    """Average same-template gang members' rows (projection onto the
+    consensus subspace — the ADMM coupling step): members iterate on one
+    shared fractional row, so the rounded argmax is identical across the
+    gang by construction."""
+    if num_gangs == 0:
+        return x
+    member = gang_id >= 0
+    gid = jnp.clip(gang_id, 0)
+    sum_g = jax.ops.segment_sum(
+        jnp.where(member[:, None], x, 0.0), gid, num_segments=num_gangs
+    )
+    cnt_g = jax.ops.segment_sum(
+        member.astype(jnp.float32), gid, num_segments=num_gangs
+    )
+    mean = sum_g[gid] / jnp.maximum(cnt_g[gid], 1.0)[:, None]
+    return jnp.where(member[:, None], mean, x)
+
+
+def _relax_choose_impl(
+    viable,  # [C, S] bool
+    k_cs,  # [C, S] int32 — capacity pods/node (rides the kstar override)
+    k_node,  # [C, S] int32 — topology-effective pods/node (the estimate)
+    podcost,  # [C, S] float32
+    counts,  # [C] float32 — pods per class (0 on pad rows)
+    gang_id,  # [C] int32 — same-template gang index, -1 outside any
+    base_template,  # [C] int32 — fresh_viability's first-wins choice
+    base_kstar,  # [C] int32
+    iters: int,
+    num_gangs: int,
+):
+    vf = viable.astype(jnp.float32)
+    nv = jnp.sum(vf, axis=1, keepdims=True)
+    x0 = vf / jnp.maximum(nv, 1.0)
+    # linear objective: total fractional $-cost of the assignment. The
+    # per-cell coefficient is the class's pod mass times its $/pod via
+    # that template; normalized to [0, 1] over the viable support so the
+    # step size is scale-free.
+    cost = jnp.where(viable, counts[:, None] * podcost, 0.0)
+    cost = cost / jnp.maximum(jnp.max(jnp.abs(cost)), 1e-6)
+    # fractional-node pressure: counts/k_node estimates the nodes this
+    # cell would open; normalized and mixed in so equal-$ choices still
+    # strictly prefer fewer nodes (the acceptance's primary axis)
+    nodeshare = jnp.where(
+        viable,
+        counts[:, None] / jnp.maximum(k_node.astype(jnp.float32), 1.0),
+        0.0,
+    )
+    nodeshare = nodeshare / jnp.maximum(jnp.max(nodeshare), 1e-6)
+    g = cost + _NODE_WEIGHT * nodeshare
+
+    def body(_, x):
+        y = x - _ETA * (g + _MU * x)
+        y = _gang_consensus(y, gang_id, num_gangs)
+        return _project_rows(y, viable)
+
+    x = jax.lax.fori_loop(0, iters, body, x0)
+    # rounding repair: argmax over the viable support; classes whose
+    # support is empty (or whose mass rounded to zero) keep the FFD
+    # choice, so the override is always a valid fresh-node policy
+    xm = jnp.where(viable, x, -1.0)
+    choice = jnp.argmax(xm, axis=1).astype(jnp.int32)
+    top = jnp.take_along_axis(xm, choice[:, None], axis=1)[:, 0]
+    has = jnp.any(viable, axis=1) & (top > 0)
+    nt = jnp.where(has, choice, base_template)
+    ks = jnp.where(
+        has,
+        jnp.take_along_axis(k_cs, jnp.clip(choice, 0)[:, None], axis=1)[:, 0],
+        base_kstar,
+    )
+    changed = jnp.sum(((nt != base_template) & (counts > 0)).astype(jnp.int32))
+    return nt, ks, changed
+
+
+# Assignment + rounding as ONE device dispatch; iteration count and gang
+# count are compile-time (both bucket upstream).
+relax_choose = partial(
+    jax.jit, static_argnames=("iters", "num_gangs")
+)(_relax_choose_impl)
+
+
+def _relax_choose_batched_impl(
+    viable, k_cs, k_node, podcost, counts, gang_id, base_template,
+    base_kstar, iters: int, num_gangs: int,
+):
+    return jax.vmap(
+        lambda v, k, kn, p, c, gi, bt, bk: _relax_choose_impl(
+            v, k, kn, p, c, gi, bt, bk, iters, num_gangs
+        )
+    )(viable, k_cs, k_node, podcost, counts, gang_id, base_template,
+      base_kstar)
+
+
+# vmapped twin for the PR 9 coalescer: stacked relax problems in one
+# shape bucket answer their assignment dispatches together
+relax_choose_batched = partial(
+    jax.jit, static_argnames=("iters", "num_gangs")
+)(_relax_choose_batched_impl)
+
+
+# graftlint: disable=GL103 -- deliberately non-donating: the scorer reads
+# a candidate's FINISHED SlotState that the caller still needs whole — the
+# winner's planes flow on to the preemption pass and the decode fetch
+@jax.jit
+def relax_score(state, tmpl_price, unplaced_bc):
+    """Scored-fallback comparator over a FINISHED solve's SlotState:
+    (unplaced pods, fresh nodes opened, $-cost proxy of the fresh fleet
+    — per-template min node price; the decode refit picks the true
+    cheapest IT, so this is a consistent relative ranking). Pad slots are
+    masked through the fresh predicate (kind==0 never takes), and pad
+    classes carry zero unplaced by construction."""
+    fresh = (state.kind == 2) & (state.podcount > 0)
+    nodes = jnp.sum(fresh.astype(jnp.int32))
+    s = jnp.clip(state.template, 0)
+    cost = jnp.sum(jnp.where(fresh, tmpl_price[s], 0.0))
+    return jnp.sum(unplaced_bc), nodes, cost

@@ -162,6 +162,18 @@ def batched_gang_plane_shardings(mesh: Mesh, planes, n_slots: int,
     return _batched_specs(mesh, planes, GANG_EV_SPECS, n_slots, axis)
 
 
+def relax_plane_shardings(mesh: Mesh, tree):
+    """Shardings for the relaxsolve assignment planes (ops/relax.py): the
+    [C, S]/[C] class×template tensors carry NO slot axis — they replicate
+    across the mesh (tiny next to the slot planes), so the relax_choose
+    dispatch composes with the pjit-over-slots solve path without a
+    resharding hop. Kept as an explicit parallel.mesh route (rather than
+    bare device_put) so graftlint GL501/GL503 resolve the relax entries'
+    placement the same way they resolve every other kernel family's."""
+    repl = replicated(mesh)
+    return jax.tree.map(lambda _: repl, tree)
+
+
 def _batched_specs(mesh: Mesh, tree, table: dict, n_slots: int, axis: str):
     """Shardings for a problem-batched NamedTuple [B, ...]: the batch axis
     replicates (each device holds every problem's shard — the vmap then

@@ -157,7 +157,17 @@ def decode_response(data: bytes) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
 # livelock — so the version bumps and a mixed deployment fails EXPLICITLY
 # (version-skew error → greedy degradation with the decode-failure metric)
 # instead of silently losing the mask.
-SOLVE_WIRE_VERSION = 3
+# v3: evictable-pod views + eviction claims (gangsched, ISSUE 10).
+# v4: solver_mode — the per-request backend selector behind the Solver
+# seam (relaxsolve, ISSUE 13): "ffd" | "relax", back-compat default "ffd"
+# when absent. Load-bearing the same way the ICE mask was: an old sidecar
+# silently dropping it would serve the heuristic packer to a client that
+# asked for (and will be judged on) the optimizing one.
+SOLVE_WIRE_VERSION = 4
+
+# the solver backends a request may select; "" means unspecified (the
+# serving daemon's default applies)
+SOLVER_MODES = ("ffd", "relax")
 
 
 def _json_payload(header: dict) -> bytes:
@@ -447,6 +457,7 @@ def encode_solve_request(
     max_slots: int = 256,
     unavailable_offerings=(),
     tenant: str = "default",
+    solver_mode: str = "ffd",
 ) -> bytes:
     """Serialize a full scheduler input for the solverd sidecar.
     ``unavailable_offerings`` is the control plane's ICE-cache snapshot
@@ -456,7 +467,14 @@ def encode_solve_request(
     (solver/fleet.py) for fair queueing and per-tenant accounting; it
     defaults to the single-tenant id so a pre-fleet client stays valid on
     the same wire version (an old sidecar ignoring it loses only
-    accounting, never placements — unlike the load-bearing ICE mask)."""
+    accounting, never placements — unlike the load-bearing ICE mask).
+    ``solver_mode`` selects the solve backend behind the Solver seam
+    (relaxsolve, ISSUE 13): "ffd" (first-fit-decreasing, the classic
+    path) or "relax" (convex-relaxation optimizer with the FFD result as
+    the scored/anytime fallback); it also rides the X-Solver-Mode header
+    so the gateway can route pre-decode."""
+    if solver_mode not in SOLVER_MODES:
+        raise ValueError(f"unknown solver mode {solver_mode!r}")
     from karpenter_core_tpu.kube import serial
 
     table, pools = _encode_it_table(instance_types)
@@ -492,6 +510,7 @@ def encode_solve_request(
             list(k) for k in unavailable_offerings
         ),
         "tenant": tenant,
+        "solver_mode": solver_mode,
     }
     return _json_payload(header)
 
@@ -515,7 +534,17 @@ def problem_fingerprint(header: dict) -> str:
     # watching identical clusters (an HA pair, a blue/green pair) describe
     # the same problem and may share one cached DeviceScheduler — the
     # cache is content-addressed, isolation is the gateway's job
-    probe = {k: v for k, v in header.items() if k not in ("pods", "tenant")}
+    # solver_mode is excluded like the tenant, for a different reason:
+    # the EFFECTIVE mode is resolved header > wire > daemon-default at
+    # the serving daemon, which appends the resolved mode to the
+    # fingerprint itself — hashing the raw field here would split a
+    # mode-less request and an explicit-default one into two cached
+    # schedulers for the identical problem + mode
+    probe = {
+        k: v
+        for k, v in header.items()
+        if k not in ("pods", "tenant", "solver_mode")
+    }
     # the topology context's excluded-uid list is derived from the PENDING
     # pods (provisioner excludes them from existing counts), so it belongs
     # to the pod half: hashing it would churn the scheduler cache on every
@@ -612,6 +641,17 @@ def problem_bucket(header: dict) -> str:
         _pow2_bucket(len(tiers), lo=1),
         has_gangs,
         has_evictable,
+        # solver mode (relaxsolve, ISSUE 13): a relax problem's dispatch
+        # stream interleaves assignment kernels and candidate re-solves
+        # an ffd problem never issues, so the two modes must never
+        # coalesce into one vmapped batch — the bucket splits here and
+        # _KernelRequest.shape_key (mode component) backstops one layer
+        # down for anything that slips past the predictor. Normalized
+        # (absent == the ffd default) so a mode-less client and an
+        # explicit-default one still coalesce; the serving daemon
+        # additionally suffixes the ticket bucket with the RESOLVED mode,
+        # which is what a non-default daemon default rides on.
+        str(header.get("solver_mode") or "ffd"),
     )
     return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
 
@@ -643,7 +683,19 @@ def decode_solve_request(data: bytes) -> dict:
         ),
         # absent from a pre-fleet encoder -> the single-tenant id
         "tenant": h.get("tenant", "default"),
+        # back-compat default: absent/empty means "unspecified" and the
+        # serving daemon's configured default applies (solverd
+        # --solver-mode, "ffd" out of the box). Unknown values reject at
+        # the decode net — an invalid mode must not surface as a
+        # DeviceScheduler constructor raise inside the device window.
+        "solver_mode": _check_mode(h.get("solver_mode", "")),
     }
+
+
+def _check_mode(mode) -> str:
+    if mode in SOLVER_MODES or mode == "":
+        return mode
+    raise ValueError(f"unknown solver mode on the wire: {mode!r}")
 
 
 def encode_solve_results(results, solve_seconds: float) -> bytes:

@@ -228,7 +228,7 @@ class SolverClient:
             return
         raise ValueError(f"unknown fault {fault!r}")
 
-    def _once(self, path: str, body: bytes):
+    def _once(self, path: str, body: bytes, headers: dict = None):
         self._apply_fault()
         conn = http.client.HTTPConnection(
             self.host, self.port, timeout=self.timeout
@@ -242,6 +242,9 @@ class SolverClient:
                     # budget remains — what admission sheds against
                     "X-Solver-Tenant": self.tenant,
                     "X-Solver-Deadline": f"{self.timeout:.3f}",
+                    # per-request extras (e.g. X-Solver-Mode, the solver
+                    # backend selector) layer on top of the identity set
+                    **(headers or {}),
                 },
             )
             resp = conn.getresponse()
@@ -284,7 +287,7 @@ class SolverClient:
         finally:
             conn.close()
 
-    def call(self, path: str, body: bytes):
+    def call(self, path: str, body: bytes, headers: dict = None):
         """(response bytes, sidecar-reported kernel seconds), or raises
         RemoteSolverError after the retry budget / on an open circuit."""
         from karpenter_core_tpu.metrics import wiring as m
@@ -306,7 +309,7 @@ class SolverClient:
                 )
             retry_after = None
             try:
-                data, kernel = self._once(path, body)
+                data, kernel = self._once(path, body, headers)
             except RemoteSolverError as e:
                 cause, detail, retry_after = e.cause, str(e), e.retry_after
                 if e.cause in ("drain", "poisoned"):
@@ -377,6 +380,13 @@ class RemoteScheduler:
         self.daemonset_pods = list(daemonset_pods or [])
         self.topology = topology
         self.max_slots = (device_scheduler_opts or {}).get("max_slots", 256)
+        # the solver backend this client requests per solve (relaxsolve,
+        # ISSUE 13): rides the wire (codec solver_mode field) AND the
+        # X-Solver-Mode header; the greedy degradation below is the
+        # anytime answer either way
+        self.solver_mode = (device_scheduler_opts or {}).get(
+            "solver_mode", "ffd"
+        )
         # the ICE-cache snapshot ships on the wire so the sidecar masks the
         # same offerings; the greedy fallback applies it locally too
         self.unavailable_offerings = frozenset(unavailable_offerings)
@@ -410,6 +420,7 @@ class RemoteScheduler:
                     max_slots=self.max_slots,
                     unavailable_offerings=self.unavailable_offerings,
                     tenant=self.client.tenant,
+                    solver_mode=self.solver_mode,
                 )
             # poison check AFTER encode (the digest IS the canonical wire
             # bytes) but BEFORE any transport: a quarantined problem costs
@@ -420,7 +431,10 @@ class RemoteScheduler:
                 m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "solve"})
                 return self._fallback_solve(pods, gangsched)
             t0 = time.perf_counter()
-            data, kernel = self.client.call("/solve", body)
+            data, kernel = self.client.call(
+                "/solve", body,
+                headers={"X-Solver-Mode": self.solver_mode},
+            )
             total = time.perf_counter() - t0
             m.SOLVER_RPC_PHASE_DURATION.observe(kernel, {"phase": "kernel"})
             m.SOLVER_RPC_PHASE_DURATION.observe(

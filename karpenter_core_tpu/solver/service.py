@@ -225,10 +225,18 @@ class SolverDaemon:
         quarantine: fleet.PoisonQuarantine = None,
         chaos=None,
         exit_fn=None,
+        default_mode: str = "ffd",
     ):
         self.ready = False
         self.solves = 0
         self.profile_dir = profile_dir
+        # solver backend served when a request names none (relaxsolve,
+        # ISSUE 13): the wire field / X-Solver-Mode header select
+        # per-request; this is the daemon-wide default (solverd
+        # --solver-mode, riding the supervisor spawn argv)
+        if default_mode not in codec.SOLVER_MODES:
+            raise ValueError(f"unknown solver mode {default_mode!r}")
+        self.default_mode = default_mode
         # shard every solve/sweep over the first N local devices (0 = all;
         # requests clamp to what exists, so a multi-device config degrades
         # to the single-device path on a 1-chip box). Resolved lazily per
@@ -306,7 +314,8 @@ class SolverDaemon:
 
     # -- endpoints ---------------------------------------------------------
 
-    def solve(self, body: bytes, tenant: str = None, deadline: float = None):
+    def solve(self, body: bytes, tenant: str = None, deadline: float = None,
+              solver_mode: str = None):
         """bytes -> (response bytes, solve seconds). Raises fleet.ShedError
         when admission rejects the request (the HTTP layer answers 429 +
         Retry-After; solver/remote.py degrades that solve to greedy),
@@ -344,12 +353,32 @@ class SolverDaemon:
             problem = self._decode_solve(body)
             if tenant is None:
                 ticket.tenant = problem["tenant"]
+            # solver-mode resolution (relaxsolve, ISSUE 13): transport
+            # header > wire field > daemon default. A resolved mode that
+            # differs from the wire's suffixes the fingerprint (the
+            # scheduler cache must never serve one mode's scheduler to
+            # the other) and always rides the bucket so relax and ffd
+            # problems can never coalesce into one vmapped batch.
+            eff_mode = (
+                solver_mode
+                or problem.get("solver_mode")
+                or self.default_mode
+            )
+            problem["solver_mode"] = eff_mode
+            # the codec fingerprint deliberately excludes the raw
+            # mode field (a mode-less wire and an explicit default
+            # must map to ONE cached scheduler); the RESOLVED mode
+            # re-joins here so the cache stays mode-bound without
+            # version-skew splits
+            problem["fingerprint"] = (
+                f"{problem['fingerprint']}+m{eff_mode}"
+            )
             # the coalescer's compatibility key: the decoded problem's
             # compile-shape bucket (codec.problem_bucket) scoped to this
             # daemon's device count; the fingerprint keeps two requests
             # for the SAME problem off one grant (a cached DeviceScheduler
             # is single-solve stateful)
-            ticket.bucket = f"{problem['bucket']}|d{self.devices}"
+            ticket.bucket = f"{problem['bucket']}|m{eff_mode}|d{self.devices}"
             ticket.fingerprint = problem["fingerprint"]
             ticket.payload = (body, problem, digest)
         except BaseException:
@@ -392,6 +421,9 @@ class SolverDaemon:
                 topology=problem["topology"],
                 unavailable_offerings=problem["unavailable_offerings"],
                 devices=self.devices,
+                solver_mode=(
+                    problem.get("solver_mode") or self.default_mode
+                ),
                 # the CLIENT verifies (solver/remote.py): it must not
                 # trust the wire anyway, so a sidecar-side check would
                 # double the overhead yet still miss wire corruption —
@@ -511,6 +543,20 @@ class SolverDaemon:
                     except Exception as e:
                         outcomes[i] = ("error", e)
                         continue
+                    # relaxsolve anytime budget: the request's remaining
+                    # client deadline bounds the optimizer's wall — past
+                    # it the relax pass skips and the FFD answer serves
+                    # (the PR 8 deadline machinery, one layer deeper).
+                    # Reset, don't just set: the scheduler is cached per
+                    # fingerprint, and a stale tiny budget left by a
+                    # deadline-carrying request would permanently degrade
+                    # deadline-less requests to the FFD answer.
+                    if getattr(scheduler, "solver_mode", "ffd") == "relax":
+                        scheduler.relax_budget_s = (
+                            max(t.deadline_at - self.gateway.time_fn(), 0.0)
+                            if t.deadline_at is not None
+                            else None
+                        )
                     entries.append((scheduler, problem_i["pods"]))
                     entry_idx.append(i)
                 if entries:
@@ -804,10 +850,12 @@ class _Handler(BaseHTTPRequestHandler):
             send_body(self, 404, b'{"error": "not found"}')
 
     def _request_identity(self):
-        """(tenant, deadline) from transport headers. The header is the
-        gateway's pre-decode identity; the wire's tenant field backs it up
-        for header-less clients. A malformed deadline means no deadline
-        (shedding on garbage would turn a client bug into an outage)."""
+        """(tenant, deadline, solver_mode) from transport headers. The
+        header is the gateway's pre-decode identity; the wire's tenant
+        field backs it up for header-less clients. A malformed deadline
+        means no deadline (shedding on garbage would turn a client bug
+        into an outage); an unknown X-Solver-Mode is ignored the same
+        way — the wire field / daemon default decide instead."""
         tenant = self.headers.get("X-Solver-Tenant") or None
         deadline = None
         raw = self.headers.get("X-Solver-Deadline")
@@ -818,16 +866,22 @@ class _Handler(BaseHTTPRequestHandler):
                 deadline = None
         if deadline is not None and deadline <= 0:
             deadline = None
-        return tenant, deadline
+        from karpenter_core_tpu.solver import codec as _codec
+
+        mode = self.headers.get("X-Solver-Mode") or None
+        if mode is not None and mode not in _codec.SOLVER_MODES:
+            mode = None
+        return tenant, deadline, mode
 
     def do_POST(self) -> None:
         path, _, query = self.path.partition("?")
         body = read_body(self)
-        tenant, deadline = self._request_identity()
+        tenant, deadline, solver_mode = self._request_identity()
         try:
             if path == "/solve":
                 out, dt = self.daemon.solve(
-                    body, tenant=tenant, deadline=deadline
+                    body, tenant=tenant, deadline=deadline,
+                    solver_mode=solver_mode,
                 )
             elif path == "/consolidate":
                 out, dt = self.daemon.consolidate(
@@ -982,6 +1036,14 @@ def main() -> int:
         help="seconds a quarantined poison-pill digest stays refused",
     )
     ap.add_argument(
+        "--solver-mode", choices=list(codec.SOLVER_MODES), default="ffd",
+        help="solve backend served when a request names none: ffd ="
+        " first-fit-decreasing (classic), relax = convex-relaxation"
+        " optimizer with the FFD result as the scored/anytime fallback;"
+        " requests override per-call via the wire field or the"
+        " X-Solver-Mode header",
+    )
+    ap.add_argument(
         "--quarantine-journal", default=None,
         help="path for the crash-only poison journal: the digest in"
         " flight on the device is recorded here, so a problem that"
@@ -1012,6 +1074,7 @@ def main() -> int:
         ),
         devices=args.devices,
         watchdog_seconds=args.watchdog_seconds,
+        default_mode=args.solver_mode,
         quarantine=fleet.PoisonQuarantine(
             strikes=args.quarantine_strikes,
             ttl=args.quarantine_ttl,

@@ -56,6 +56,7 @@ def default_command(
     devices: Optional[int] = None,
     watchdog_seconds: Optional[float] = None,
     quarantine_journal: Optional[str] = None,
+    solve_mode: Optional[str] = None,
 ) -> List[str]:
     cmd = [
         sys.executable,
@@ -99,6 +100,11 @@ def default_command(
     # fingerprint that was in flight when its predecessor died
     if quarantine_journal:
         cmd.extend(["--quarantine-journal", quarantine_journal])
+    # the child's default solve backend (relaxsolve, ISSUE 13): only a
+    # non-default rides the argv, so a respawned sidecar keeps serving
+    # the operator's --solver-backend choice to mode-less requests
+    if solve_mode:
+        cmd.extend(["--solver-mode", solve_mode])
     return cmd
 
 
@@ -118,6 +124,7 @@ class SolverSupervisor:
         devices: Optional[int] = None,
         watchdog_seconds: Optional[float] = None,
         quarantine_journal: Optional[str] = None,
+        solve_mode: Optional[str] = None,
         backoff_initial: float = 1.0,
         backoff_max: float = 30.0,
         stable_window: float = 60.0,
@@ -136,6 +143,7 @@ class SolverSupervisor:
             devices=devices,
             watchdog_seconds=watchdog_seconds,
             quarantine_journal=quarantine_journal,
+            solve_mode=solve_mode,
         )
         self.backoff_initial = backoff_initial
         self.backoff_max = backoff_max

@@ -1,0 +1,25 @@
+# GL501 bad (relaxsolve, ISSUE 13): a DeviceScheduler-shaped relax pass
+# hands the scored-fallback comparator (ops/relax.relax_score — a
+# SlotState jit entry) state built straight from host numpy: nothing in
+# its dataflow ever routed through parallel.mesh placement, so on a
+# multi-device scheduler the score dispatch compiles against absent
+# shardings and gathers the whole slot axis. Lint corpus only — never
+# imported.
+import numpy as np
+
+from karpenter_core_tpu.ops.ffd import SlotState
+from karpenter_core_tpu.ops.relax import relax_score
+
+
+class DeviceScheduler:
+    def _fake_final_state(self, n_slots):
+        # every plane is host numpy: provenance {host}, never placed
+        return SlotState(
+            kind=np.full((n_slots,), 2, dtype=np.int8),
+            template=np.zeros((n_slots,), dtype=np.int32),
+            podcount=np.ones((n_slots,), dtype=np.int32),
+        )
+
+    def _relax_improve(self, tmpl_price, unplaced_bc, n_slots):
+        state = self._fake_final_state(n_slots)
+        return relax_score(state, tmpl_price, unplaced_bc)  # GL501

@@ -1,0 +1,39 @@
+# GL501 good (relaxsolve, ISSUE 13): the production shape — the state
+# the scorer consumes is the FINISHED solve's SlotState, whose planes
+# were placed through the sanctioned parallel.mesh routes (_dev_slots ->
+# axis_sharding) before the solve dispatch; the relax assignment planes
+# route through relax_plane_shardings (replicated — they carry no slot
+# axis). Lint corpus only — never imported.
+import jax
+import numpy as np
+
+from karpenter_core_tpu.ops.ffd import SlotState, ffd_solve_donated
+from karpenter_core_tpu.ops.relax import relax_choose, relax_score
+from karpenter_core_tpu.parallel import mesh as pmesh
+
+
+class DeviceScheduler:
+    def __init__(self, mesh):
+        self._mesh = mesh
+
+    def _dev_slots(self, a):
+        return jax.device_put(a, pmesh.axis_sharding(self._mesh, a.ndim, 0))
+
+    def _make_init_state(self, n_slots):
+        return SlotState(
+            kind=self._dev_slots(np.zeros((n_slots,), dtype=np.int8)),
+            template=self._dev_slots(np.full((n_slots,), -1, np.int32)),
+            podcount=self._dev_slots(np.zeros((n_slots,), dtype=np.int32)),
+        )
+
+    def _relax_improve(self, steps, statics, planes, tmpl_price,
+                       unplaced_bc, n_slots):
+        planes = jax.device_put(
+            planes, pmesh.relax_plane_shardings(self._mesh, planes)
+        )
+        nt, ks, _changed = relax_choose(
+            *planes, iters=8, num_gangs=0
+        )
+        init = self._make_init_state(n_slots)
+        state, _takes, unplaced = ffd_solve_donated(init, steps, statics)
+        return nt, ks, relax_score(state, tmpl_price, unplaced_bc)

@@ -58,6 +58,21 @@ def test_fast_bench_emits_well_formed_json():
     # slots touched on device can exceed final claims (sparse-tail repack
     # drops empty claims) but never undershoot them
     assert phases["used_slots"] >= primary["nodes"] > 0
+    # every config's phases block is backend-attributable (ISSUE 13)
+    assert phases["solver_mode"] == "ffd"
+    # the tiny cfg12 proves the relaxsolve backend end-to-end: both
+    # modes solved, deltas recorded, and the acceptance gate holds even
+    # at smoke scale (the two-pool construction makes the win structural)
+    cfg12 = line["detail"]["cfg12_relax"]
+    for key in ("ffd", "relax", "nodes_delta", "cost_delta", "p50_ratio",
+                "node_improved", "cost_improved", "relax_ok"):
+        assert key in cfg12["cfg3_shape"] or key in cfg12, key
+    for shape in ("cfg3_shape", "cfg11_shape"):
+        assert cfg12[shape]["nodes_delta"] < 0, (shape, cfg12[shape])
+        assert cfg12[shape]["cost_delta"] < 0, (shape, cfg12[shape])
+        assert cfg12[shape]["ffd"]["phases"]["solver_mode"] == "ffd"
+        assert cfg12[shape]["relax"]["phases"]["solver_mode"] == "relax"
+    assert cfg12["relax_ok"] is True, cfg12
 
     # the tiny cfg11 gangsched smoke (ISSUE 10): preemption fired, every
     # gang stayed atomic, and the eviction set stayed minimal

@@ -204,6 +204,9 @@ def sample_problem() -> dict:
         # a non-default tenant so the fleet-gateway identity provably
         # survives the wire (the default would also pass a dropped field)
         tenant="tenant-a",
+        # a non-default backend so the relaxsolve mode selector provably
+        # survives the wire (ISSUE 13; same reasoning as the tenant)
+        solver_mode="relax",
     )
 
 

@@ -1,0 +1,456 @@
+"""relaxsolve (ISSUE 13): the convex-relaxation solver backend.
+
+Contract under test:
+* the relax backend STRICTLY improves node count AND $-cost on problems
+  where first-template-wins is suboptimal, and NEVER regresses anywhere
+  (the scored fallback serves the FFD answer when rounding loses);
+* every relax result passes the UNMODIFIED ResultVerifier — on plain,
+  topology, tier, and gang problems — with the rejection counter unmoved
+  (the relaxation composes the constraints, it doesn't special-case them);
+* the anytime contract: a spent budget serves the FFD answer;
+* the verdict cache: warm re-solves of a won problem dispatch once
+  (p50 parity with ffd mode) and keep the improved packing;
+* mode isolation: relax and ffd problems never share a vmapped dispatch
+  (codec.problem_bucket component + _KernelRequest.shape_key component);
+* the wire: solver_mode field + X-Solver-Mode header + solverd/operator
+  flag plumbing.
+"""
+import copy
+
+import pytest
+
+from tests.helpers import GIB, make_nodepool, make_pod
+from tests.test_fuzz_parity import fuzz_scenario
+
+from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+from karpenter_core_tpu.models.provisioner import (
+    DeviceScheduler,
+    _KernelRequest,
+    solve_batch,
+)
+from karpenter_core_tpu.solver import codec
+from karpenter_core_tpu.solver.gangs import (
+    GANG_ANNOTATION,
+    GANG_SAME_TEMPLATE_ANNOTATION,
+)
+from karpenter_core_tpu.solver.verify import ResultVerifier
+
+
+def _rejections():
+    from karpenter_core_tpu.metrics import wiring as m
+
+    return dict(m.SOLVER_RESULT_REJECTED.values)
+
+
+def two_pool_world(cheaper_dense: float = 0.9):
+    """The shape where first-template-wins provably loses: pool 'a-first'
+    (first by name at equal weight) offers only 4-cpu nodes, pool
+    'b-dense' 16-cpu nodes at ``cheaper_dense``x the per-cpu price — the
+    FFD backend packs everything onto a-first (4 pods/node for 1-cpu
+    pods), the relaxation onto b-dense (16 pods/node, cheaper)."""
+    cat_a = build_catalog(cpu_grid=[4], mem_factors=[4], oses=["linux"],
+                          arches=["amd64"])
+    cat_b = build_catalog(cpu_grid=[16], mem_factors=[4], oses=["linux"],
+                          arches=["amd64"])
+    for it in cat_b:
+        for off in it.offerings:
+            off.price *= cheaper_dense
+    pools = [make_nodepool("a-first"), make_nodepool("b-dense")]
+    return pools, {"a-first": cat_a, "b-dense": cat_b}
+
+
+def _cost(results, its):
+    """$-cost proxy of a Results: cheapest available offering among each
+    claim's instance-type options."""
+    total = 0.0
+    for c in results.new_node_claims:
+        total += min(
+            off.price
+            for it in c.instance_type_options
+            for off in it.offerings
+            if off.available
+        )
+    return total
+
+
+def _pods(n, cpu=1.0):
+    return [make_pod(cpu=cpu, memory_gib=1.0, name=f"p{i}")
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# the headline: relax strictly beats FFD where template choice matters
+# ---------------------------------------------------------------------------
+
+
+def test_relax_strictly_beats_ffd_on_two_pool_problem():
+    pools, its = two_pool_world()
+    pods = _pods(64)
+
+    ffd = DeviceScheduler(copy.deepcopy(pools), its, max_slots=256)
+    res_f = ffd.solve(copy.deepcopy(pods))
+    assert res_f.all_pods_scheduled()
+
+    before = _rejections()
+    rx = DeviceScheduler(copy.deepcopy(pools), its, max_slots=256,
+                         solver_mode="relax")
+    res_r = rx.solve(copy.deepcopy(pods))
+    assert _rejections() == before, "relax result tripped the verifier"
+    assert res_r.all_pods_scheduled()
+
+    assert res_r.node_count() < res_f.node_count(), (
+        f"relax={res_r.node_count()} ffd={res_f.node_count()}"
+    )
+    assert _cost(res_r, its) < _cost(res_f, its)
+    assert rx.last_phase_stats["relax"]["outcome"] == "won"
+    assert rx.last_phase_stats["solver_mode"] == "relax"
+
+
+def test_relax_verdict_cache_warm_solves_dispatch_once():
+    pools, its = two_pool_world()
+    pods = _pods(48)
+    rx = DeviceScheduler(pools, its, max_slots=256, solver_mode="relax")
+    cold = rx.solve(copy.deepcopy(pods))
+    cold_nodes = cold.node_count()
+    # warm until the adaptive slot axis settles, then the verdict must hit
+    rx.solve(copy.deepcopy(pods))
+    warm = rx.solve(copy.deepcopy(pods))
+    assert warm.node_count() == cold_nodes
+    assert rx.last_phase_stats["relax"]["outcome"] == "cached_won"
+    assert rx.last_phase_stats["relax"]["cached"] is True
+
+
+def test_relax_noop_on_single_template_matches_ffd_exactly():
+    """One nodepool -> one template -> rounding cannot move anything: the
+    relax solve must serve the byte-same packing as ffd mode and record
+    the short-circuit."""
+    catalog = build_catalog(cpu_grid=[2, 4, 8], mem_factors=[4],
+                            oses=["linux"], arches=["amd64"])
+    pools = [make_nodepool()]
+    its = {"default": catalog}
+    pods = _pods(40)
+
+    res_f = DeviceScheduler(copy.deepcopy(pools), its,
+                            max_slots=128).solve(copy.deepcopy(pods))
+    rx = DeviceScheduler(copy.deepcopy(pools), its, max_slots=128,
+                         solver_mode="relax")
+    res_r = rx.solve(copy.deepcopy(pods))
+    assert res_r.node_count() == res_f.node_count()
+    assert set(res_r.pod_errors) == set(res_f.pod_errors)
+    assert rx.last_phase_stats["relax"]["outcome"] == "noop"
+
+
+# ---------------------------------------------------------------------------
+# anytime contract
+# ---------------------------------------------------------------------------
+
+
+def test_relax_deadline_serves_the_ffd_answer():
+    """A spent budget must serve the FFD packing — inside budget, not
+    after finishing the optimizer anyway."""
+    pools, its = two_pool_world()
+    pods = _pods(48)
+
+    res_f = DeviceScheduler(copy.deepcopy(pools), its,
+                            max_slots=256).solve(copy.deepcopy(pods))
+    rx = DeviceScheduler(copy.deepcopy(pools), its, max_slots=256,
+                         solver_mode="relax", relax_budget_s=0.0)
+    res_r = rx.solve(copy.deepcopy(pods))
+    assert rx.last_phase_stats["relax"]["outcome"] == "deadline"
+    # the anytime answer IS the FFD answer
+    assert res_r.node_count() == res_f.node_count()
+    assert set(res_r.pod_errors) == set(res_f.pod_errors)
+    # and a roomy budget on the same scheduler improves it (the expired
+    # round cached no verdict — the optimizer re-runs)
+    rx.relax_budget_s = None
+    res_r2 = rx.solve(copy.deepcopy(pods))
+    assert res_r2.node_count() < res_f.node_count()
+
+
+# ---------------------------------------------------------------------------
+# fuzz battery: the unmodified verifier accepts every relax result
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(14))
+def test_relax_passes_verifier_on_every_fuzz_seed(seed):
+    """Every existing fuzz seed (mixed topology/taints/selectors/volumes/
+    existing nodes), solved in relax mode with verification ON: the
+    rejection counter must not move, and pod conservation must hold."""
+    pods, existing, pools, its = fuzz_scenario(seed)
+    before = _rejections()
+    rx = DeviceScheduler(copy.deepcopy(pools), its,
+                         existing_nodes=copy.deepcopy(existing),
+                         max_slots=128, solver_mode="relax")
+    rp = copy.deepcopy(pods)
+    res = rx.solve(rp)
+    assert _rejections() == before, (
+        "verifier false-positive on a relax-mode result"
+    )
+    placed = sum(len(c.pods) for c in res.new_node_claims) + sum(
+        len(s.pods) for s in res.existing_nodes
+    )
+    assert placed == len(pods) - len(res.pod_errors)
+    # independent re-check (belt and braces beyond the counter)
+    violations = ResultVerifier(
+        pools, its, existing_nodes=copy.deepcopy(existing)
+    ).verify(res, rp)
+    assert not violations, [str(v) for v in violations]
+
+
+def _topology_pods(n):
+    pods = []
+    for i in range(n):
+        kind = i % 3
+        if kind == 0:
+            pods.append(make_pod(cpu=1.0, name=f"t{i}"))
+        elif kind == 1:
+            pods.append(make_pod(
+                cpu=1.0, name=f"t{i}", labels={"app": f"sz-{i % 2}"},
+                spread_zone=True,
+            ))
+        else:
+            pods.append(make_pod(
+                cpu=1.0, name=f"t{i}", labels={"app": f"sh-{i % 2}"},
+                spread_hostname=True,
+            ))
+    return pods
+
+
+def test_relax_passes_verifier_on_topology_problems():
+    pools, its = two_pool_world()
+    pods = _topology_pods(36)
+    before = _rejections()
+    rx = DeviceScheduler(copy.deepcopy(pools), its, max_slots=256,
+                         solver_mode="relax")
+    rp = copy.deepcopy(pods)
+    res = rx.solve(rp)
+    assert _rejections() == before
+    assert res.all_pods_scheduled(), list(res.pod_errors.items())[:3]
+    violations = ResultVerifier(pools, its).verify(res, rp)
+    assert not violations, [str(v) for v in violations]
+
+
+def _gang_tier_pods(n_gangs=3, gang_size=4, n_plain=12):
+    pods = []
+    for g in range(n_gangs):
+        for j in range(gang_size):
+            pods.append(make_pod(
+                cpu=1.0, memory_gib=1.0, name=f"g{g}-{j}",
+            ))
+            pods[-1].metadata.annotations = {
+                GANG_ANNOTATION: f"gang-{g}",
+                GANG_SAME_TEMPLATE_ANNOTATION: "true",
+            }
+    for i in range(n_plain):
+        p = make_pod(cpu=1.0, name=f"c{i}")
+        p.priority = 1_000_000 * (1 + i % 2)  # two positive tiers
+        pods.append(p)
+    pods.extend(_pods(8))
+    return pods
+
+
+def test_relax_passes_verifier_on_tier_and_gang_problems():
+    """Tiers and same-template gangs are CONSTRAINTS of the relaxation:
+    the relax result must verify clean (gang atomicity + co-location
+    re-derived from annotations by the unmodified verifier) and every
+    gang must land whole on one template."""
+    pools, its = two_pool_world()
+    pods = _gang_tier_pods()
+    before = _rejections()
+    rx = DeviceScheduler(copy.deepcopy(pools), its, max_slots=256,
+                         solver_mode="relax")
+    rp = copy.deepcopy(pods)
+    res = rx.solve(rp)
+    assert _rejections() == before
+    assert res.all_pods_scheduled(), list(res.pod_errors.items())[:3]
+    violations = ResultVerifier(pools, its).verify(res, rp)
+    assert not violations, [str(v) for v in violations]
+    # same-template co-location holds through the relax override
+    pool_of_pod = {
+        p.uid: c.template.nodepool_name
+        for c in res.new_node_claims
+        for p in c.pods
+    }
+    by_gang = {}
+    for p in rp:
+        ann = p.metadata.annotations or {}
+        if ann.get(GANG_ANNOTATION):
+            by_gang.setdefault(ann[GANG_ANNOTATION], set()).add(
+                pool_of_pod.get(p.uid)
+            )
+    assert by_gang and all(
+        len(pools_used) == 1 for pools_used in by_gang.values()
+    ), by_gang
+
+
+# ---------------------------------------------------------------------------
+# mode isolation: shape keys, buckets, mixed-mode batches
+# ---------------------------------------------------------------------------
+
+
+def test_kernel_request_shape_key_carries_mode():
+    import jax.numpy as jnp
+
+    def req(mode):
+        return _KernelRequest(
+            init_state=jnp.zeros((4,)), steps=jnp.zeros((4,)),
+            statics=jnp.zeros((4,)), level_iters=8,
+            step_class=jnp.zeros((4,), dtype=jnp.int32), num_classes=8,
+            devices=1, n_slots=4, mode=mode,
+        )
+
+    assert req("ffd").shape_key() != req("relax").shape_key()
+    assert req("ffd").shape_key() == req("ffd").shape_key()
+
+
+def test_problem_bucket_carries_solver_mode():
+    pools, its = two_pool_world()
+    pods = _pods(8)
+
+    def bucket(mode):
+        body = codec.encode_solve_request(
+            pools, its, [], [], pods, solver_mode=mode
+        )
+        return codec.problem_bucket(codec._json_header(body))
+
+    assert bucket("ffd") != bucket("relax")
+    assert bucket("ffd") == bucket("ffd")
+
+
+def test_mixed_mode_solve_batch_never_shares_a_vmapped_dispatch():
+    """One ffd and one relax problem of the SAME compile shape under one
+    solve_batch window: their solve dispatches must run solo (zero
+    batched dispatches) yet both complete — the shape_key mode component
+    in action. The same pair in a single mode IS coalesced (positive
+    control, so this test can't pass vacuously)."""
+    pools, its = two_pool_world()
+    pods = _pods(32)
+
+    def sched(mode):
+        return DeviceScheduler(copy.deepcopy(pools), its, max_slots=256,
+                               solver_mode=mode)
+
+    # positive control: same mode, same shape -> coalesces
+    outcomes, stats = solve_batch([
+        (sched("ffd"), copy.deepcopy(pods)),
+        (sched("ffd"), copy.deepcopy(pods)),
+    ])
+    assert all(st == "ok" for st, _ in outcomes)
+    assert stats["batched_dispatches"] >= 1, stats
+
+    # mixed modes: identical tensor shapes, yet nothing coalesces
+    outcomes, stats = solve_batch([
+        (sched("ffd"), copy.deepcopy(pods)),
+        (sched("relax"), copy.deepcopy(pods)),
+    ])
+    assert all(st == "ok" for st, _ in outcomes)
+    assert stats["batched_dispatches"] == 0, stats
+    res_f, res_r = outcomes[0][1], outcomes[1][1]
+    assert res_r.node_count() < res_f.node_count()
+
+
+def test_two_relax_problems_coalesce_their_dispatches():
+    """Two relax problems in one window DO coalesce — including the
+    relax_choose assignment dispatch (the batched twin)."""
+    pools, its = two_pool_world()
+    pods = _pods(32)
+    outcomes, stats = solve_batch([
+        (DeviceScheduler(copy.deepcopy(pools), its, max_slots=256,
+                         solver_mode="relax"), copy.deepcopy(pods)),
+        (DeviceScheduler(copy.deepcopy(pools), its, max_slots=256,
+                         solver_mode="relax"), copy.deepcopy(pods)),
+    ])
+    assert all(st == "ok" for st, _ in outcomes)
+    assert stats["batched_dispatches"] >= 2, stats  # solve + relax rounds
+    assert outcomes[0][1].node_count() == outcomes[1][1].node_count()
+
+
+# ---------------------------------------------------------------------------
+# the wire: field, header, flags
+# ---------------------------------------------------------------------------
+
+
+def test_codec_rejects_unknown_mode_both_sides():
+    pools, its = two_pool_world()
+    with pytest.raises(ValueError, match="unknown solver mode"):
+        codec.encode_solve_request(pools, its, [], [], [],
+                                   solver_mode="zzz")
+    body = codec.encode_solve_request(pools, its, [], [], [])
+    h = codec._json_header(body)
+    h["solver_mode"] = "zzz"
+    with pytest.raises(ValueError, match="unknown solver mode"):
+        codec.decode_solve_request(codec._json_payload(h))
+
+
+def test_solve_wire_version_bumped_for_mode_field():
+    assert codec.SOLVE_WIRE_VERSION == 4
+    body = codec.encode_solve_request(*two_pool_world(), [], [], [])
+    h = codec._json_header(body)
+    h["version"] = 3
+    with pytest.raises(ValueError, match="unsupported solve wire version"):
+        codec.decode_solve_request(codec._json_payload(h))
+
+
+def test_daemon_header_overrides_wire_mode():
+    """X-Solver-Mode wins over the wire field; the override lands a
+    DIFFERENT scheduler-cache fingerprint, so the two modes never share
+    one (single-solve-stateful, mode-bound) DeviceScheduler."""
+    from karpenter_core_tpu.solver.service import SolverDaemon
+
+    pools, its = two_pool_world()
+    pods = _pods(48)
+    body = codec.encode_solve_request(pools, its, [], [], pods,
+                                      solver_mode="ffd")
+    d = SolverDaemon()
+    out_f, _ = d.solve(body)
+    claims_f = len(codec.decode_solve_results(out_f)["claims"])
+    out_r, _ = d.solve(body, solver_mode="relax")
+    claims_r = len(codec.decode_solve_results(out_r)["claims"])
+    assert claims_r < claims_f, (claims_r, claims_f)
+
+
+def test_daemon_default_mode_applies_to_modeless_wire():
+    """A request whose wire names no mode (back-compat / foreign client)
+    gets the daemon's --solver-mode default."""
+    from karpenter_core_tpu.solver.service import SolverDaemon
+
+    pools, its = two_pool_world()
+    pods = _pods(48)
+    body = codec.encode_solve_request(pools, its, [], [], pods)
+    h = codec._json_header(body)
+    h.pop("solver_mode")
+    modeless = codec._json_payload(h)
+    assert codec.decode_solve_request(modeless)["solver_mode"] == ""
+
+    claims = {}
+    for mode in ("ffd", "relax"):
+        d = SolverDaemon(default_mode=mode)
+        out, _ = d.solve(modeless)
+        claims[mode] = len(codec.decode_solve_results(out)["claims"])
+    assert claims["relax"] < claims["ffd"], claims
+
+
+def test_supervisor_spawn_argv_carries_solver_mode():
+    from karpenter_core_tpu.solver.supervisor import default_command
+
+    cmd = default_command(0, solve_mode="relax")
+    i = cmd.index("--solver-mode")
+    assert cmd[i + 1] == "relax"
+    assert "--solver-mode" not in default_command(0)
+
+
+def test_operator_solver_backend_flag():
+    from karpenter_core_tpu.operator import Options
+
+    opts = Options.parse(["--solver-backend", "relax"])
+    assert opts.solver_backend == "relax"
+    assert Options.parse([]).solver_backend == "ffd"
+    with pytest.raises(ValueError, match="unknown solver backend"):
+        Options.parse(["--solver-backend", "zzz"])
+
+
+def test_device_scheduler_rejects_unknown_mode():
+    pools, its = two_pool_world()
+    with pytest.raises(ValueError, match="unknown solver mode"):
+        DeviceScheduler(pools, its, solver_mode="zzz")

@@ -1129,7 +1129,9 @@ class TestPoisonQuarantine:
         pools, its, pods = _solve_problem(2)
         body = codec.encode_solve_request(pools, its, [], [], pods,
                                           max_slots=16)
-        fp = codec.decode_solve_request(body)["fingerprint"]
+        # the daemon's cache key is the decoded fingerprint plus the
+        # RESOLVED solver mode (relaxsolve, ISSUE 13)
+        fp = codec.decode_solve_request(body)["fingerprint"] + "+mffd"
 
         class _Bomb:
             def update_topology_context(self, topo):

@@ -65,6 +65,7 @@ _MESH_SHARDERS = {
     "slot_shardings", "axis_sharding", "batch_sharding",
     "batched_slot_shardings", "batched_step_shardings",
     "gang_plane_shardings", "batched_gang_plane_shardings",
+    "relax_plane_shardings",
 }
 _MESH_REPLICATORS = {"replicated"}
 

@@ -50,7 +50,11 @@ from tools.graftlint.engine import ParsedFile, Rule, dotted_name, register
 # entries (ISSUE 10) are SlotState kernels too: gang_solve* runs the same
 # scan with a gang axis riding the class batch, and preempt_pass* consumes
 # the FINISHED solve's SlotState plus the EvPlanes (whose slot axis routes
-# through parallel.mesh.gang_plane_shardings / the batched twin).
+# through parallel.mesh.gang_plane_shardings / the batched twin). The
+# relaxsolve scorer (ISSUE 13, ops/relax.relax_score) consumes a FINISHED
+# solve's SlotState too — its state must come out of a routed dispatch,
+# never a bare host build (the relax assignment planes themselves carry no
+# slot axis and route through parallel.mesh.relax_plane_shardings).
 SLOTSTATE_JIT_ENTRIES = {
     "ffd_solve",
     "ffd_solve_donated",
@@ -63,6 +67,7 @@ SLOTSTATE_JIT_ENTRIES = {
     "gang_solve_batched_donated",
     "preempt_pass",
     "preempt_pass_batched",
+    "relax_score",
 }
 
 
