@@ -42,6 +42,18 @@ Secondary lines (reported in `detail`):
                   version runs under BENCH_FAST=1 so tier-1 smokes the
                   relax path. `--configs cfgA,cfgB` runs a subset of the
                   secondary configs (the primary always runs)
+  cfg13_delta     the delta wire + solver fleet (ISSUE 14): an
+                  operator-shaped snapshot (existing nodes + topology
+                  context + catalog) re-solved across 1%-churn rounds
+                  through BOTH wire forms — full vs segment-manifest —
+                  recording bytes shipped per re-solve (gate: delta
+                  ships <=10% of full-wire bytes at scale) with
+                  node-count and result-wire byte parity; then aggregate
+                  pods/sec serving N tenants at 1 vs 2 vs 4 sidecars
+                  through the client-side fleet router, affinity on vs
+                  off (scheduler-cache hit rate must stay hot under
+                  affinity). A tiny version runs under BENCH_FAST=1 so
+                  tier-1 smokes the manifest path and the router
   cfg9_verified   the verification trust anchor's cost: the primary
                   config runs with the ResultVerifier ON (the production
                   default — every config above already pays it), and this
@@ -1494,6 +1506,283 @@ def _relax_bench(n_pods=5000, repeats=3):
     return out
 
 
+def _delta_bench(
+    n_pods=2000,
+    n_nodes=600,
+    n_types=300,
+    churn=0.01,
+    rounds=5,
+    fleet_tenants=6,
+    fleet_rounds=3,
+    fleet_sizes=(1, 2, 4),
+):
+    """cfg13_delta: the delta wire + solver fleet (ISSUE 14).
+
+    Phase 1 (wire): an operator-shaped problem — existing nodes carrying
+    a topology context, a real catalog, a pending-pod batch sized at the
+    churn fraction — re-solved across `rounds` snapshots that each
+    replace ``churn`` of the nodes and mint a fresh pending batch.
+    Both wire forms are driven against their own daemon (transport-free,
+    so the bytes ARE the payloads): the full path re-encodes and ships
+    everything; the delta path ships a digest manifest plus exactly the
+    segments the far side has not seen (the client-side sent-set the
+    real SolverClient keeps). Records per-re-solve bytes and latency on
+    both paths, the delta/full byte ratio (acceptance: <= 0.10 at
+    scale), and node-count + result-wire parity per round (the manifest
+    path may never change a packing).
+
+    Phase 2 (fleet): N tenants with distinct catalogs (distinct problem
+    fingerprints — warm scheduler caches are the prize) hammer 1 / 2 / 4
+    in-thread sidecars through the client-side FleetRouter; at the
+    largest size, affinity on vs off. Records aggregate pods/sec and the
+    scheduler-cache hit rate per topology (affinity must keep re-solves
+    hitting the member whose caches are warm)."""
+    import copy
+    import threading
+
+    from karpenter_core_tpu.cloudprovider.kwok import bench_catalog
+    from karpenter_core_tpu.metrics import wiring as m
+    from karpenter_core_tpu.solver import codec, remote, segments, service
+
+    catalog = bench_catalog(n_types)
+    pools = [_pool()]
+    its = {"default": list(catalog)}
+    from karpenter_core_tpu.api import labels as L
+    from karpenter_core_tpu.controllers.provisioning.scheduling.inflight import (
+        SimNode,
+    )
+    from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+        Topology,
+    )
+
+    def make_node(name, i):
+        return SimNode(
+            name=name,
+            labels={
+                L.LABEL_ARCH: "amd64",
+                L.LABEL_OS: "linux",
+                L.LABEL_TOPOLOGY_ZONE: f"zone-{'abcd'[i % 4]}",
+                L.LABEL_HOSTNAME: name,
+                L.NODEPOOL_LABEL_KEY: "default",
+            },
+            taints=[],
+            available={"cpu": 2.0, "memory": 4 * GIB, "pods": 200.0},
+            capacity={"cpu": 8.0, "memory": 16 * GIB, "pods": 210.0},
+            initialized=True,
+        )
+
+    nodes = [make_node(f"node-{i:05d}", i) for i in range(n_nodes)]
+    # a topology context shaped like the provisioner's: a few bound pods
+    # per node ride the wire as (pod, labels, node) triples
+    ctx_pods = _plain_pods(2 * n_nodes, shapes=(4, 3))
+    existing_pods = [
+        (p, {"app": f"ctx-{i % 7}"}, nodes[i // 2].name)
+        for i, p in enumerate(ctx_pods)
+    ]
+    domains = {
+        L.LABEL_TOPOLOGY_ZONE: {f"zone-{z}" for z in "abcd"},
+        L.LABEL_HOSTNAME: {n.name for n in nodes},
+    }
+    batch = max(int(n_pods * churn), 4)
+
+    def snapshot(round_no):
+        """Round r's churned snapshot: `churn` of the nodes replaced,
+        a fresh pending batch (new pods ALWAYS ship — they are new)."""
+        ns = list(nodes)
+        k = max(int(n_nodes * churn), 1)
+        for j in range(k):
+            i = (round_no * 31 + j * 97) % n_nodes
+            ns[i] = make_node(f"node-r{round_no}-{i:05d}", i)
+        pending = _plain_pods(batch)
+        for p in pending:
+            p.metadata.name = f"r{round_no}-{p.metadata.name}"
+        topo = Topology(
+            domains={k_: set(v) for k_, v in domains.items()},
+            existing_pods=[
+                t for t in existing_pods
+                if any(n.name == t[2] for n in ns)
+            ],
+            excluded_pod_uids={p.uid for p in pending},
+        )
+        return ns, pending, topo
+
+    def result_view(out):
+        h = codec._json_header(out)
+        h.pop("solve_seconds", None)
+        return h
+
+    d_full = service.SolverDaemon()
+    d_delta = service.SolverDaemon()
+    # the client-side ledger (SolverClient.segcache shape): sent digests
+    # + the last confirmed listing, so steady-state manifests ship
+    # base+edits instead of the full digest listing
+    sent = set()
+    base = None
+    full_bytes, delta_bytes = [], []
+    full_times, delta_times = [], []
+    parity_ok = True
+    for r in range(rounds + 1):  # round 0 is the cold start
+        ns, pending, topo = snapshot(r)
+        header = codec._encode_solve_header(
+            pools, its, ns, [], pending, topology=topo, max_slots=1024,
+        )
+        # symmetric timing: each path's timer covers ITS encode (the
+        # container dump here, split+manifest-encode below) plus the
+        # daemon round — the p50 comparison must not hide the full
+        # wire's encode cost
+        t0 = time.perf_counter()
+        body_full = codec._json_payload(header)
+        out_full, _ = d_full.solve(body_full)
+        t_full = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        plan = segments.split_solve_header(header)
+        include = [dg for dg in plan.segments if dg not in sent]
+        body_delta = codec.encode_manifest_request(plan, include, base=base)
+        out_delta, _ = d_delta.solve(body_delta)
+        t_delta = time.perf_counter() - t0
+        sent |= set(plan.segments)
+        base = (plan.listing_digest, plan.listing)
+
+        parity_ok = parity_ok and (
+            result_view(out_full) == result_view(out_delta)
+        )
+        if r > 0:  # the cold round is the catalog upload, not the regime
+            full_bytes.append(len(body_full))
+            delta_bytes.append(len(body_delta))
+            full_times.append(t_full)
+            delta_times.append(t_delta)
+
+    ratio = (
+        sum(delta_bytes) / sum(full_bytes) if sum(full_bytes) else 1.0
+    )
+    nodes_full = len(codec._json_header(out_full)["claims"])
+    nodes_delta = len(codec._json_header(out_delta)["claims"])
+
+    wire = {
+        "nodes": n_nodes,
+        "ctx_pods": len(existing_pods),
+        "pending_per_round": batch,
+        "churn": churn,
+        "rounds": rounds,
+        "full_wire_bytes_per_resolve": int(
+            sum(full_bytes) / max(len(full_bytes), 1)
+        ),
+        "delta_wire_bytes_per_resolve": int(
+            sum(delta_bytes) / max(len(delta_bytes), 1)
+        ),
+        "delta_ratio": round(ratio, 4),
+        # the acceptance gate: a 1%-churn re-solve ships <=10% of the
+        # full wire (judged at the full-scale round; a BENCH_FAST run
+        # has too little stable snapshot for 10% and records the ratio)
+        "delta_ok": bool(ratio <= 0.10),
+        "p50_full_resolve_s": round(
+            sorted(full_times)[len(full_times) // 2], 4
+        ) if full_times else None,
+        "p50_delta_resolve_s": round(
+            sorted(delta_times)[len(delta_times) // 2], 4
+        ) if delta_times else None,
+        "parity_ok": bool(parity_ok),
+        "result_nodes_delta": nodes_delta - nodes_full,
+    }
+
+    # -- phase 2: 1 vs 2 vs 4 sidecars through the fleet router ------------
+
+    tenant_problems = []
+    for t in range(fleet_tenants):
+        tcat = bench_catalog(max(n_types // 2 + 7 * t, 20))
+        tenant_problems.append((
+            f"tenant{t}",
+            [_pool()],
+            {"default": list(tcat)},
+            _plain_pods(max(batch, 24)),
+        ))
+
+    def run_fleet(n_sidecars, affinity):
+        srvs = [service.serve(0) for _ in range(n_sidecars)]
+        try:
+            members = [
+                remote.SolverClient(
+                    f"127.0.0.1:{s.server_address[1]}",
+                    timeout=600, member=str(i),
+                )
+                for i, s in enumerate(srvs)
+            ]
+            router = remote.FleetRouter(members, affinity=affinity)
+            scheds = {
+                tenant: remote.RemoteScheduler(
+                    router, tpools, tits,
+                    device_scheduler_opts={"max_slots": 256},
+                    verify=not NO_VERIFY,
+                )
+                for tenant, tpools, tits, _ in tenant_problems
+            }
+            hits0 = m.SOLVERD_SCHED_CACHE.value({"outcome": "hit"})
+            miss0 = m.SOLVERD_SCHED_CACHE.value({"outcome": "miss"})
+            solved = [0]
+            lock = threading.Lock()
+
+            def hammer(tenant, tpods):
+                for _ in range(fleet_rounds):
+                    res = scheds[tenant].solve(copy.deepcopy(tpods))
+                    assert res.all_pods_scheduled()
+                    with lock:
+                        solved[0] += len(tpods)
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(
+                    target=hammer, args=(tenant, tpods), daemon=True
+                )
+                for tenant, _tp, _ti, tpods in tenant_problems
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            wall = time.perf_counter() - t0
+            hits = m.SOLVERD_SCHED_CACHE.value({"outcome": "hit"}) - hits0
+            misses = (
+                m.SOLVERD_SCHED_CACHE.value({"outcome": "miss"}) - miss0
+            )
+            return {
+                "sidecars": n_sidecars,
+                "affinity": affinity,
+                "aggregate_pods_per_sec": round(solved[0] / wall, 1),
+                "wall_s": round(wall, 3),
+                "sched_cache_hit_rate": round(
+                    hits / max(hits + misses, 1), 3
+                ),
+                "routed": router.snapshot()["routed"],
+            }
+        finally:
+            for s in srvs:
+                s.shutdown()
+                s.server_close()
+
+    fleet = {}
+    for k in fleet_sizes:
+        fleet[f"x{k}"] = run_fleet(k, affinity=True)
+    fleet["x%d_no_affinity" % fleet_sizes[-1]] = run_fleet(
+        fleet_sizes[-1], affinity=False
+    )
+    on = fleet[f"x{fleet_sizes[-1]}"]["sched_cache_hit_rate"]
+    off = fleet[
+        "x%d_no_affinity" % fleet_sizes[-1]
+    ]["sched_cache_hit_rate"]
+    return {
+        "wire": wire,
+        "fleet": fleet,
+        "tenants": fleet_tenants,
+        "rounds_per_tenant": fleet_rounds,
+        # affinity's whole point: re-solves keep hitting the member whose
+        # caches are warm, so the hit rate must not degrade vs no-affinity
+        "affinity_hit_rate": on,
+        "no_affinity_hit_rate": off,
+        "affinity_cache_ok": bool(on >= off),
+    }
+
+
 def _restart_probe() -> None:
     """Child mode: a FRESH process (persistent compile cache on disk warm
     from the parent's solves) boots a DeviceScheduler, pre-warms the shape
@@ -1569,7 +1858,7 @@ def main():
             "cfg1_5k400", "cfg2_masked", "cfg3_topology", "cfg4_consol",
             "cfg5_sidecar", "cfg6_ice_storm", "cfg7_fleet", "cfg8_multidev",
             "cfg9_verified", "cfg10_batch", "cfg11_gangs", "cfg12_relax",
-            "shape_churn", "restart",
+            "cfg13_delta", "shape_churn", "restart",
         )
         bogus = [
             o for o in only
@@ -1672,6 +1961,11 @@ def main():
             detail["cfg12_relax"] = _relax_bench(
                 n_pods=min(5000, max(N_PODS, 500))
             )
+        if sel("cfg13_delta"):
+            detail["cfg13_delta"] = _delta_bench(
+                n_pods=min(2000, max(N_PODS, 400)),
+                n_nodes=min(600, max(N_PODS // 3, 100)),
+            )
         if sel("restart"):
             detail["restart"] = _run_restart_probe()
     else:
@@ -1693,6 +1987,14 @@ def main():
         # on BOTH shapes (below it the topology host floor dominates the
         # capacity classes and the scored fallback correctly keeps FFD)
         detail["cfg12_relax"] = _relax_bench(n_pods=400, repeats=2)
+        # ... and a tiny cfg13 proves the delta wire (manifest path,
+        # result parity, the byte ratio schema) + the fleet router at
+        # 1-vs-2 sidecars; the 10% byte gate is judged at full scale
+        # (a tiny snapshot has too little stable problem half)
+        detail["cfg13_delta"] = _delta_bench(
+            n_pods=96, n_nodes=48, n_types=16, rounds=2,
+            fleet_tenants=3, fleet_rounds=2, fleet_sizes=(1, 2),
+        )
 
     pods_per_sec = primary["pods_per_sec"]
     budget_ok = primary["p50_solve_s"] <= 1.0

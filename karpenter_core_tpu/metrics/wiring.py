@@ -293,6 +293,45 @@ SOLVERD_SCHED_CACHE_BYTES = REGISTRY.gauge(
     " size proxy per entry, never exceeds the configured bound)",
 )
 
+# -- delta wire + fleet routing (solver/segments.py, solver/remote.py) -----
+
+SOLVERD_SEGSTORE_ENTRIES = REGISTRY.gauge(
+    "solverd_segment_store_entries",
+    "Content-addressed solve-request segments resident in the sidecar's"
+    " SegmentStore — the working set the delta wire elides from every"
+    " manifest request",
+)
+SOLVERD_SEGSTORE_BYTES = REGISTRY.gauge(
+    "solverd_segment_store_bytes",
+    "Bytes pinned by resident segments (canonical JSON bytes per segment,"
+    " never exceeds the configured bound)",
+)
+SOLVERD_SEGSTORE_EVICTIONS = REGISTRY.counter(
+    "solverd_segment_store_evictions_total",
+    "Segments dropped from the store, by reason (ttl|entries|bytes) —"
+    " sustained entries/bytes evictions mean the fleet's snapshot mix"
+    " outgrew the store budget (expect miss/re-upload rounds); ttl is"
+    " routine idle expiry",
+)
+SOLVER_SEGMENT_WIRE_BYTES = REGISTRY.counter(
+    "solver_segment_wire_bytes_total",
+    "Solve-request bytes shipped to the sidecar, by payload kind:"
+    " manifest = pure digest manifests (the steady-state delta wire),"
+    " segment = manifests carrying segment uploads (cold start or a"
+    " miss repair), full = whole-problem bodies (wire_mode=full or the"
+    " manifest fallback) — the delta wire's headline ratio is"
+    " (manifest+segment) vs full for the same traffic",
+)
+SOLVER_FLEET_ROUTED = REGISTRY.counter(
+    "solver_fleet_routed_total",
+    "Solve RPCs placed by the client-side fleet router, by reason:"
+    " affinity = the rendezvous pick for the manifest's catalog digest"
+    " (warm prepared-state caches keep hitting), spill = least-loaded"
+    " placement (an answered refusal — shed/drain/quarantine — re-routed,"
+    " or affinity disabled), degraded = the affinity pick's breaker was"
+    " open so the next-best healthy member served",
+)
+
 # -- continuous cross-tenant solve batching (solver/fleet.py coalescer) ----
 
 SOLVERD_BATCH_SIZE = REGISTRY.histogram(

@@ -144,6 +144,17 @@ class Options:
     # an external --solver-addr sidecar configures its own.
     solver_max_batch: int = DEFAULT_MAX_BATCH
     solver_batch_window_ms: float = DEFAULT_BATCH_WINDOW_MS
+    # horizontally scaled solver tier (segmentstore + fleet routing,
+    # ISSUE 14): spawn N supervised solverds on distinct ports and route
+    # client-side with digest affinity + spill-over (solver/remote.
+    # FleetRouter). 1 = the classic single sidecar. An external
+    # --solver-addr may name a comma-separated member list instead.
+    solver_fleet: int = 1
+    # solve-request wire form: delta = content-addressed segment
+    # manifests with miss repair and full-wire fallback (unchanged
+    # catalogs never re-upload); full = every request ships the whole
+    # problem (the pre-v5 behavior, and the escape hatch)
+    solver_wire: str = "delta"  # delta | full
     batch_max_duration: float = 10.0
     batch_idle_duration: float = 1.0
     log_level: str = "info"
@@ -205,6 +216,12 @@ class Options:
             "--solver-batch-window-ms",
             "KARPENTER_SOLVER_BATCH_WINDOW_MS",
             float,
+        ),
+        "solver_fleet": (
+            "--solver-fleet", "KARPENTER_SOLVER_FLEET", int,
+        ),
+        "solver_wire": (
+            "--solver-wire", "KARPENTER_SOLVER_WIRE", str,
         ),
         "batch_max_duration": (
             "--batch-max-duration", "KARPENTER_BATCH_MAX_DURATION", float,
@@ -292,6 +309,26 @@ class Options:
                 "--solver-batch-window-ms must be >= 0 (0 = never wait),"
                 f" got {opts.solver_batch_window_ms}"
             )
+        if opts.solver_fleet < 1:
+            raise ValueError(
+                "--solver-fleet must be >= 1 (1 = single sidecar),"
+                f" got {opts.solver_fleet}"
+            )
+        if opts.solver_fleet > 1 and opts.solver_addr:
+            # the fleet size only governs SPAWNED children; an external
+            # address wins and would silently ignore the flag — a user
+            # who believes they have a 4-member fleet must hear otherwise
+            raise ValueError(
+                "--solver-fleet > 1 spawns supervised sidecars and"
+                " cannot combine with --solver-addr; for an external"
+                " fleet pass a comma-separated member list as"
+                " --solver-addr instead"
+            )
+        if opts.solver_wire not in ("delta", "full"):
+            raise ValueError(
+                f"unknown solver wire mode {opts.solver_wire!r}"
+                " (delta | full)"
+            )
         # malformed weights must fail at the flag surface, not inside a
         # respawned sidecar's argparse three failures deep
         from karpenter_core_tpu.solver.fleet import parse_tenant_weights
@@ -368,16 +405,25 @@ class Operator:
         self.solver_supervisor = None
         self.solver_client = None
         if self.options.solver == "tpu" and self.options.solver_mode == "sidecar":
-            from karpenter_core_tpu.solver.remote import SolverClient
+            from karpenter_core_tpu.solver.remote import (
+                FleetRouter,
+                SolverClient,
+            )
 
-            addr = self.options.solver_addr
-            if not addr:
+            # --solver-addr may name an external fleet as a comma-
+            # separated member list; empty spawns supervised children
+            addrs = [
+                a.strip()
+                for a in self.options.solver_addr.split(",")
+                if a.strip()
+            ]
+            if not addrs:
                 from karpenter_core_tpu.solver.supervisor import (
+                    FleetSupervisor,
                     SolverSupervisor,
                 )
 
-                self.solver_supervisor = SolverSupervisor(
-                    on_event=self._publish_sidecar_event,
+                child_kwargs = dict(
                     # the spawned sidecar arms jax.profiler capture lazily
                     # (POST /profile), so pass the operator's profile dir
                     # through: TPU-side traces become grabbable from the
@@ -414,14 +460,44 @@ class Operator:
                         else None
                     ),
                 )
-                addr = self.solver_supervisor.start()
-            self.solver_client = SolverClient(
-                addr,
-                timeout=self.options.solver_timeout,
-                on_state_change=self._publish_circuit_event,
-                # this operator's identity at a (possibly shared) sidecar
-                tenant=self.options.solver_tenant,
-            )
+                if self.options.solver_fleet > 1:
+                    # N children on distinct ports; the router below does
+                    # digest-affinity placement across them (ISSUE 14)
+                    self.solver_supervisor = FleetSupervisor(
+                        self.options.solver_fleet,
+                        on_event=self._publish_sidecar_event,
+                        **child_kwargs,
+                    )
+                    addrs = self.solver_supervisor.start()
+                else:
+                    self.solver_supervisor = SolverSupervisor(
+                        on_event=self._publish_sidecar_event,
+                        **child_kwargs,
+                    )
+                    addrs = [self.solver_supervisor.start()]
+
+            def _make_client(i: int, a: str) -> "SolverClient":
+                return SolverClient(
+                    a,
+                    timeout=self.options.solver_timeout,
+                    on_state_change=self._publish_circuit_event,
+                    # this operator's identity at a (possibly shared)
+                    # sidecar
+                    tenant=self.options.solver_tenant,
+                    # delta vs full solve-request wire (ISSUE 14)
+                    wire_mode=self.options.solver_wire,
+                    member=str(i) if len(addrs) > 1 else "",
+                )
+
+            if len(addrs) > 1:
+                # the router shares ONE client-side poison quarantine
+                # across members and per-member breakers/sent-caches
+                self.solver_client = FleetRouter(
+                    [_make_client(i, a) for i, a in enumerate(addrs)],
+                    tenant=self.options.solver_tenant,
+                )
+            else:
+                self.solver_client = _make_client(0, addrs[0])
         # in-proc TPU solves follow --solver-devices (sidecar mode leaves
         # the device choice to the child, which owns the chips); an
         # explicit device_scheduler_opts["devices"] wins over the flag
@@ -672,10 +748,19 @@ class Operator:
         self._pass_id += 1
         self._pass_seen = set()
         if self.solver_supervisor is not None:
-            # supervise the sidecar every pass; after a respawn the client
-            # follows the (possibly fresh) address — no operator restart
-            if self.solver_supervisor.poll() and self.solver_client is not None:
-                self.solver_client.set_addr(self.solver_supervisor.addr)
+            # supervise the sidecar(s) every pass; after a respawn the
+            # client follows the (possibly fresh) address — no operator
+            # restart. A FleetSupervisor reports WHICH members respawned
+            # so the router re-points exactly those.
+            restarted = self.solver_supervisor.poll()
+            if self.solver_client is not None:
+                if isinstance(restarted, list):
+                    for i in restarted:
+                        self.solver_client.set_member_addr(
+                            i, self.solver_supervisor.addrs[i]
+                        )
+                elif restarted:
+                    self.solver_client.set_addr(self.solver_supervisor.addr)
         for pool in list(self.kube.list_nodepools()):
             self._guarded("nodepool.hash", self.nodepool_hash.reconcile, pool)
             self._guarded(

@@ -163,7 +163,15 @@ def decode_response(data: bytes) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
 # when absent. Load-bearing the same way the ICE mask was: an old sidecar
 # silently dropping it would serve the heuristic packer to a client that
 # asked for (and will be judged on) the optimizing one.
-SOLVE_WIRE_VERSION = 4
+# v5: the delta wire (segmentstore, ISSUE 14) — a solve request may now be
+# a MANIFEST of content-addressed segment digests (solver/segments.py)
+# instead of the full problem; the sidecar answers a typed miss for
+# digests its store lost, and problem_fingerprint becomes derivable from
+# the manifest's problem-half digests (both request forms compute the
+# SAME fingerprint, so the scheduler cache never splits on wire form).
+# The full-wire form stays first-class at v5 — it is the fallback when a
+# sidecar cannot resolve a manifest even after the re-upload round.
+SOLVE_WIRE_VERSION = 5
 
 # the solver backends a request may select; "" means unspecified (the
 # serving daemon's default applies)
@@ -447,6 +455,13 @@ def _decode_topology(d: Optional[dict]):
     )
 
 
+# graftlint: disable=GL401 -- encode_solve_request delegates its whole
+# header to _encode_solve_header (whose field set GL401 checks against
+# _decode_solve_header directly, including "version"); "kind" and
+# "wire_kind" are decode_solve_request's FORM-dispatch surface shared
+# with encode_manifest_request — the one-level twin pairing cannot see
+# either relationship, and the twins it cannot pair are each locked by
+# GL403 at SOLVE_WIRE_VERSION
 def encode_solve_request(
     nodepools,
     instance_types: Dict[str, list],
@@ -473,6 +488,37 @@ def encode_solve_request(
     path) or "relax" (convex-relaxation optimizer with the FFD result as
     the scored/anytime fallback); it also rides the X-Solver-Mode header
     so the gateway can route pre-decode."""
+    return _json_payload(_encode_solve_header(
+        nodepools,
+        instance_types,
+        existing_nodes,
+        daemonset_pods,
+        pods,
+        topology=topology,
+        max_slots=max_slots,
+        unavailable_offerings=unavailable_offerings,
+        tenant=tenant,
+        solver_mode=solver_mode,
+    ))
+
+
+def _encode_solve_header(
+    nodepools,
+    instance_types: Dict[str, list],
+    existing_nodes,
+    daemonset_pods,
+    pods,
+    topology=None,
+    max_slots: int = 256,
+    unavailable_offerings=(),
+    tenant: str = "default",
+    solver_mode: str = "ffd",
+) -> dict:
+    """The full solve header as a dict — encode_solve_request's payload
+    before the npz container, shared by the full wire (v1..v5 shape) and
+    the delta wire (solver/segments.py splits this exact dict into
+    content-addressed segments, so the manifest path is wire-equivalent
+    by construction)."""
     if solver_mode not in SOLVER_MODES:
         raise ValueError(f"unknown solver mode {solver_mode!r}")
     from karpenter_core_tpu.kube import serial
@@ -512,7 +558,7 @@ def encode_solve_request(
         "tenant": tenant,
         "solver_mode": solver_mode,
     }
-    return _json_payload(header)
+    return header
 
 
 def problem_fingerprint(header: dict) -> str:
@@ -521,41 +567,25 @@ def problem_fingerprint(header: dict) -> str:
     pods, topology context, limits, ICE snapshot). Two requests with equal
     fingerprints describe the same cluster, so the sidecar can reuse one
     DeviceScheduler — and with it the prepared-state caches — across RPC
-    calls, re-solving only the pod mix. Computed over the decoded JSON
-    header (wire-canonical), not the npz bytes, so compression framing
-    never perturbs it."""
-    import hashlib
+    calls, re-solving only the pod mix.
 
-    # graftlint: disable=GL201 -- json.dumps(sort_keys=True) below
-    # canonicalizes every dict key recursively; build order never reaches
-    # the hash (only LIST order would, and no list is built here)
-    #
-    # the tenant is routing metadata, not problem content: two operators
-    # watching identical clusters (an HA pair, a blue/green pair) describe
-    # the same problem and may share one cached DeviceScheduler — the
-    # cache is content-addressed, isolation is the gateway's job
-    # solver_mode is excluded like the tenant, for a different reason:
-    # the EFFECTIVE mode is resolved header > wire > daemon-default at
-    # the serving daemon, which appends the resolved mode to the
-    # fingerprint itself — hashing the raw field here would split a
-    # mode-less request and an explicit-default one into two cached
-    # schedulers for the identical problem + mode
-    probe = {
-        k: v
-        for k, v in header.items()
-        if k not in ("pods", "tenant", "solver_mode")
-    }
-    # the topology context's excluded-uid list is derived from the PENDING
-    # pods (provisioner excludes them from existing counts), so it belongs
-    # to the pod half: hashing it would churn the scheduler cache on every
-    # reconcile. The solve side re-reads the request's live context on
-    # every cache hit (SolverDaemon.solve -> update_topology_context), so
-    # dropping it here never serves stale exclusions.
-    if probe.get("topology"):
-        probe["topology"] = {**probe["topology"], "excluded": []}
-    return hashlib.sha256(
-        json.dumps(probe, sort_keys=True).encode()
-    ).hexdigest()
+    v5: derived from the manifest's problem-half SEGMENT DIGESTS
+    (solver/segments.py splits the header canonically and hashes the
+    sorted (kind, digest) pairs), so a manifest request computes the
+    identical fingerprint from its digest listing alone — the PR 3
+    prepared-state cache and the PR 5 scheduler cache key off digests and
+    hit across restarts of either side and across wire forms.
+
+    The exclusions carry over from v4 unchanged: the tenant is routing
+    metadata, not problem content (the cache is content-addressed,
+    isolation is the gateway's job); solver_mode is excluded because the
+    serving daemon appends the RESOLVED mode itself; and the topology
+    context's excluded-uid list is derived from the PENDING pods, so
+    hashing it would churn the scheduler cache on every reconcile (the
+    solve side re-reads the live context on every cache hit)."""
+    from karpenter_core_tpu.solver import segments
+
+    return segments.fingerprint_of_header(header)
 
 
 # decode-net clamp for the wire's slot ceiling: max_slots sizes every
@@ -656,19 +686,338 @@ def problem_bucket(header: dict) -> str:
     return hashlib.sha256(repr(parts).encode()).hexdigest()[:16]
 
 
-def decode_solve_request(data: bytes) -> dict:
+def encode_manifest_request(plan, include=None, base=None) -> bytes:
+    """Serialize a delta-wire solve request from a SegmentPlan
+    (solver/segments.split_solve_header): the digest listing + inline
+    remainder + pod-layout arrays, plus the segment BODIES named by
+    ``include`` (None ships everything — the cold-start / full-repair
+    form; an empty list ships a pure manifest). Same npz container as
+    every other payload; the uploads ride as ``seg_<digest>`` byte
+    arrays so one request carries the whole miss repair.
+
+    ``base`` = (previous listing digest, previous rows): the steady-state
+    form — instead of the full digest listing (hundreds of rows, hex is
+    incompressible), ship ``listing_base`` + the row EDITS against it.
+    The daemon holds recent listings content-addressed in its segment
+    store; a lost base is a typed miss like any segment, answered by
+    resending the full listing."""
+    # uploads pack into ONE byte blob (indexed by digest+length in the
+    # header): deflate then compresses ACROSS segments — changed node
+    # buckets share most of their structure, and per-entry zip overhead
+    # would otherwise dominate small repairs
+    blobs: List[bytes] = []
+    index: List[List] = []
+    for dg in (plan.all_digests() if include is None else include):
+        data = plan.segments.get(dg)
+        if data is not None:
+            blobs.append(data)
+            index.append([dg, len(data)])
+    if base is not None and base[0] != plan.listing_digest:
+        prev_set = {tuple(r) for r in base[1]}
+        cur_set = {tuple(r) for r in plan.listing}
+        header = {
+            "version": SOLVE_WIRE_VERSION,
+            "kind": "manifest",
+            "listing_base": base[0],
+            "segments_add": sorted(
+                [list(r) for r in cur_set - prev_set]
+            ),
+            "segments_drop": sorted(
+                [list(r) for r in prev_set - cur_set]
+            ),
+            # integrity pin: the daemon verifies its reconstruction
+            # hashes to the listing the pod layout was computed over
+            "listing_digest": plan.listing_digest,
+            "upload_index": index,
+            "inline": plan.inline,
+        }
+    elif base is not None:
+        # unchanged problem half AND pod batches: the smallest wire form
+        header = {
+            "version": SOLVE_WIRE_VERSION,
+            "kind": "manifest",
+            "listing_base": base[0],
+            "segments_add": [],
+            "segments_drop": [],
+            "listing_digest": plan.listing_digest,
+            "upload_index": index,
+            "inline": plan.inline,
+        }
+    else:
+        header = {
+            "version": SOLVE_WIRE_VERSION,
+            "kind": "manifest",
+            "segments": plan.listing,
+            "upload_index": index,
+            "inline": plan.inline,
+        }
+    arrays: Dict[str, np.ndarray] = {
+        _HEADER_KEY: np.frombuffer(
+            json.dumps(header).encode(), dtype=np.uint8
+        ),
+        "pod_batch": np.asarray(plan.pod_batch, dtype=np.int32),
+        "pod_member": np.asarray(plan.pod_member, dtype=np.int32),
+        "uploads": np.frombuffer(b"".join(blobs), dtype=np.uint8),
+    }
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    return buf.getvalue()
+
+
+def _encode_manifest_inline(header: dict) -> dict:
+    """The manifest's non-content-addressed remainder: pod-half scalars
+    and presence flags. Everything here either changes per solve (tenant
+    routing, the pod-derived topology exclusions) or is too small to be
+    worth a digest round trip (the ICE snapshot, the slot ceiling). The
+    field set is frozen in the GL403 wire lock like every encoder's."""
+    topo = header.get("topology")
+    # .get with the decoders' back-compat defaults: a header a foreign or
+    # older client built without the optional fields must still split
+    # (and fingerprint) — absent folds to the same value as an explicit
+    # default, exactly as decode_solve_request resolves it
+    return {
+        "max_slots": header.get("max_slots", 256),
+        "tenant": header.get("tenant", "default"),
+        "solver_mode": header.get("solver_mode", ""),
+        "unavailable_offerings": header.get("unavailable_offerings", []),
+        "has_topology": topo is not None,
+        "topo_excluded": None if topo is None else topo.get("excluded"),
+    }
+
+
+def decode_solve_request(data: bytes, segment_store=None) -> dict:
     """Inverse of encode_solve_request; returns a kwargs-style dict (plus
     ``fingerprint``, the problem-half content hash for scheduler reuse,
-    and ``bucket``, the coalescing shape-bucket key)."""
-    from karpenter_core_tpu.kube import serial
-
+    ``bucket``, the coalescing shape-bucket key, and ``wire_kind`` —
+    ``full`` | ``manifest``). A v5 manifest body resolves through
+    ``segment_store`` (solver/segments.py); a store miss raises
+    segments.SegmentMissError naming the digests, which the HTTP layer
+    turns into the typed 409 answer — never a wrong solve."""
     h = _json_header(data)
     if h["version"] != SOLVE_WIRE_VERSION:
         raise ValueError(f"unsupported solve wire version {h['version']}")
+    if h.get("kind") == "manifest":
+        return decode_manifest_request(data, segment_store, header=h)
+    out = _decode_solve_header(h)
+    out["wire_kind"] = "full"
+    # the scheduler cache's entry-weight proxy: for the full wire the
+    # body IS the problem's byte scale
+    out["approx_bytes"] = len(data)
+    return out
+
+
+def decode_manifest_request(
+    data: bytes, segment_store=None, header: dict = None
+) -> dict:
+    """Inverse of encode_manifest_request: store any segment uploads
+    riding the body (content-verified — an upload that does not hash to
+    its claimed digest is corrupt wire, so a hostile tenant can never
+    poison another tenant's manifest through the shared store), assemble
+    the full header from the store, and decode it exactly like the full
+    wire. The fingerprint is computed from the manifest's digest listing
+    alone — the derivability the scheduler caches key on."""
+    from karpenter_core_tpu.solver import segments
+
+    h = header if header is not None else _json_header(data)
+    if h.get("version") != SOLVE_WIRE_VERSION:
+        raise ValueError(f"unsupported solve wire version {h.get('version')}")
+    if h.get("kind") != "manifest":
+        raise ValueError(f"not a manifest request: kind={h.get('kind')!r}")
+    if segment_store is None:
+        raise ValueError(
+            "manifest solve request but no segment store is configured"
+        )
+    inline = _decode_manifest_inline(h.get("inline"))
+    z = _load_npz(data)
+    index = h.get("upload_index", [])
+    if not isinstance(index, list):
+        raise ValueError(f"malformed upload index: {index!r}")
+    if index:
+        from karpenter_core_tpu.solver.segments import digest_of
+
+        blob = z["uploads"].tobytes()
+        offset = 0
+        for row in index:
+            if (
+                not isinstance(row, list) or len(row) != 2
+                or not isinstance(row[0], str)
+                or not isinstance(row[1], int) or row[1] < 0
+            ):
+                raise ValueError(f"malformed upload index row: {row!r}")
+            dg, length = row
+            piece = blob[offset:offset + length]
+            offset += length
+            if len(piece) != length or digest_of(piece) != dg:
+                # content addressing is verified at the door: a hostile
+                # or torn upload can never poison another tenant's
+                # manifest through the shared store
+                raise ValueError(
+                    f"segment upload {dg[:12]} does not hash to its"
+                    " claimed digest"
+                )
+            segment_store.put(dg, piece)
+        if offset != len(blob):
+            raise ValueError("upload blob length disagrees with its index")
+    listing = _resolve_listing(
+        h.get("segments"), h.get("listing_base"), h.get("segments_add"),
+        h.get("segments_drop"), h.get("listing_digest"), segment_store,
+    )
+    segments.check_manifest_parts(listing, inline)
+    if "pod_batch" not in z.files or "pod_member" not in z.files:
+        raise ValueError("manifest body lost its pod layout arrays")
+    # track the PROBLEM's real byte scale while assembling: a steady-state
+    # manifest body is a few hundred bytes, so the scheduler cache's
+    # byte-bound weight proxy must come from the resolved segments, not
+    # from len(body) — or N delta-wire tenants would pin N full
+    # schedulers the --cache-mib bound accounts as ~0
+    fetched = [0]
+
+    def fetch(dg):
+        blob = segment_store.get(dg)
+        if blob is not None:
+            fetched[0] += len(blob)
+        return blob
+
+    assembled = segments.assemble_solve_header(
+        listing, inline, z["pod_batch"], z["pod_member"], fetch,
+    )
+    # remember THIS listing content-addressed: the client's next manifest
+    # names it as ``listing_base`` and ships only the row edits
+    segment_store.put(
+        segments.listing_digest_of(listing),
+        segments.listing_bytes(listing),
+    )
+    return {
+        # derivability is the point: the fingerprint comes from the
+        # digest listing without re-canonicalizing the assembled content
+        # (it equals the full-wire fingerprint of the same problem by
+        # construction)
+        **_decode_solve_header(
+            assembled,
+            fingerprint=segments.fingerprint_of_parts(listing, inline),
+        ),
+        "wire_kind": "manifest",
+        "approx_bytes": fetched[0],
+    }
+
+
+def _resolve_listing(
+    explicit, base, add, drop, want, segment_store
+) -> list:
+    """The manifest's digest listing: ``explicit`` (the full ``segments``
+    rows) or reconstructed from ``listing_base`` + row edits against a
+    listing the store holds from an earlier solve. A missing or DRIFTED
+    base (the reconstruction's digest must match ``want`` — the listing
+    the client computed its pod layout over) raises SegmentMissError for
+    the base digest — the client answers by resending the full listing,
+    so staleness self-heals in one round instead of mis-indexing a pod
+    batch."""
+    import json as _json
+
+    from karpenter_core_tpu.solver import segments
+
+    if explicit is not None:
+        segments.check_manifest_parts(explicit, {})
+        return segments.sort_listing(explicit)
+    if not isinstance(base, str) or not base:
+        raise ValueError("manifest names neither segments nor a base")
+    raw = segment_store.get(base)
+    if raw is None:
+        raise segments.SegmentMissError([base])
+    try:
+        rows = _json.loads(raw.decode())
+    except (UnicodeDecodeError, ValueError) as e:
+        raise ValueError(f"stored base listing is malformed: {e}") from e
+    for edits in (add, drop):
+        if not isinstance(edits, list) or not all(
+            isinstance(r, list) and len(r) == 2
+            and all(isinstance(x, str) for x in r)
+            for r in edits
+        ):
+            raise ValueError(f"malformed listing edits: {edits!r}")
+    merged = (
+        {tuple(r) for r in rows} - {tuple(r) for r in drop}
+    ) | {tuple(r) for r in add}
+    listing = segments.sort_listing(merged)
+    if want and segments.listing_digest_of(listing) != want:
+        # drift (evicted-and-readded base collision, corrupt edit set):
+        # a typed miss, never a silently mis-assembled problem
+        raise segments.SegmentMissError([base])
+    return listing
+
+
+def _decode_manifest_inline(inline) -> dict:
+    """Twin of _encode_manifest_inline: shape-check and normalize the
+    manifest's non-addressed remainder at the decode net (absent keys
+    fold to the encoders' back-compat defaults, like the full wire's)."""
+    if not isinstance(inline, dict):
+        raise ValueError(f"manifest inline is not a dict: {inline!r}")
+    return {
+        "max_slots": inline.get("max_slots", 256),
+        "tenant": inline.get("tenant", "default"),
+        "solver_mode": inline.get("solver_mode", ""),
+        "unavailable_offerings": inline.get("unavailable_offerings", []),
+        "has_topology": bool(inline.get("has_topology")),
+        "topo_excluded": inline.get("topo_excluded"),
+    }
+
+
+def request_digest(data: bytes, segment_store=None) -> str:
+    """Quarantine/poison key of a request body, stable per logical
+    problem across wire forms: full-wire bodies hash their (canonical,
+    PR 4) bytes; manifest bodies hash their CORE — digest listing +
+    inline + pod layout — so the same problem keys identically whether
+    or not segment uploads ride along (the miss/re-upload handshake must
+    not split one poison problem into several strike streaks). A
+    base+edits manifest reconstructs its listing through
+    ``segment_store`` first. Any parse failure (or an unresolvable base)
+    degrades to the raw-bytes hash, never a raise — this runs PRE-decode
+    as the cheap refusal gate."""
+    import hashlib
+
+    from karpenter_core_tpu.solver import segments
+
+    try:
+        z = _load_npz(data)
+        if "pod_batch" not in z.files:
+            return hashlib.sha256(data).hexdigest()
+        h = json.loads(bytes(z[_HEADER_KEY]).decode())
+        if h.get("kind") != "manifest":
+            return hashlib.sha256(data).hexdigest()
+        if h.get("segments") is None and segment_store is None:
+            return hashlib.sha256(data).hexdigest()
+        listing = _resolve_listing(
+            h.get("segments"), h.get("listing_base"),
+            h.get("segments_add"), h.get("segments_drop"),
+            h.get("listing_digest"), segment_store,
+        )
+        segments.check_manifest_parts(listing, h.get("inline"))
+        return segments.core_digest_of(
+            listing, h.get("inline"),
+            z["pod_batch"], z["pod_member"],
+        )
+    except (
+        ValueError, KeyError, TypeError, UnicodeDecodeError,
+        segments.SegmentMissError,
+    ):
+        return hashlib.sha256(data).hexdigest()
+
+
+def _decode_solve_header(h: dict, fingerprint: str = None) -> dict:
+    """Twin of _encode_solve_header: the full-shape header dict (native
+    or assembled from a manifest) to the kwargs-style problem dict. The
+    version re-check is deliberate — assembled headers pass through here
+    too, and a version skew must never surface as a shape mismatch.
+    ``fingerprint`` lets the manifest path hand in its digest-derived
+    value instead of re-canonicalizing the whole assembled header."""
+    from karpenter_core_tpu.kube import serial
+
     from karpenter_core_tpu.cloudprovider.types import OfferingKey
 
+    if h.get("version") != SOLVE_WIRE_VERSION:
+        raise ValueError(f"unsupported solve wire version {h.get('version')}")
     return {
-        "fingerprint": problem_fingerprint(h),
+        "fingerprint": fingerprint or problem_fingerprint(h),
         "bucket": problem_bucket(h),
         "nodepools": [serial.decode(d) for d in h["nodepools"]],
         "instance_types": _decode_it_table(h["it_table"], h["it_pools"]),

@@ -776,9 +776,11 @@ QUARANTINE_CAP = 1024
 
 
 class PoisonQuarantine:
-    """TTL'd poison-pill ledger over problem fingerprints (sha256 of the
-    canonical request body — PR 4 made wire bytes canonical per logical
-    problem, so the digest is stable across retries of the same problem).
+    """TTL'd poison-pill ledger over request digests (codec.request_digest:
+    sha256 of the canonical body for full-wire requests — PR 4 made wire
+    bytes canonical per logical problem — and the manifest CORE for
+    delta-wire requests, so the digest stays stable across retries AND
+    across the miss/re-upload handshake's changing upload payloads).
 
     A problem that crashes, hangs, corrupts its result, or fails
     verification ``strikes`` times inside the TTL window is quarantined:

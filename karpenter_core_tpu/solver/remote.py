@@ -36,6 +36,7 @@ from __future__ import annotations
 import hashlib
 import http.client
 import socket
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -49,7 +50,9 @@ _STATE_NAMES = {0: "closed", 1: "half-open", 2: "open"}
 
 # causes where the sidecar ANSWERED — alive and regulating/restarting/
 # refusing — so the breaker is never charged and retries are pointless
-_ANSWERED_CAUSES = ("shed", "drain", "poisoned")
+# (segment_miss is the delta wire's typed miss: the sidecar is alive and
+# asking for bytes, the caller re-uploads — PR 5's shed contract, ISSUE 14)
+_ANSWERED_CAUSES = ("shed", "drain", "poisoned", "segment_miss")
 
 
 class RemoteSolverError(Exception):
@@ -61,13 +64,19 @@ class RemoteSolverError(Exception):
     ):
         super().__init__(message or cause)
         # timeout | error | circuit_open | injected | shed | drain |
-        # poisoned | corrupt (a result wire whose FIELDS decoded but whose
-        # content is malformed — raised by RemoteScheduler._materialize)
+        # poisoned | segment_miss | corrupt (a result wire whose FIELDS
+        # decoded but whose content is malformed — raised by
+        # RemoteScheduler._materialize)
         self.cause = cause
         # server-estimated seconds until a retry would be admitted (429
         # sheds only); honored by call()'s backoff in place of the fixed
         # exponential schedule
         self.retry_after = retry_after
+        # segment_miss payload: the digests the sidecar's store cannot
+        # produce, and the answering daemon's instance id (what the
+        # client's sent-cache keys on)
+        self.need: List[str] = []
+        self.instance: str = ""
 
 
 class FaultInjector:
@@ -99,12 +108,17 @@ class CircuitBreaker:
         time_fn=time.monotonic,
         on_state_change=None,
         tenant: str = "default",
+        member: str = "",
     ):
         self.failure_threshold = failure_threshold
         self.cooldown = cooldown
         self.time_fn = time_fn
         self.on_state_change = on_state_change
         self.tenant = tenant
+        # fleet-member identity ("" outside fleet mode): per-member
+        # breakers are what let the router keep serving from healthy
+        # members while ONE member is dark
+        self.member = member
         self.state = STATE_CLOSED
         self.failures = 0
         self.opened_at = 0.0
@@ -114,10 +128,13 @@ class CircuitBreaker:
         from karpenter_core_tpu.metrics import wiring as m
 
         # tenant-labeled: each operator in the fleet owns its own breaker
-        # series, so "tenant-b is on greedy" is one dashboard cell
-        m.SOLVER_CIRCUIT_STATE.set(
-            float(self.state), {"tenant": self.tenant}
-        )
+        # series, so "tenant-b is on greedy" is one dashboard cell; in
+        # fleet mode the member index joins the labels so "member 2 of
+        # tenant-b's fleet is dark" is one cell too
+        labels = {"tenant": self.tenant}
+        if self.member:
+            labels["member"] = self.member
+        m.SOLVER_CIRCUIT_STATE.set(float(self.state), labels)
 
     def _transition(self, state: int) -> None:
         if state == self.state:
@@ -136,6 +153,15 @@ class CircuitBreaker:
                 return True
             return False
         return True
+
+    def probeable(self) -> bool:
+        """Read-only allow(): would a call be admitted now? The fleet
+        router ranks members with this — allow() itself transitions
+        open -> half-open, and ranking must not consume the probe slot."""
+        return (
+            self.state != STATE_OPEN
+            or self.time_fn() - self.opened_at >= self.cooldown
+        )
 
     def record_success(self) -> None:
         self.failures = 0
@@ -170,6 +196,8 @@ class SolverClient:
         on_state_change=None,
         tenant: str = "default",
         quarantine=None,
+        wire_mode: str = "delta",
+        member: str = "",
     ):
         host, _, port = addr.rpartition(":")
         self.host = host or "127.0.0.1"
@@ -178,13 +206,29 @@ class SolverClient:
         self.max_retries = max_retries
         self.backoff = backoff
         self.tenant = tenant
+        # delta = manifest-of-digests solve requests with miss repair and
+        # full-wire fallback (ISSUE 14); full = every request ships the
+        # whole problem (the v4-and-earlier behavior, and the escape
+        # hatch when the far side predates the segment store)
+        if wire_mode not in ("delta", "full"):
+            raise ValueError(f"unknown wire mode {wire_mode!r}")
+        self.wire_mode = wire_mode
+        self.member = member
         self.breaker = breaker or CircuitBreaker(
-            on_state_change=on_state_change, tenant=tenant
+            on_state_change=on_state_change, tenant=tenant, member=member
         )
         if on_state_change is not None and breaker is not None:
             breaker.on_state_change = on_state_change
         self.fault_injector = fault_injector
         self.sleep = sleep
+        # delta-wire sent-cache: which segment digests the CURRENT far
+        # instance has confirmed (solver/segments.SentCache) — rebound
+        # whenever the X-Solverd-Instance response header changes, so a
+        # respawned sidecar costs one re-upload round, not a stale elision
+        from karpenter_core_tpu.solver.segments import SentCache
+
+        self.segcache = SentCache()
+        self._seen_instance = ""
         # client-side poison quarantine, keyed on the request-body digest:
         # lives HERE (not on the per-solve RemoteScheduler) because the
         # strike streak must survive across solves, like the breaker. A
@@ -249,6 +293,34 @@ class SolverClient:
             )
             resp = conn.getresponse()
             data = resp.read()
+            # the daemon's boot identity rides every answer; the delta
+            # path keys its sent-cache on it (a changed id = a respawn =
+            # the far store is empty)
+            inst = resp.getheader("X-Solverd-Instance")
+            if inst:
+                self._seen_instance = inst
+            if resp.status == 409:
+                # delta-wire typed miss: the sidecar cannot assemble the
+                # manifest and names exactly the digests it needs — an
+                # ANSWER, not a fault (solve_delta re-uploads once)
+                import json as _json
+
+                try:
+                    miss = _json.loads(data.decode())
+                    need = [
+                        d for d in miss.get("need", [])
+                        if isinstance(d, str)
+                    ]
+                    instance = str(miss.get("instance", "") or "")
+                except (ValueError, UnicodeDecodeError, AttributeError):
+                    need, instance = [], ""
+                e = RemoteSolverError(
+                    "segment_miss",
+                    f"sidecar {path} missing {len(need)} segment(s)",
+                )
+                e.need = need
+                e.instance = instance
+                raise e
             if resp.status == 429:
                 # admission shed: the gateway answered with its estimate
                 # of when a retry would be admitted
@@ -287,9 +359,12 @@ class SolverClient:
         finally:
             conn.close()
 
-    def call(self, path: str, body: bytes, headers: dict = None):
+    def call(self, path: str, body: bytes, headers: dict = None,
+             routing_key: str = None):
         """(response bytes, sidecar-reported kernel seconds), or raises
-        RemoteSolverError after the retry budget / on an open circuit."""
+        RemoteSolverError after the retry budget / on an open circuit.
+        ``routing_key`` is accepted (and ignored) so FleetRouter and the
+        single client duck-type one call surface."""
         from karpenter_core_tpu.metrics import wiring as m
 
         if not self.breaker.allow():
@@ -297,6 +372,8 @@ class SolverClient:
             raise RemoteSolverError("circuit_open", "circuit breaker open")
         cause, detail = "error", ""
         retry_after: Optional[float] = None
+        need: List[str] = []
+        instance = ""
         for attempt in range(self.max_retries + 1):
             if attempt:
                 m.SOLVER_RPC_RETRIES.inc()
@@ -312,12 +389,15 @@ class SolverClient:
                 data, kernel = self._once(path, body, headers)
             except RemoteSolverError as e:
                 cause, detail, retry_after = e.cause, str(e), e.retry_after
-                if e.cause in ("drain", "poisoned"):
+                need, instance = e.need, e.instance
+                if e.cause in ("drain", "poisoned", "segment_miss"):
                     # the sidecar ANSWERED with a definitive refusal:
-                    # draining (it is about to restart) or a quarantined
-                    # poison digest — retrying is pointless and the
-                    # breaker stays untouched (a live answer is not a
-                    # dead sidecar)
+                    # draining (it is about to restart), a quarantined
+                    # poison digest, or a segment miss (retrying the SAME
+                    # body cannot succeed — the repair is a different
+                    # body, solve_delta's job) — retrying is pointless
+                    # and the breaker stays untouched (a live answer is
+                    # not a dead sidecar)
                     self.breaker.record_success()
                     break
                 if e.cause == "shed":
@@ -351,7 +431,75 @@ class SolverClient:
             # degradation past its end)
             self.breaker.record_failure()
         m.SOLVER_RPC_FAILURES.inc({"cause": cause})
-        raise RemoteSolverError(cause, detail, retry_after=retry_after)
+        err = RemoteSolverError(cause, detail, retry_after=retry_after)
+        err.need, err.instance = need, instance
+        raise err
+
+    # -- delta wire (segmentstore, ISSUE 14) -------------------------------
+
+    def solve_delta(self, plan, headers: dict = None):
+        """One delta-wire solve: ship a manifest eliding every segment
+        the sent-cache says the far instance holds; on the typed miss,
+        re-upload exactly the named digests and retry ONCE. Raises
+        RemoteSolverError("segment_miss") only when the repair round
+        ALSO missed — the caller falls back to the full wire (degraded
+        bytes, never a wrong solve and never a greedy fallback: the
+        sidecar is alive and answering, so the breaker stays untouched).
+
+        ``plan`` is solver/segments.split_solve_header's SegmentPlan; a
+        fleet-member restart surfaces here as exactly one miss round —
+        the new instance id on the answer rebinds the sent-cache."""
+        from karpenter_core_tpu.metrics import wiring as m
+        from karpenter_core_tpu.solver import codec
+
+        include = [
+            dg for dg in plan.segments if not self.segcache.known(dg)
+        ]
+        body = codec.encode_manifest_request(
+            plan, include, base=self.segcache.base()
+        )
+        m.SOLVER_SEGMENT_WIRE_BYTES.inc(
+            {"kind": "segment" if include else "manifest"}, by=len(body)
+        )
+        try:
+            data, kernel = self.call("/solve", body, headers)
+        except RemoteSolverError as e:
+            if e.cause != "segment_miss":
+                raise
+            # miss: the far store lost segments and/or the base listing
+            # (respawn, TTL, LRU, drift) — the answer names them; drop
+            # them from the ledger, rebind to the answering instance (a
+            # NEW id clears everything including the base), and repair
+            # with one upload round
+            self.segcache.forget(e.need)
+            if e.instance:
+                self.segcache.rebind(e.instance)
+            repair = {dg for dg in e.need if dg in plan.segments}
+            if any(dg not in plan.segments for dg in e.need):
+                # the base listing itself (or something we never held)
+                # is what's missing: resend the FULL listing
+                self.segcache.drop_base()
+            if not repair and self.segcache.base() is not None:
+                # the miss names nothing we hold AND the base survived —
+                # a malformed answer; nothing to repair, full-wire
+                # fallback (the caller's job)
+                raise
+            repair |= {
+                dg for dg in plan.segments
+                if not self.segcache.known(dg)
+            }
+            body = codec.encode_manifest_request(
+                plan, sorted(repair), base=self.segcache.base()
+            )
+            m.SOLVER_SEGMENT_WIRE_BYTES.inc(
+                {"kind": "segment" if repair else "manifest"},
+                by=len(body),
+            )
+            data, kernel = self.call("/solve", body, headers)
+        self.segcache.rebind(self._seen_instance)
+        self.segcache.mark(plan.all_digests())
+        self.segcache.set_base(plan.listing_digest, plan.listing)
+        return data, kernel
 
 
 class RemoteScheduler:
@@ -409,8 +557,10 @@ class RemoteScheduler:
         digest = None
         quarantine = self.client.quarantine
         try:
+            plan = None
+            wire_mode = getattr(self.client, "wire_mode", "full")
             with m.SOLVER_RPC_PHASE_DURATION.time({"phase": "encode"}):
-                body = codec.encode_solve_request(
+                header = codec._encode_solve_header(
                     self.nodepools,
                     self.instance_types,
                     self.existing_nodes,
@@ -422,19 +572,55 @@ class RemoteScheduler:
                     tenant=self.client.tenant,
                     solver_mode=self.solver_mode,
                 )
-            # poison check AFTER encode (the digest IS the canonical wire
-            # bytes) but BEFORE any transport: a quarantined problem costs
-            # zero RPCs, device grants, or sidecar respawns
-            digest = hashlib.sha256(body).hexdigest()
+                if wire_mode == "delta":
+                    # delta wire (ISSUE 14): split into content-addressed
+                    # segments; the quarantine key is the manifest CORE
+                    # (digests + inline + pod layout), stable whether or
+                    # not uploads ride along — the same key the gateway
+                    # computes via codec.request_digest
+                    from karpenter_core_tpu.solver import segments as segmod
+
+                    plan = segmod.split_solve_header(header)
+                    digest = plan.core_digest
+                else:
+                    body = codec._json_payload(header)
+                    digest = hashlib.sha256(body).hexdigest()
+            # poison check AFTER encode (the digest IS the canonical
+            # content) but BEFORE any transport: a quarantined problem
+            # costs zero RPCs, device grants, or sidecar respawns
             if quarantine is not None and quarantine.quarantined(digest):
                 m.SOLVER_QUARANTINE_ROUTED.inc({"site": "client"})
                 m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "solve"})
                 return self._fallback_solve(pods, gangsched)
             t0 = time.perf_counter()
-            data, kernel = self.client.call(
-                "/solve", body,
-                headers={"X-Solver-Mode": self.solver_mode},
-            )
+            rpc_headers = {"X-Solver-Mode": self.solver_mode}
+            if plan is not None:
+                try:
+                    data, kernel = self.client.solve_delta(
+                        plan, rpc_headers
+                    )
+                except RemoteSolverError as e:
+                    if e.cause != "segment_miss":
+                        raise
+                    # the manifest could not be resolved even after the
+                    # re-upload round: ship the WHOLE problem — degraded
+                    # bytes, never a wrong solve and never greedy (the
+                    # sidecar is alive; full-wire v5 is first-class)
+                    body = codec._json_payload(header)
+                    m.SOLVER_SEGMENT_WIRE_BYTES.inc(
+                        {"kind": "full"}, by=len(body)
+                    )
+                    data, kernel = self.client.call(
+                        "/solve", body, rpc_headers,
+                        routing_key=plan.catalog_digest,
+                    )
+            else:
+                m.SOLVER_SEGMENT_WIRE_BYTES.inc(
+                    {"kind": "full"}, by=len(body)
+                )
+                data, kernel = self.client.call(
+                    "/solve", body, rpc_headers
+                )
             total = time.perf_counter() - t0
             m.SOLVER_RPC_PHASE_DURATION.observe(kernel, {"phase": "kernel"})
             m.SOLVER_RPC_PHASE_DURATION.observe(
@@ -672,6 +858,235 @@ class RemoteScheduler:
             pod_errors=errors,
             evictions=evictions,
         )
+
+
+class FleetRouter:
+    """Client-side routing over N solverd fleet members (ISSUE 14).
+
+    Duck-types the SolverClient surface RemoteScheduler consumes
+    (``call``/``solve_delta``/``tenant``/``quarantine``/``breaker``/
+    ``wire_mode``) while placing each solve on one of N member clients:
+
+    * **digest affinity** — rendezvous (highest-random-weight) hashing of
+      the manifest's CATALOG digest over member INDICES, so every solve
+      of one cluster keeps landing on the member whose prepared-state
+      and scheduler caches are already warm for it. Keying on the index
+      (not the address) keeps the mapping stable across respawns, and
+      rendezvous keeps it stable under member churn: removing one member
+      remaps only that member's keys, never the survivors';
+    * **spill-over** — an ANSWERED refusal (shed/drain/quarantine) from
+      the affinity member re-routes once to the least-loaded healthy
+      other member (the refusal never charged a breaker, so spilling is
+      free); with affinity off (the bench's negative control) every
+      placement is least-loaded;
+    * **per-member breakers** — each member client owns its breaker
+      (member-labeled on the gauge), and a member whose breaker is open
+      is skipped at placement (``reason=degraded``) so one dark member
+      costs routing, not greedy degradation;
+    * **aggregate health** — ``health()`` polls every member's /healthz
+      into one fleet view (ready = any member ready).
+
+    The client-side poison quarantine is SHARED across members (a poison
+    problem is poison everywhere), as is the tenant identity. Placement
+    counters ride ``solver_fleet_routed_total{reason}``.
+    """
+
+    def __init__(
+        self,
+        members: List[SolverClient],
+        tenant: str = "default",
+        affinity: bool = True,
+        quarantine=None,
+    ):
+        if not members:
+            raise ValueError("FleetRouter needs at least one member")
+        self.members = list(members)
+        self.tenant = tenant
+        self.affinity = affinity
+        if quarantine is None:
+            from karpenter_core_tpu.solver.fleet import PoisonQuarantine
+
+            quarantine = PoisonQuarantine(site="client")
+        self.quarantine = quarantine
+        for c in self.members:
+            c.quarantine = quarantine  # one verdict ledger, N transports
+        self._lock = threading.RLock()
+        self._inflight = [0] * len(self.members)
+        self._tl = threading.local()
+        self.routed: Dict[str, int] = {}
+
+    # -- SolverClient surface ---------------------------------------------
+
+    @property
+    def wire_mode(self) -> str:
+        return self.members[0].wire_mode
+
+    @property
+    def breaker(self):
+        """The breaker of the member that served THIS thread's last call
+        — what RemoteScheduler charges on a corrupt result. Falls back to
+        member 0 before any call has routed."""
+        i = getattr(self._tl, "last", 0)
+        return self.members[i].breaker
+
+    @property
+    def addr(self) -> str:
+        return ",".join(c.addr for c in self.members)
+
+    def set_member_addr(self, i: int, addr: str) -> None:
+        """Follow a respawned fleet member to its new port (the operator
+        calls this after FleetSupervisor.poll reports a restart)."""
+        self.members[i].set_addr(addr)
+
+    def set_addr(self, addr: str) -> None:
+        """SolverClient duck-typing for the single-member router: a bare
+        address re-points member 0."""
+        self.set_member_addr(0, addr)
+
+    # -- placement ---------------------------------------------------------
+
+    def _healthy_locked(self) -> List[int]:
+        with self._lock:
+            up = [
+                i for i, c in enumerate(self.members)
+                if c.breaker.probeable()
+            ]
+            # every breaker open: fall through to all members — the
+            # breakers themselves fast-fail, and a blanket empty set
+            # would turn "all cooling down" into an unroutable error
+            return up or list(range(len(self.members)))
+
+    def _count_routed_locked(self, reason: str) -> None:
+        from karpenter_core_tpu.metrics import wiring as m
+
+        with self._lock:
+            self.routed[reason] = self.routed.get(reason, 0) + 1
+        m.SOLVER_FLEET_ROUTED.inc({"reason": reason})
+
+    def _least_loaded_locked(self, candidates: List[int]) -> int:
+        with self._lock:
+            return min(
+                candidates, key=lambda i: (self._inflight[i], i)
+            )
+
+    def _pick(self, routing_key: Optional[str]) -> int:
+        healthy = self._healthy_locked()
+        if self.affinity and routing_key:
+            ranked = max(
+                healthy,
+                key=lambda i: hashlib.sha256(
+                    f"{i}|{routing_key}".encode()
+                ).digest(),
+            )
+            degraded = len(healthy) < len(self.members) and ranked != max(
+                range(len(self.members)),
+                key=lambda i: hashlib.sha256(
+                    f"{i}|{routing_key}".encode()
+                ).digest(),
+            )
+            self._count_routed_locked(
+                "degraded" if degraded else "affinity"
+            )
+            return ranked
+        member = self._least_loaded_locked(healthy)
+        self._count_routed_locked("spill")
+        return member
+
+    def _run(self, i: int, fn):
+        with self._lock:
+            self._inflight[i] += 1
+        self._tl.last = i
+        try:
+            return fn(self.members[i])
+        finally:
+            with self._lock:
+                self._inflight[i] -= 1
+
+    def _routed(self, fn, routing_key: Optional[str]):
+        """Place fn on the affinity pick; spill ONCE to the least-loaded
+        healthy other member when the pick answers with a refusal (shed/
+        drain/poisoned — it is regulating or restarting, not dead; a
+        transport FAULT does not spill, the breaker machinery owns it)."""
+        first = self._pick(routing_key)
+        try:
+            return self._run(first, fn)
+        except RemoteSolverError as e:
+            if (
+                e.cause not in ("shed", "drain", "poisoned")
+                or len(self.members) < 2
+            ):
+                raise
+            others = [
+                i for i in self._healthy_locked() if i != first
+            ]
+            if not others:
+                raise
+            spill = self._least_loaded_locked(others)
+            self._count_routed_locked("spill")
+            return self._run(spill, fn)
+
+    def call(self, path: str, body: bytes, headers: dict = None,
+             routing_key: str = None):
+        if routing_key is None:
+            # no explicit affinity key (frontier sweeps, fallback bodies
+            # from callers that did not thread one): derive a stable one
+            # from the body so repeat traffic still lands warm
+            routing_key = hashlib.sha256(body).hexdigest()
+        return self._routed(
+            lambda c: c.call(path, body, headers), routing_key
+        )
+
+    def solve_delta(self, plan, headers: dict = None):
+        return self._routed(
+            lambda c: c.solve_delta(plan, headers), plan.catalog_digest
+        )
+
+    # -- observability -----------------------------------------------------
+
+    def health(self, timeout: float = 2.0) -> dict:
+        """Aggregate fleet /healthz: one member view per row, fleet-level
+        ready when ANY member is ready (the router can place around the
+        rest). An unreachable member reports ok:false, reachable:false —
+        a fleet dashboard tells 'member down' from 'member overloaded'."""
+        import json as _json
+        from urllib.request import urlopen
+
+        rows = []
+        ready = 0
+        for c in self.members:
+            row = {"addr": c.addr, "ok": False, "reachable": False}
+            try:
+                with urlopen(
+                    f"http://{c.addr}/healthz", timeout=timeout
+                ) as resp:
+                    row.update(_json.loads(resp.read().decode()))
+                    row["reachable"] = True
+            except (OSError, ValueError):
+                pass
+            if row.get("ready"):
+                ready += 1
+            rows.append(row)
+        return {
+            "ok": any(r.get("ok") for r in rows),
+            "ready": ready > 0,
+            "ready_members": ready,
+            "size": len(self.members),
+            "members": rows,
+        }
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "routed": dict(sorted(self.routed.items())),
+                "members": [
+                    {
+                        "addr": c.addr,
+                        "breaker": _STATE_NAMES[c.breaker.state],
+                        "inflight": self._inflight[i],
+                    }
+                    for i, c in enumerate(self.members)
+                ],
+            }
 
 
 def remote_frontier(

@@ -63,7 +63,6 @@ Run: ``python -m karpenter_core_tpu.solver.service --port 0``
 """
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import threading
@@ -71,7 +70,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from karpenter_core_tpu.kube.httpserver import read_body, send_body
-from karpenter_core_tpu.solver import codec, fleet
+from karpenter_core_tpu.solver import codec, fleet, segments
 from karpenter_core_tpu.solver.supervisor import (
     DRAIN_EXIT_CODE,
     DRAIN_EXIT_DEADLINE_SECONDS,
@@ -226,10 +225,27 @@ class SolverDaemon:
         chaos=None,
         exit_fn=None,
         default_mode: str = "ffd",
+        segment_store: segments.SegmentStore = None,
     ):
         self.ready = False
         self.solves = 0
         self.profile_dir = profile_dir
+        # boot identity for the delta wire (segmentstore, ISSUE 14): rides
+        # every answer as X-Solverd-Instance and every segment-miss 409,
+        # so clients key their sent-caches per PROCESS — a respawn mints
+        # a fresh id and costs exactly one re-upload round, never a stale
+        # elision against an empty store
+        import uuid
+
+        self.instance = uuid.uuid4().hex[:12]
+        # content-addressed segment store: what a manifest request's
+        # digests resolve against (`is None`, not truthiness — an empty
+        # store must still be adopted, the PR 5 cache lesson)
+        self.segment_store = (
+            segment_store
+            if segment_store is not None
+            else segments.SegmentStore()
+        )
         # solver backend served when a request names none (relaxsolve,
         # ISSUE 13): the wire field / X-Solver-Mode header select
         # per-request; this is the daemon-wide default (solverd
@@ -338,9 +354,17 @@ class SolverDaemon:
         and one corrupt or poisoned problem in a batch fails alone."""
         from karpenter_core_tpu.metrics import wiring as m
 
-        # the poison key is the request-body digest (canonical wire bytes,
-        # PR 4), computed pre-decode: the decode itself may be the crash
-        digest = hashlib.sha256(body).hexdigest()
+        # the poison key is the request digest (canonical wire bytes for
+        # full bodies, the manifest CORE for delta bodies — the same key
+        # whether or not segment uploads ride along), computed pre-decode:
+        # the decode itself may be the crash. For a manifest this parses
+        # the (small) header and resolves the listing a second time
+        # alongside _decode_solve — accepted: the heavy JSON (segment
+        # contents) is only ever parsed once, in assembly, and both
+        # passes run in the pipelined host phase, never on the grant.
+        digest = codec.request_digest(
+            body, segment_store=self.segment_store
+        )
         if self.quarantine.quarantined(digest):
             m.SOLVER_QUARANTINE_ROUTED.inc({"site": "gateway"})
             raise fleet.QuarantinedError(digest)
@@ -537,8 +561,15 @@ class SolverDaemon:
                             continue
                     body_i, problem_i, _d = t.payload
                     try:
+                        # the cache's byte-bound weight comes from the
+                        # PROBLEM's scale (resolved segment bytes for a
+                        # manifest, body bytes for the full wire) — a
+                        # steady-state manifest body is a few hundred
+                        # bytes and would let N delta-wire tenants pin N
+                        # full schedulers past the --cache-mib bound
                         scheduler = self._scheduler_for(
-                            problem_i, len(body_i)
+                            problem_i,
+                            problem_i.get("approx_bytes") or len(body_i)
                         )
                     except Exception as e:
                         outcomes[i] = ("error", e)
@@ -657,8 +688,14 @@ class SolverDaemon:
     def _decode_solve(self, body: bytes) -> dict:
         """The solve request's host-phase decode — a named seam so chaos
         tests can wedge ONE tenant's host phase and prove the device keeps
-        serving everyone else."""
-        return codec.decode_solve_request(body)
+        serving everyone else. Manifest bodies resolve through the
+        segment store here, pre-grant: a miss raises
+        segments.SegmentMissError, the ticket is abandoned, and the HTTP
+        layer answers the typed 409 — segment traffic never holds the
+        device."""
+        return codec.decode_solve_request(
+            body, segment_store=self.segment_store
+        )
 
     def _maybe_profile(self):
         """jax.profiler trace context when profiling is toggled on and a
@@ -702,7 +739,9 @@ class SolverDaemon:
         from karpenter_core_tpu.metrics import wiring as m
         from karpenter_core_tpu.models.consolidation import frontier_core
 
-        digest = hashlib.sha256(body).hexdigest()
+        digest = codec.request_digest(
+            body, segment_store=self.segment_store
+        )
         if self.quarantine.quarantined(digest):
             m.SOLVER_QUARANTINE_ROUTED.inc({"site": "gateway"})
             raise fleet.QuarantinedError(digest)
@@ -772,6 +811,11 @@ class SolverDaemon:
             "draining": draining,
             "queue_depth": depth,
             "queue_capacity": self.gateway.max_depth,
+            # delta-wire surface (ISSUE 14): the boot identity clients key
+            # their sent-caches on, and the segment store's residency so a
+            # fleet dashboard can tell "cold member" from "evicting"
+            "instance": self.instance,
+            "segments": self.segment_store.stats(),
             # the poison ledger, so a fleet dashboard can tell "this
             # sidecar is refusing a poison problem" from "cold"
             "quarantine_entries": self.quarantine.size(),
@@ -931,12 +975,30 @@ class _Handler(BaseHTTPRequestHandler):
                     "fingerprint": e.fingerprint,
                 }).encode(),
             )
+        except segments.SegmentMissError as e:
+            # delta-wire typed miss (ISSUE 14): the store cannot produce
+            # these digests — answer 409 naming them (+ our instance id,
+            # what the client's sent-cache rebinds on) and the client
+            # repairs with ONE upload round. Never a wrong solve, never a
+            # breaker charge: a miss is an answer, not a fault.
+            return send_body(
+                self, 409,
+                json.dumps({
+                    "error": "segments_missing",
+                    "need": e.need,
+                    "instance": self.daemon.instance,
+                }).encode(),
+            )
         except Exception as e:
             return send_body(
                 self, 500, repr(e).encode(), ctype="text/plain"
             )
         send_body(
-            self, 200, out, _OCTET, headers={"X-Solver-Seconds": f"{dt:.6f}"}
+            self, 200, out, _OCTET,
+            headers={
+                "X-Solver-Seconds": f"{dt:.6f}",
+                "X-Solverd-Instance": self.daemon.instance,
+            },
         )
 
 
@@ -1044,6 +1106,18 @@ def main() -> int:
         " X-Solver-Mode header",
     )
     ap.add_argument(
+        "--segment-cache-mib", type=int,
+        default=segments.DEFAULT_STORE_BYTES >> 20,
+        help="delta-wire segment store byte bound, in MiB (canonical"
+        " segment bytes; LRU past it — an evicted segment costs the next"
+        " manifest one miss/re-upload round, never a wrong solve)",
+    )
+    ap.add_argument(
+        "--segment-ttl", type=float, default=segments.DEFAULT_STORE_TTL,
+        help="idle seconds before a segment no manifest references"
+        " expires from the store (references refresh it)",
+    )
+    ap.add_argument(
         "--quarantine-journal", default=None,
         help="path for the crash-only poison journal: the digest in"
         " flight on the device is recorded here, so a problem that"
@@ -1059,6 +1133,10 @@ def main() -> int:
         ap.error("--max-batch must be >= 1 (1 disables coalescing)")
     if args.batch_window_ms < 0:
         ap.error("--batch-window-ms must be >= 0 (0 = never wait)")
+    if args.segment_cache_mib <= 0:
+        ap.error("--segment-cache-mib must be positive")
+    if args.segment_ttl <= 0:
+        ap.error("--segment-ttl must be positive")
 
     daemon = SolverDaemon(
         profile_dir=args.profile_dir,
@@ -1075,6 +1153,10 @@ def main() -> int:
         devices=args.devices,
         watchdog_seconds=args.watchdog_seconds,
         default_mode=args.solver_mode,
+        segment_store=segments.SegmentStore(
+            max_bytes=args.segment_cache_mib << 20,
+            ttl=args.segment_ttl,
+        ),
         quarantine=fleet.PoisonQuarantine(
             strikes=args.quarantine_strikes,
             ttl=args.quarantine_ttl,

@@ -342,3 +342,73 @@ class SolverSupervisor:
         if self.proc.stdout is not None:
             self.proc.stdout.close()
         self.proc = None
+
+
+class FleetSupervisor:
+    """--solver-fleet=N: N supervised solverd children on distinct ports.
+
+    Composes N SolverSupervisors — each member keeps the FULL single-child
+    contract (handshake deadline, crash-vs-drain exit classification,
+    crash-loop backoff, the drain streak cap) unchanged; this class only
+    adds the fleet-shaped surface the operator and the client-side router
+    (solver/remote.FleetRouter) consume: start-all, per-pass poll-all
+    (returning WHICH members respawned, so the router re-points exactly
+    those addresses), per-member drain, stop-all. PR 8's crash-only
+    drain/respawn already made each member replaceable; the fleet tier is
+    routing + cache warmth, not new lifecycle machinery.
+
+    Every child spawns with ``port=0`` (each picks its own free port), so
+    members can never collide, and member events carry their index so the
+    operator's event stream says WHICH sidecar restarted."""
+
+    def __init__(
+        self,
+        n: int,
+        on_event: Optional[Callable[[str, str], None]] = None,
+        supervisor_factory=None,
+        **child_kwargs,
+    ):
+        if n < 1:
+            raise ValueError(f"fleet size must be >= 1, got {n}")
+        self.on_event = on_event
+        factory = supervisor_factory or SolverSupervisor
+        self.members: List[SolverSupervisor] = [
+            factory(on_event=self._member_event(i), **child_kwargs)
+            for i in range(n)
+        ]
+
+    def _member_event(self, i: int) -> Callable[[str, str], None]:
+        def emit(reason: str, message: str) -> None:
+            if self.on_event is not None:
+                self.on_event(reason, f"[member {i}] {message}")
+
+        return emit
+
+    def start(self) -> List[str]:
+        """Spawn every member; returns their host:port addresses in
+        member order (the router's stable member indices)."""
+        return [m.start() for m in self.members]
+
+    @property
+    def addrs(self) -> List[str]:
+        return [m.addr for m in self.members]
+
+    def alive_count(self) -> int:
+        return sum(1 for m in self.members if m.alive())
+
+    def poll(self) -> List[int]:
+        """One supervision pass over every member; returns the indices
+        that respawned this pass (the caller re-points its router at
+        those members' possibly-new addresses). A member still inside
+        its crash backoff simply stays down this pass — the router keeps
+        serving from the rest."""
+        return [i for i, m in enumerate(self.members) if m.poll()]
+
+    def drain(self, i: int, **kwargs) -> bool:
+        """Drain ONE member (rolling restarts: drain, poll-respawn,
+        next) — the fleet keeps serving from the others meanwhile."""
+        return self.members[i].drain(**kwargs)
+
+    def stop(self) -> None:
+        for m in self.members:
+            m.stop()

@@ -74,6 +74,26 @@ def test_fast_bench_emits_well_formed_json():
         assert cfg12[shape]["relax"]["phases"]["solver_mode"] == "relax"
     assert cfg12["relax_ok"] is True, cfg12
 
+    # the tiny cfg13 proves the delta wire + fleet router end-to-end
+    # (ISSUE 14): manifest-path solves parity the full path, the byte
+    # schema is recorded, and the router keeps caches hot under affinity
+    cfg13 = line["detail"]["cfg13_delta"]
+    wire = cfg13["wire"]
+    for key in ("full_wire_bytes_per_resolve",
+                "delta_wire_bytes_per_resolve", "delta_ratio", "delta_ok",
+                "parity_ok", "result_nodes_delta"):
+        assert key in wire, key
+    assert wire["parity_ok"] is True
+    assert wire["result_nodes_delta"] == 0
+    # a smoke-sized snapshot has too little stable problem half for the
+    # full-scale <=10% gate, but the delta must already beat the full wire
+    assert wire["delta_ratio"] < 1.0, wire
+    fleet = cfg13["fleet"]
+    assert "x1" in fleet and "x2" in fleet
+    for phase in fleet.values():
+        assert phase["aggregate_pods_per_sec"] > 0
+    assert cfg13["affinity_cache_ok"] is True, cfg13
+
     # the tiny cfg11 gangsched smoke (ISSUE 10): preemption fired, every
     # gang stayed atomic, and the eviction set stayed minimal
     gangs = line["detail"]["cfg11_gangs"]

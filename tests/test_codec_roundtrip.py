@@ -243,6 +243,10 @@ _ROUNDTRIPPED_ENTRIES = {
     "encode_solve_results",
     "encode_frontier_request",
     "encode_frontier_response",
+    # delta wire (ISSUE 14): round-tripped by the manifest parity battery
+    # in tests/test_segments.py (manifest-path vs full-path equivalence
+    # over the fuzz corpus) plus the unit roundtrip below
+    "encode_manifest_request",
 }
 
 
@@ -393,6 +397,37 @@ def test_evictable_priority_clamps_at_the_decode_net():
     import numpy as np
 
     np.full((1,), prio, dtype=np.int32)  # the EvPlanes store must not raise
+
+
+def test_manifest_request_roundtrip_matches_full_decode():
+    """The delta wire's top-level entry (ISSUE 14): a manifest body
+    decodes to the SAME problem dict as the full wire — fingerprint,
+    bucket, pod order, node set — through a fresh segment store. The
+    deeper equivalences (result-wire parity over the fuzz corpus, the
+    miss protocol) live in tests/test_segments.py."""
+    from karpenter_core_tpu.solver import segments as segmod
+
+    problem = sample_problem()
+    full = codec.decode_solve_request(
+        codec.encode_solve_request(**problem)
+    )
+    plan = segmod.split_solve_header(
+        codec._encode_solve_header(**problem)
+    )
+    man = codec.decode_manifest_request(
+        codec.encode_manifest_request(plan),
+        segment_store=segmod.SegmentStore(),
+    )
+    assert man["fingerprint"] == full["fingerprint"] == plan.fingerprint
+    assert man["bucket"] == full["bucket"]
+    assert man["wire_kind"] == "manifest" and full["wire_kind"] == "full"
+    assert [p.uid for p in man["pods"]] == [p.uid for p in full["pods"]]
+    assert [n.name for n in man["existing_nodes"]] == [
+        n.name for n in full["existing_nodes"]
+    ]
+    assert man["tenant"] == full["tenant"]
+    assert man["solver_mode"] == full["solver_mode"]
+    assert man["unavailable_offerings"] == full["unavailable_offerings"]
 
 
 def test_solve_request_wire_bytes_are_canonical():

@@ -94,6 +94,34 @@ class TestOptionsParse:
         with pytest.raises(ValueError):
             Options.parse(["--solver-tenant-weights", "blue"], env={})
 
+    def test_fleet_and_wire_flags(self):
+        # delta wire + horizontally scaled solver tier (ISSUE 14)
+        o = Options.parse([], env={})
+        assert o.solver_fleet == 1
+        assert o.solver_wire == "delta"
+        o = Options.parse(
+            ["--solver-fleet", "4", "--solver-wire=full"], env={}
+        )
+        assert o.solver_fleet == 4 and o.solver_wire == "full"
+        assert Options.parse(
+            [], env={"KARPENTER_SOLVER_FLEET": "2"}
+        ).solver_fleet == 2
+        with pytest.raises(ValueError, match="solver-fleet"):
+            Options.parse(["--solver-fleet", "0"], env={})
+        with pytest.raises(ValueError, match="wire mode"):
+            Options.parse(["--solver-wire", "chunky"], env={})
+        # fleet sizing governs SPAWNED children; silently ignoring it
+        # next to an external address would fake a fleet
+        with pytest.raises(ValueError, match="cannot combine"):
+            Options.parse(
+                ["--solver-fleet", "2", "--solver-addr", "h:1"], env={}
+            )
+        # an external fleet IS expressible: the comma-list address
+        o = Options.parse(
+            ["--solver-addr", "h:1,h:2"], env={}
+        )
+        assert o.solver_addr == "h:1,h:2" and o.solver_fleet == 1
+
     def test_unknown_flag_rejected(self):
         # a typo'd flag must error, not silently swallow the next flag
         with pytest.raises(ValueError):

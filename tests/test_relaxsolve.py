@@ -384,7 +384,9 @@ def test_codec_rejects_unknown_mode_both_sides():
 
 
 def test_solve_wire_version_bumped_for_mode_field():
-    assert codec.SOLVE_WIRE_VERSION == 4
+    # v4 introduced solver_mode; v5 the delta wire (segmentstore) — the
+    # mode field's skew protection carries forward unchanged
+    assert codec.SOLVE_WIRE_VERSION >= 4
     body = codec.encode_solve_request(*two_pool_world(), [], [], [])
     h = codec._json_header(body)
     h["version"] = 3

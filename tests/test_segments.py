@@ -1,0 +1,809 @@
+"""Delta wire + fleet routing (segmentstore, ISSUE 14).
+
+Four batteries:
+
+* **segment units** — SegmentStore TTL/LRU/byte-cap semantics on a fake
+  clock, SentCache instance rebinding, and split/assemble exactness (the
+  manifest path must reconstruct the full header VALUE-FOR-VALUE, which
+  is what makes its solves wire-identical to full-path ones);
+* **manifest parity** — the full fuzz corpus (all 14 seeds) plus
+  topology-context, gang, and relax-mode problems solved through BOTH
+  wire forms on fresh daemons, asserting the RESULT wire is identical
+  (modulo the timing field) — the delta wire may never change a packing;
+* **miss protocol** — a respawned/evicting sidecar answers the typed 409
+  miss, the client repairs with ONE upload round (breaker untouched, no
+  greedy fallback), and a store that cannot hold segments at all degrades
+  to the FULL wire, still never to greedy;
+* **fleet routing** — rendezvous affinity stability under member churn,
+  spill-over under forced drain, degraded routing around an open breaker,
+  the kill/respawn regression (a fleet member restart costs one re-upload,
+  not a greedy fallback), and the two-operators-x-two-sidecars e2e.
+"""
+import copy
+import json
+import time
+
+import pytest
+
+from tests.helpers import make_nodepool, make_pod
+
+from karpenter_core_tpu.metrics import wiring as m
+from karpenter_core_tpu.solver import codec, remote, segments, service
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def now(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# SegmentStore / SentCache units
+# ---------------------------------------------------------------------------
+
+
+class TestSegmentStore:
+    def _store(self, **kw):
+        clock = FakeClock()
+        kw.setdefault("ttl", 60.0)
+        return segments.SegmentStore(time_fn=clock.now, **kw), clock
+
+    def test_put_get_roundtrip_and_contains(self):
+        store, _ = self._store()
+        store.put("d1", b"abc")
+        assert store.get("d1") == b"abc"
+        assert "d1" in store and "d2" not in store
+        assert store.total_bytes() == 3 and len(store) == 1
+
+    def test_ttl_expiry_is_idle_based(self):
+        store, clock = self._store(ttl=60.0)
+        store.put("d1", b"abc")
+        clock.advance(50)
+        assert store.get("d1") == b"abc"  # reference refreshes the TTL
+        clock.advance(50)
+        assert store.get("d1") == b"abc"  # still warm: 50 < 60 since touch
+        clock.advance(61)
+        assert store.get("d1") is None  # idle past the TTL: expired
+        assert store.stats()["evictions"].get("ttl") == 1
+
+    def test_entry_cap_evicts_lru(self):
+        store, _ = self._store(max_entries=2)
+        store.put("a", b"1")
+        store.put("b", b"2")
+        assert store.get("a") == b"1"  # touch: b becomes the LRU
+        store.put("c", b"3")
+        assert store.get("b") is None and store.get("a") == b"1"
+        assert store.stats()["evictions"].get("entries") == 1
+
+    def test_byte_cap_is_strict(self):
+        store, _ = self._store(max_bytes=10)
+        store.put("a", b"x" * 6)
+        store.put("b", b"y" * 6)  # 12 > 10: a evicts
+        assert store.get("a") is None and store.get("b") is not None
+        # even a single oversized segment may not pin more than the
+        # budget — it serves (put succeeds) but does not stay resident
+        store.put("big", b"z" * 64)
+        assert store.get("big") is None
+        assert store.stats()["evictions"].get("bytes", 0) >= 2
+
+    def test_replacing_same_digest_does_not_double_count(self):
+        store, _ = self._store()
+        store.put("a", b"x" * 8)
+        store.put("a", b"x" * 8)
+        assert store.total_bytes() == 8
+
+    def test_rejects_bad_bounds(self):
+        with pytest.raises(ValueError):
+            segments.SegmentStore(max_entries=0)
+        with pytest.raises(ValueError):
+            segments.SegmentStore(ttl=0)
+
+
+class TestSentCache:
+    def test_mark_known_and_instance_rebind_clears(self):
+        sc = segments.SentCache()
+        sc.rebind("inst-1")
+        sc.mark(["d1", "d2"])
+        assert sc.known("d1") and sc.known("d2")
+        assert not sc.rebind("inst-1")  # same instance: no clear
+        assert sc.known("d1")
+        assert sc.rebind("inst-2")  # respawn: ledger resets
+        assert not sc.known("d1") and len(sc) == 0
+
+    def test_forget_drops_named_digests_only(self):
+        sc = segments.SentCache()
+        sc.mark(["d1", "d2", "d3"])
+        sc.forget(["d2", "zzz"])
+        assert sc.known("d1") and not sc.known("d2") and sc.known("d3")
+
+    def test_digest_cap_is_lru(self):
+        sc = segments.SentCache(max_digests=2)
+        sc.mark(["a", "b"])
+        sc.mark(["a"])  # touch
+        sc.mark(["c"])
+        assert sc.known("a") and sc.known("c") and not sc.known("b")
+
+
+# ---------------------------------------------------------------------------
+# split / assemble exactness + fingerprint derivability
+# ---------------------------------------------------------------------------
+
+
+def _sample_problem():
+    from tests.test_codec_roundtrip import sample_problem
+
+    return sample_problem()
+
+
+def test_split_assemble_reconstructs_header_exactly():
+    header = codec._encode_solve_header(**_sample_problem())
+    plan = segments.split_solve_header(header)
+    back = segments.assemble_solve_header(
+        plan.listing, plan.inline, plan.pod_batch, plan.pod_member,
+        plan.segments.get,
+    )
+    # canonical-bytes equality = value-for-value reconstruction (the
+    # original header is JSON-pure by construction: it IS what the full
+    # wire ships)
+    assert segments.canonical_bytes(back) == segments.canonical_bytes(header)
+
+
+def test_fingerprint_matches_across_wire_forms_and_derives_from_digests():
+    problem = _sample_problem()
+    header = codec._encode_solve_header(**problem)
+    plan = segments.split_solve_header(header)
+    full = codec.decode_solve_request(codec.encode_solve_request(**problem))
+    assert plan.fingerprint == full["fingerprint"]
+    # derivable from the digest listing alone — no content needed
+    assert plan.fingerprint == segments.fingerprint_of_parts(
+        plan.listing, plan.inline
+    )
+    store = segments.SegmentStore()
+    man = codec.decode_solve_request(
+        codec.encode_manifest_request(plan), segment_store=store
+    )
+    assert man["fingerprint"] == full["fingerprint"]
+    assert man["wire_kind"] == "manifest" and full["wire_kind"] == "full"
+    assert man["bucket"] == full["bucket"]
+
+
+def test_fingerprint_excludes_pod_half_like_v4():
+    base = _sample_problem()
+    header = codec._encode_solve_header(**base)
+    fp = segments.split_solve_header(header).fingerprint
+
+    churned = dict(base)
+    churned["pods"] = [make_pod(cpu=2.0, name="other") for _ in range(7)]
+    churned["tenant"] = "tenant-b"
+    churned["solver_mode"] = "ffd"
+    h2 = codec._encode_solve_header(**churned)
+    assert segments.split_solve_header(h2).fingerprint == fp
+
+    recat = dict(base)
+    recat["max_slots"] = 64
+    h3 = codec._encode_solve_header(**recat)
+    assert segments.split_solve_header(h3).fingerprint != fp
+
+
+def test_node_churn_reships_a_small_fraction_of_segments():
+    """The delta property at the unit level: replacing ~1% of a few
+    hundred existing nodes dirties only their hash buckets — the changed
+    segments' bytes are a small fraction of the total."""
+    from tests.test_codec_roundtrip import sample_sim_node
+
+    pools = [make_nodepool()]
+    from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+
+    its = {"default": list(build_catalog(cpu_grid=[1, 2], mem_factors=[2]))}
+    nodes = [sample_sim_node(f"node-{i:04d}") for i in range(300)]
+    pods = [make_pod(cpu=0.5, name="p0")]
+    h1 = codec._encode_solve_header(pools, its, nodes, [], pods)
+    plan1 = segments.split_solve_header(h1)
+
+    churned = list(nodes)
+    for i in (7, 131, 288):  # ~1% replaced with fresh-named nodes
+        churned[i] = sample_sim_node(f"node-new-{i}")
+    h2 = codec._encode_solve_header(pools, its, churned, [], pods)
+    plan2 = segments.split_solve_header(h2)
+
+    changed = [d for d in plan2.segments if d not in plan1.segments]
+    total = plan2.raw_bytes()
+    shipped = plan2.raw_bytes(changed)
+    assert shipped < 0.15 * total, (shipped, total)
+    # the stable kinds share digests outright
+    assert plan2.catalog_digest == plan1.catalog_digest
+
+
+def test_request_digest_stable_across_upload_forms():
+    header = codec._encode_solve_header(**_sample_problem())
+    plan = segments.split_solve_header(header)
+    with_uploads = codec.encode_manifest_request(plan)
+    pure = codec.encode_manifest_request(plan, include=[])
+    assert (
+        codec.request_digest(with_uploads)
+        == codec.request_digest(pure)
+        == plan.core_digest
+    )
+    full = codec.encode_solve_request(**_sample_problem())
+    import hashlib
+
+    assert codec.request_digest(full) == hashlib.sha256(full).hexdigest()
+
+
+def test_decode_attaches_problem_scale_approx_bytes():
+    """The scheduler cache's byte-bound weight proxy must track the
+    PROBLEM's scale on both wire forms: a steady-state manifest body is a
+    few hundred bytes, and weighing cached DeviceSchedulers by it would
+    let N delta-wire tenants pin N full schedulers past --cache-mib."""
+    problem = _sample_problem()
+    full_body = codec.encode_solve_request(**problem)
+    full = codec.decode_solve_request(full_body)
+    assert full["approx_bytes"] == len(full_body)
+    plan = segments.split_solve_header(
+        codec._encode_solve_header(**problem)
+    )
+    man = codec.decode_solve_request(
+        codec.encode_manifest_request(plan),
+        segment_store=segments.SegmentStore(),
+    )
+    assert man["approx_bytes"] == plan.raw_bytes()
+    pure_manifest = codec.encode_manifest_request(plan, include=[])
+    assert man["approx_bytes"] > len(pure_manifest)
+
+
+def test_manifest_rejects_tampered_upload_and_bad_shapes():
+    header = codec._encode_solve_header(**_sample_problem())
+    plan = segments.split_solve_header(header)
+    dg = next(iter(plan.segments))
+    evil = segments.SegmentPlan(
+        plan.listing,
+        {**plan.segments, dg: plan.segments[dg] + b" "},
+        plan.inline, plan.pod_batch, plan.pod_member, plan.catalog_digest,
+    )
+    body = codec.encode_manifest_request(evil)
+    with pytest.raises(ValueError, match="does not hash"):
+        codec.decode_solve_request(
+            body, segment_store=segments.SegmentStore()
+        )
+    # a manifest without a configured store is a loud error, not a KeyError
+    with pytest.raises(ValueError, match="segment store"):
+        codec.decode_solve_request(codec.encode_manifest_request(plan))
+    # malformed listing rows are decode-net ValueErrors
+    with pytest.raises(ValueError):
+        segments.check_manifest_parts([["nodes"]], {})
+    with pytest.raises(ValueError):
+        segments.check_manifest_parts([["alien-kind", "d" * 64]], {})
+
+
+# ---------------------------------------------------------------------------
+# manifest-path vs full-path result-wire parity (the acceptance battery)
+# ---------------------------------------------------------------------------
+
+
+def _result_view(out: bytes) -> dict:
+    """The result wire minus its timing field — 'wire-identical results'
+    means identical placements/claims/evictions, not identical clocks."""
+    h = codec._json_header(out)
+    h.pop("solve_seconds", None)
+    return h
+
+
+def _assert_both_forms_identical(pools, its, existing, ds, pods, **kw):
+    full_body = codec.encode_solve_request(
+        pools, its, existing, ds, pods, **kw
+    )
+    header = codec._encode_solve_header(
+        pools, its, existing, ds, pods, **kw
+    )
+    plan = segments.split_solve_header(header)
+    out_full, _ = service.SolverDaemon().solve(full_body)
+    out_man, _ = service.SolverDaemon().solve(
+        codec.encode_manifest_request(plan)
+    )
+    assert _result_view(out_full) == _result_view(out_man)
+    return out_man
+
+
+@pytest.mark.parametrize("seed", range(14))
+def test_manifest_parity_all_fuzz_seeds(seed):
+    from tests.test_fuzz_parity import fuzz_scenario
+
+    pods, existing, pools, its = fuzz_scenario(seed)
+    _assert_both_forms_identical(
+        pools, its, existing, [], pods, max_slots=128
+    )
+
+
+def test_manifest_parity_with_topology_context():
+    problem = _sample_problem()
+    problem["pods"] = [make_pod(cpu=0.5, name=f"tp-{i}") for i in range(12)]
+    out = _assert_both_forms_identical(
+        problem["nodepools"], problem["instance_types"],
+        problem["existing_nodes"], problem["daemonset_pods"],
+        problem["pods"], topology=problem["topology"],
+        max_slots=problem["max_slots"],
+        unavailable_offerings=problem["unavailable_offerings"],
+    )
+    assert codec.decode_solve_results(out)["claims"]
+
+
+def test_manifest_parity_gang_mode():
+    from karpenter_core_tpu.solver import gangs
+
+    pools = [make_nodepool()]
+    from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+
+    its = {"default": list(build_catalog(cpu_grid=[2, 4], mem_factors=[2]))}
+    pods = []
+    for i in range(8):
+        p = make_pod(cpu=0.5, name=f"g-{i}")
+        p.metadata.annotations[gangs.GANG_ANNOTATION] = "gang-a"
+        p.metadata.annotations[gangs.GANG_MIN_SIZE_ANNOTATION] = "8"
+        pods.append(p)
+    pods += [make_pod(cpu=0.5, name=f"plain-{i}") for i in range(4)]
+    out = _assert_both_forms_identical(pools, its, [], [], pods)
+    res = codec.decode_solve_results(out)
+    placed = {u for c in res["claims"] for u in c["pod_uids"]}
+    gang_uids = {p.uid for p in pods[:8]}
+    # atomicity holds identically on both forms: all-or-nothing
+    assert gang_uids <= placed or not (gang_uids & placed)
+
+
+def test_manifest_parity_relax_mode():
+    from tests.test_relaxsolve import two_pool_world
+
+    pools, its = two_pool_world()
+    pods = [make_pod(cpu=0.5, name=f"r-{i}") for i in range(24)]
+    _assert_both_forms_identical(
+        pools, its, [], [], pods, solver_mode="relax"
+    )
+
+
+# ---------------------------------------------------------------------------
+# the miss / re-upload protocol
+# ---------------------------------------------------------------------------
+
+
+def _world(n_pods=12):
+    from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+
+    pools = [make_nodepool()]
+    its = {"default": list(build_catalog(cpu_grid=[1, 2, 4], mem_factors=[2]))}
+    pods = [make_pod(cpu=0.5, name=f"p-{i}") for i in range(n_pods)]
+    return pools, its, pods
+
+
+def _served(daemon=None):
+    srv = service.serve(0, daemon=daemon)
+    return srv, f"127.0.0.1:{srv.server_address[1]}"
+
+
+class TestMissProtocol:
+    def test_warm_resolve_ships_manifest_only(self):
+        pools, its, pods = _world()
+        srv, addr = _served()
+        try:
+            client = remote.SolverClient(addr, timeout=120)
+            rs = remote.RemoteScheduler(client, pools, its)
+            assert rs.solve(pods).all_pods_scheduled()
+            before = dict(m.SOLVER_SEGMENT_WIRE_BYTES.values)
+            assert rs.solve(pods).all_pods_scheduled()
+            after = dict(m.SOLVER_SEGMENT_WIRE_BYTES.values)
+            key_seg = (("kind", "segment"),)
+            key_man = (("kind", "manifest"),)
+            assert after.get(key_seg, 0) == before.get(key_seg, 0), (
+                "warm re-solve re-uploaded segments"
+            )
+            assert after.get(key_man, 0) > before.get(key_man, 0)
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_respawn_costs_one_reupload_not_a_fallback(self):
+        """The satellite bugfix contract: a sidecar restart (fresh store,
+        fresh instance id) surfaces as ONE typed miss + re-upload — the
+        breaker is never charged and the solve never degrades to greedy."""
+        pools, its, pods = _world()
+        srv, addr = _served()
+        try:
+            client = remote.SolverClient(addr, timeout=120)
+            rs = remote.RemoteScheduler(client, pools, its)
+            assert rs.solve(pods).all_pods_scheduled()
+            # "respawn": swap in a fresh store + instance id in place
+            d = srv.daemon_
+            d.segment_store = segments.SegmentStore()
+            d.instance = "respawned-0001"
+            fallbacks = m.SOLVER_RPC_FALLBACKS.value({"endpoint": "solve"})
+            before = dict(m.SOLVER_SEGMENT_WIRE_BYTES.values)
+            assert rs.solve(pods).all_pods_scheduled()
+            after = dict(m.SOLVER_SEGMENT_WIRE_BYTES.values)
+            assert client.breaker.state == remote.STATE_CLOSED
+            assert m.SOLVER_RPC_FALLBACKS.value(
+                {"endpoint": "solve"}
+            ) == fallbacks, "a segment miss must never degrade to greedy"
+            assert after.get((("kind", "segment"),), 0) > before.get(
+                (("kind", "segment"),), 0
+            ), "the re-upload round did not happen"
+            assert after.get((("kind", "full"),), 0) == before.get(
+                (("kind", "full"),), 0
+            ), "a one-round miss must not fall back to the full wire"
+            assert client.segcache.instance() == "respawned-0001"
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_unresolvable_store_falls_back_to_full_wire_never_greedy(self):
+        class AmnesiacStore(segments.SegmentStore):
+            """Accepts puts, remembers nothing — the pathological far
+            side that can never assemble a manifest."""
+
+            def get(self, digest):
+                return None
+
+        daemon = service.SolverDaemon(segment_store=AmnesiacStore())
+        srv, addr = _served(daemon)
+        try:
+            client = remote.SolverClient(addr, timeout=120)
+            rs = remote.RemoteScheduler(client, *_world()[:2])
+            pods = _world()[2]
+            fallbacks = m.SOLVER_RPC_FALLBACKS.value({"endpoint": "solve"})
+            before = dict(m.SOLVER_SEGMENT_WIRE_BYTES.values)
+            assert rs.solve(pods).all_pods_scheduled()
+            after = dict(m.SOLVER_SEGMENT_WIRE_BYTES.values)
+            assert after.get((("kind", "full"),), 0) > before.get(
+                (("kind", "full"),), 0
+            ), "second miss must degrade to the FULL wire"
+            assert m.SOLVER_RPC_FALLBACKS.value(
+                {"endpoint": "solve"}
+            ) == fallbacks
+            assert client.breaker.state == remote.STATE_CLOSED
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_store_eviction_on_live_instance_repairs_transparently(self):
+        """An undersized store evicts problem A's segments while problem
+        B solves; re-solving A hits the LIVE instance's typed miss and
+        repairs with one upload round — no full-wire fallback, no breaker
+        charge. (A store smaller than ONE problem's working set is the
+        pathological case the AmnesiacStore test covers: that degrades to
+        the full wire.)"""
+        from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+
+        # one _world problem occupies 5 store entries (nodepools, catalog,
+        # dspods, one pod batch, its listing blob); a 6-entry store holds
+        # one problem but never two, so solving B must evict part of A's
+        # set while A's shared segments (nodepools, dspods) survive
+        daemon = service.SolverDaemon(
+            segment_store=segments.SegmentStore(max_entries=6)
+        )
+        srv, addr = _served(daemon)
+        try:
+            client = remote.SolverClient(addr, timeout=120)
+            pools, its, pods = _world()
+            its_b = {
+                "default": list(
+                    build_catalog(cpu_grid=[2, 8], mem_factors=[4])
+                )
+            }
+            pods_b = [make_pod(cpu=1.0, name=f"b-{i}") for i in range(6)]
+            rs_a = remote.RemoteScheduler(client, pools, its)
+            rs_b = remote.RemoteScheduler(client, pools, its_b)
+            assert rs_a.solve(pods).all_pods_scheduled()
+            assert rs_b.solve(pods_b).all_pods_scheduled()  # evicts A's set
+            before = dict(m.SOLVER_SEGMENT_WIRE_BYTES.values)
+            assert rs_a.solve(pods).all_pods_scheduled()
+            after = dict(m.SOLVER_SEGMENT_WIRE_BYTES.values)
+            assert client.breaker.state == remote.STATE_CLOSED
+            assert after.get((("kind", "full"),), 0) == before.get(
+                (("kind", "full"),), 0
+            ), "a live-instance eviction miss must repair, not fall back"
+            assert after.get((("kind", "segment"),), 0) > before.get(
+                (("kind", "segment"),), 0
+            )
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_healthz_reports_instance_and_segment_stats(self):
+        srv, addr = _served()
+        try:
+            from urllib.request import urlopen
+
+            h = json.loads(
+                urlopen(f"http://{addr}/healthz", timeout=30).read()
+            )
+            assert h["instance"] == srv.daemon_.instance
+            assert {"entries", "bytes", "evictions"} <= set(h["segments"])
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_wire_mode_full_never_sends_manifests(self):
+        pools, its, pods = _world()
+        srv, addr = _served()
+        try:
+            client = remote.SolverClient(addr, timeout=120, wire_mode="full")
+            rs = remote.RemoteScheduler(client, pools, its)
+            before = dict(m.SOLVER_SEGMENT_WIRE_BYTES.values)
+            assert rs.solve(pods).all_pods_scheduled()
+            after = dict(m.SOLVER_SEGMENT_WIRE_BYTES.values)
+            assert after.get((("kind", "manifest"),), 0) == before.get(
+                (("kind", "manifest"),), 0
+            )
+            assert after.get((("kind", "full"),), 0) > before.get(
+                (("kind", "full"),), 0
+            )
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# fleet router
+# ---------------------------------------------------------------------------
+
+
+def _fake_members(n):
+    return [
+        remote.SolverClient(f"127.0.0.1:{9000 + i}", member=str(i))
+        for i in range(n)
+    ]
+
+
+class TestFleetRouter:
+    def test_affinity_is_deterministic_per_key(self):
+        router = remote.FleetRouter(_fake_members(4))
+        keys = [f"catalog-{i}" for i in range(32)]
+        first = {k: router._pick(k) for k in keys}
+        for _ in range(3):
+            assert {k: router._pick(k) for k in keys} == first
+        # a healthy fleet routes purely by affinity
+        assert set(router.snapshot()["routed"]) == {"affinity"}
+
+    def test_member_churn_remaps_only_the_dead_members_keys(self):
+        """The rendezvous property: opening ONE member's breaker remaps
+        exactly the keys it owned — every surviving member keeps its
+        warm-cache keys."""
+        router = remote.FleetRouter(_fake_members(4))
+        keys = [f"catalog-{i}" for i in range(64)]
+        before = {k: router._pick(k) for k in keys}
+        dead = before[keys[0]]
+        b = router.members[dead].breaker
+        b.state = remote.STATE_OPEN
+        b.opened_at = b.time_fn() + 10_000  # cooldown never elapses here
+        after = {k: router._pick(k) for k in keys}
+        for k in keys:
+            if before[k] == dead:
+                assert after[k] != dead
+            else:
+                assert after[k] == before[k], (
+                    "a surviving member lost an affinity key"
+                )
+        assert router.snapshot()["routed"].get("degraded", 0) > 0
+
+    def test_affinity_off_routes_least_loaded(self):
+        router = remote.FleetRouter(_fake_members(3), affinity=False)
+        picks = {router._pick("same-key") for _ in range(6)}
+        assert router.snapshot()["routed"] == {"spill": 6}
+        assert picks == {0}  # idle fleet: deterministic least-loaded tie
+
+    def test_spill_over_under_forced_drain(self):
+        pools, its, pods = _world()
+        srvs = [service.serve(0) for _ in range(2)]
+        try:
+            members = [
+                remote.SolverClient(
+                    f"127.0.0.1:{s.server_address[1]}",
+                    timeout=120, member=str(i),
+                )
+                for i, s in enumerate(srvs)
+            ]
+            router = remote.FleetRouter(members)
+            rs = remote.RemoteScheduler(router, pools, its)
+            assert rs.solve(pods).all_pods_scheduled()
+            served = next(
+                i for i, c in enumerate(members) if len(c.segcache) > 0
+            )
+            # drain the affinity member: the router must spill to the
+            # other, the solve must succeed, no breaker charge anywhere
+            srvs[served].daemon_.gateway.drain()
+            fallbacks = m.SOLVER_RPC_FALLBACKS.value({"endpoint": "solve"})
+            assert rs.solve(pods).all_pods_scheduled()
+            assert router.snapshot()["routed"].get("spill", 0) >= 1
+            assert m.SOLVER_RPC_FALLBACKS.value(
+                {"endpoint": "solve"}
+            ) == fallbacks
+            assert all(
+                c.breaker.state == remote.STATE_CLOSED for c in members
+            )
+            # aggregate health: one draining member, fleet still ready
+            h = router.health()
+            assert h["size"] == 2 and h["ready_members"] >= 1
+        finally:
+            for s in srvs:
+                s.shutdown()
+                s.server_close()
+
+    def test_router_duck_types_the_client_surface(self):
+        router = remote.FleetRouter(_fake_members(2), tenant="t")
+        assert router.tenant == "t"
+        assert router.wire_mode == "delta"
+        assert router.quarantine is router.members[0].quarantine
+        assert router.quarantine is router.members[1].quarantine
+        assert router.breaker is router.members[0].breaker  # pre-routing
+        with pytest.raises(ValueError):
+            remote.FleetRouter([])
+
+
+# ---------------------------------------------------------------------------
+# supervised fleet: kill/respawn + two operators x two sidecars
+# ---------------------------------------------------------------------------
+
+
+def _wait_respawn(sup, client_or_router, member=None, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        restarted = sup.poll()
+        if isinstance(restarted, list):
+            if restarted:
+                for i in restarted:
+                    client_or_router.set_member_addr(i, sup.addrs[i])
+                return True
+        elif restarted:
+            client_or_router.set_addr(sup.addr)
+            return True
+        time.sleep(0.1)
+    return False
+
+
+class TestFleetLifecycle:
+    def test_member_kill_respawn_costs_one_reupload_not_greedy(self):
+        """Kill/respawn regression (satellite): a REAL fleet-member
+        process dies and respawns; the next solve through the router pays
+        one miss/re-upload round — greedy fallbacks and the breaker both
+        stay untouched."""
+        from karpenter_core_tpu.solver.supervisor import SolverSupervisor
+
+        pools, its, pods = _world()
+        sup = SolverSupervisor(port=0, backoff_initial=0.05)
+        addr = sup.start()
+        try:
+            member = remote.SolverClient(addr, timeout=120, member="0")
+            router = remote.FleetRouter([member])
+            rs = remote.RemoteScheduler(router, pools, its)
+            assert rs.solve(pods).all_pods_scheduled()
+            inst_before = member.segcache.instance()
+            sup.proc.kill()
+            sup.proc.wait(timeout=15)
+            assert _wait_respawn(sup, router), "sidecar did not respawn"
+            fallbacks = m.SOLVER_RPC_FALLBACKS.value({"endpoint": "solve"})
+            before = dict(m.SOLVER_SEGMENT_WIRE_BYTES.values)
+            assert rs.solve(pods).all_pods_scheduled()
+            after = dict(m.SOLVER_SEGMENT_WIRE_BYTES.values)
+            assert m.SOLVER_RPC_FALLBACKS.value(
+                {"endpoint": "solve"}
+            ) == fallbacks, "restart cost a greedy fallback"
+            assert member.breaker.state == remote.STATE_CLOSED
+            assert after.get((("kind", "segment"),), 0) > before.get(
+                (("kind", "segment"),), 0
+            ), "restart did not cost the expected re-upload"
+            assert after.get((("kind", "full"),), 0) == before.get(
+                (("kind", "full"),), 0
+            )
+            assert member.segcache.instance() not in ("", inst_before)
+        finally:
+            sup.stop()
+
+
+def _operator(options_kw, catalog):
+    from karpenter_core_tpu.cloudprovider.kwok import KwokCloudProvider
+    from karpenter_core_tpu.kube.store import KubeStore
+    from karpenter_core_tpu.operator import Operator, Options
+    from karpenter_core_tpu.utils.clock import FakeClock as OpClock
+
+    clock = OpClock()
+    kube = KubeStore(clock)
+    return Operator(
+        kube=kube,
+        cloud_provider=KwokCloudProvider(kube, catalog),
+        clock=clock,
+        options=Options(solver="tpu", **options_kw),
+    )
+
+
+def _replicated(pod):
+    from karpenter_core_tpu.api.objects import OwnerReference
+
+    pod.metadata.owner_references.append(
+        OwnerReference(kind="ReplicaSet", name="rs", uid="rs-uid")
+    )
+    return pod
+
+
+def _battery(op, prefix):
+    op.kube.create(make_nodepool())
+    for i in range(3):
+        op.kube.create(_replicated(make_pod(cpu=1.5, name=f"{prefix}-p{i}")))
+    op.kube.create(_replicated(
+        make_pod(cpu=0.5, name=f"{prefix}-z0", zone_in=["zone-b"])
+    ))
+    op.run_until_idle(disrupt=False)
+    pods = op.kube.list_pods()
+    return {
+        "bound": sorted(p.metadata.name for p in pods if p.node_name),
+        "unbound": sorted(p.metadata.name for p in pods if not p.node_name),
+        "nodes": len(op.kube.list_nodes()),
+    }
+
+
+@pytest.mark.slow
+class TestTwoOperatorsTwoSidecars:
+    def test_two_operators_share_one_two_member_fleet(self):
+        """The fleet shape end-to-end: operator A spawns a 2-member fleet
+        (--solver-fleet=2); operator B (different catalog, different
+        tenant) points its router at the SAME two members via the
+        comma-list --solver-addr. Each tenant reaches its in-proc parity
+        through the shared fleet with zero greedy fallbacks, and the two
+        catalogs' affinity keys route independently."""
+        from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+
+        cat_a = build_catalog(cpu_grid=[1, 2, 4, 8], mem_factors=[2, 4])
+        cat_b = build_catalog(cpu_grid=[2, 4, 16], mem_factors=[4])
+        inproc_a = _battery(
+            _operator(dict(solver_mode="inproc"), cat_a), "a"
+        )
+        inproc_b = _battery(
+            _operator(dict(solver_mode="inproc"), cat_b), "b"
+        )
+        assert inproc_a["unbound"] == [] and inproc_b["unbound"] == []
+
+        op_a = _operator(
+            dict(
+                solver_mode="sidecar", solver_fleet=2,
+                solver_tenant="tenant-a",
+            ),
+            cat_a,
+        )
+        try:
+            from karpenter_core_tpu.solver.remote import FleetRouter
+            from karpenter_core_tpu.solver.supervisor import FleetSupervisor
+
+            assert isinstance(op_a.solver_supervisor, FleetSupervisor)
+            assert isinstance(op_a.solver_client, FleetRouter)
+            addrs = op_a.solver_supervisor.addrs
+            assert len(addrs) == 2 and addrs[0] != addrs[1]
+
+            op_b = _operator(
+                dict(
+                    solver_mode="sidecar",
+                    solver_addr=",".join(addrs),
+                    solver_tenant="tenant-b",
+                ),
+                cat_b,
+            )
+            assert op_b.solver_supervisor is None  # borrowed, not owned
+            assert isinstance(op_b.solver_client, FleetRouter)
+
+            fallbacks = m.SOLVER_RPC_FALLBACKS.value({"endpoint": "solve"})
+            remote_a = _battery(op_a, "a")
+            remote_b = _battery(op_b, "b")
+            assert remote_a == inproc_a
+            assert remote_b == inproc_b
+            assert m.SOLVER_RPC_FALLBACKS.value(
+                {"endpoint": "solve"}
+            ) == fallbacks
+            # both routers placed by affinity, and the fleet aggregate
+            # health sees two ready members
+            assert op_a.solver_client.snapshot()["routed"].get(
+                "affinity", 0
+            ) > 0
+            health = op_a.solver_client.health()
+            assert health["ready_members"] == 2
+        finally:
+            op_a.shutdown()
