@@ -25,6 +25,7 @@ the whole availability loop under test.
 """
 from __future__ import annotations
 
+import hashlib
 import random
 import time
 from typing import Dict, List, NamedTuple, Optional, Sequence
@@ -39,14 +40,28 @@ from karpenter_core_tpu.cloudprovider.types import (
 from karpenter_core_tpu.kube.store import ConflictError, TooManyRequestsError
 
 
+def fold_seed(seed: int, name: str) -> int:
+    """Fold a scenario seed with a stream name into an independent child
+    seed. sha256, not hash(): str hashing is salted per process
+    (PYTHONHASHSEED), and a fold that moves between runs would void the
+    identical-seed→identical-trace contract the twin's fuzzer shrinks
+    against."""
+    digest = hashlib.sha256(f"{seed}/{name}".encode()).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
 class ChaosSchedule:
-    """Deterministic fault source shared by both injectors.
+    """Deterministic fault source shared by every injector.
 
     ``script`` maps a seam name to a fault list consumed call-by-call
     (``"ok"`` entries pass through); once a seam's script is exhausted,
-    ``rates`` take over: ``{"<seam>.<fault>": probability}`` drawn from the
-    seeded PRNG in a fixed order, so the same seed replays the same
-    faults."""
+    ``rates`` take over: ``{"<seam>.<fault>": probability}`` drawn from a
+    PER-SEAM child PRNG (seed folded with the seam name), so the same seed
+    replays the same faults AND each seam's fault sequence is independent
+    of every other seam's draw count — removing one seam's faults (the
+    twin's shrinker dropping a fault class from a failing scenario) leaves
+    the remaining seams' sequences untouched, which is what makes
+    shrinking monotone instead of a reshuffle."""
 
     def __init__(
         self,
@@ -55,20 +70,36 @@ class ChaosSchedule:
         script: Optional[Dict[str, List[str]]] = None,
     ):
         self.seed = seed
-        self.rng = random.Random(seed)
         self.rates = dict(rates or {})
         self.script = {k: list(v) for k, v in (script or {}).items()}
         self.draws = 0
+        self.seam_draws: Dict[str, int] = {}
+        self._seam_rngs: Dict[str, random.Random] = {}
+
+    def _rng(self, seam: str) -> random.Random:
+        rng = self._seam_rngs.get(seam)
+        if rng is None:
+            rng = random.Random(fold_seed(self.seed, seam))
+            self._seam_rngs[seam] = rng
+        return rng
 
     def next_fault(self, seam: str, faults: Sequence[str]) -> str:
         self.draws += 1
+        self.seam_draws[seam] = self.seam_draws.get(seam, 0) + 1
         queued = self.script.get(seam)
         if queued:
             return queued.pop(0)
+        rng = None
         for fault in faults:
             rate = self.rates.get(f"{seam}.{fault}", 0.0)
-            if rate and self.rng.random() < rate:
-                return fault
+            if rate:
+                # one draw per CONFIGURED fault keeps a seam's sequence a
+                # pure function of (seed, seam, its own rate keys): faults
+                # of OTHER seams can come and go without shifting it
+                if rng is None:
+                    rng = self._rng(seam)
+                if rng.random() < rate:
+                    return fault
         return "ok"
 
 
@@ -159,7 +190,9 @@ class SolverChaos:
       valid wire whose content fails the client's ResultVerifier.
 
     Faults draw from the shared seeded ``ChaosSchedule`` (seam
-    ``solverd.solve``), so a soak replays identically per seed."""
+    ``solverd.solve`` by default; a fleet twin names one seam per member,
+    e.g. ``solverd.solve.m2``, so murdering one member's faults never
+    shifts its siblings' draws), so a soak replays identically per seed."""
 
     FAULTS = ("wedge", "crash", "corrupt_wire", "bad_result")
 
@@ -168,14 +201,16 @@ class SolverChaos:
         schedule: ChaosSchedule,
         wedge_seconds: float = 1.0,
         sleep=time.sleep,
+        seam: str = "solverd.solve",
     ):
         self.schedule = schedule
         self.wedge_seconds = wedge_seconds
         self.sleep = sleep
+        self.seam = seam
         self.injected: Dict[str, int] = {}
 
     def next_fault(self) -> str:
-        return self.schedule.next_fault("solverd.solve", self.FAULTS)
+        return self.schedule.next_fault(self.seam, self.FAULTS)
 
     def _count(self, fault: str) -> None:
         self.injected[fault] = self.injected.get(fault, 0) + 1

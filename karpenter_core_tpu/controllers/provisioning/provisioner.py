@@ -26,6 +26,12 @@ from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
 from karpenter_core_tpu.utils import pod as podutil
 from karpenter_core_tpu.utils import resources as resutil
 
+# how long an existing node stays disruption-protected after pods were
+# nominated onto it (statenode nomination TTL; the reference's
+# NominationWindow is batch-window-scaled — long enough for the binder's
+# conflict-retry loop, short enough not to park consolidation)
+NOMINATION_WINDOW = 30.0
+
 
 class Provisioner:
     def __init__(
@@ -40,6 +46,7 @@ class Provisioner:
         solver_client=None,
         unavailable_offerings=None,
         verify_results: bool = True,
+        nominated_pods=None,
     ):
         self.kube = kube
         self.cluster = cluster
@@ -66,11 +73,28 @@ class Provisioner:
         self.profile_solves = 0
         self.profile_dir = ""
         self._profiled = 0
+        # live-nomination view (the operator's binder ledger):
+        # {pod key -> target claim/node} for pods already promised
+        # capacity whose bind has not landed yet. Two obligations follow
+        # (both found by the digital twin's fuzzer under bind-conflict +
+        # launch-fault chaos, as capacity overcommits): (1) nominated
+        # pods must NOT re-enter the solve — re-placing one double-books
+        # the capacity its pending bind is about to take; (2) the solve's
+        # existing-node availability must SUBTRACT nominated-but-unbound
+        # pods, or other pods get packed into capacity a pending bind
+        # already owns. The reference prevents both with cluster-state
+        # pod nominations (scheduler.go Reserve + nomination TTLs).
+        self._nominated_pods = nominated_pods or (lambda: {})
 
     # -- input assembly ----------------------------------------------------
 
     def pending_pods(self) -> List[Pod]:
-        return [p for p in self.kube.list_pods() if podutil.is_provisionable(p)]
+        nominated = self._nominated_pods()
+        return [
+            p
+            for p in self.kube.list_pods()
+            if podutil.is_provisionable(p) and p.key() not in nominated
+        ]
 
     def deleting_node_pods(self) -> List[Pod]:
         """Reschedulable pods on deleting nodes re-enter the solve
@@ -159,6 +183,7 @@ class Provisioner:
             if n.name not in excluded_nodes
         ]
         self._attach_volume_state(sim_nodes)
+        self._reserve_nominated(sim_nodes)
         topology = Topology(
             domains=domain_universe(nodepools, instance_types, sim_nodes),
             existing_pods=[
@@ -261,6 +286,33 @@ class Provisioner:
             keep.append(p)
         return keep, errors
 
+    def _reserve_nominated(self, sim_nodes) -> None:
+        """Subtract nominated-but-unbound pods from their target node's
+        availability: capacity a pending bind owns is not free. Pods
+        nominated to an UNREGISTERED claim have no sim node yet and need
+        no reservation — the claim's capacity only becomes a solve
+        target after registration, and the binder lands (or prunes) the
+        nominations earlier in that same pass."""
+        nominated = self._nominated_pods()
+        if not nominated:
+            return
+        pending_by_node: Dict[str, List[Pod]] = {}
+        for key in sorted(nominated):
+            ns, _, name = key.partition("/")
+            pod = self.kube.get(Pod, name, ns)
+            if pod is None or pod.node_name:
+                continue  # gone, or the bind already landed
+            pending_by_node.setdefault(nominated[key], []).append(pod)
+        for sim in sim_nodes:
+            pending = pending_by_node.get(sim.name)
+            if not pending:
+                continue
+            # requests_for_pods already folds in the implicit 'pods'
+            # count resource, so ONE subtract covers cpu/memory/slots
+            sim.available = resutil.subtract(
+                sim.available, resutil.requests_for_pods(*pending)
+            )
+
     def _attach_volume_state(self, sim_nodes) -> None:
         """Per-node CSINode limits + bound pods' volume usage
         (statenode volume tracking, volumeusage.go Add/AddLimit)."""
@@ -302,6 +354,14 @@ class Provisioner:
         for sim in results.existing_nodes:
             for p in sim.pods:
                 nominations[p.key()] = sim.name
+            if sim.pods:
+                # protect the node from disruption while the binds land
+                # (StateNode.nominated gates candidacy, disruption/types
+                # .py; the reference's NominateNodeEvent + TTL — this was
+                # the dormant half of that contract)
+                self.cluster.nominate_node(
+                    sim.name, self.clock.now() + NOMINATION_WINDOW
+                )
         if self.recorder is not None and nominations:
             from karpenter_core_tpu.events import Event
 

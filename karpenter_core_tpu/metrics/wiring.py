@@ -213,6 +213,14 @@ SOLVERD_RESTARTS = REGISTRY.counter(
     " drain (a clean drain-exit — the child flushed its queue and asked to"
     " be restarted; respawns immediately, never charges backoff)",
 )
+SOLVERD_RESPAWN_STORM = REGISTRY.gauge(
+    "solverd_respawn_storm",
+    "1 while a supervised sidecar member exceeded the respawn-storm"
+    " threshold inside the sliding window (member-labeled): crash-only"
+    " churn is routine and rides solverd_restarts_total, but a member"
+    " respawning this often is MELTING — readyz degrades while the storm"
+    " holds so probes and the digital twin can tell the two apart",
+)
 SOLVER_RESULT_REJECTED = REGISTRY.counter(
     "solver_result_rejected_total",
     "Solve results that failed host-side verification (solver/verify.py),"

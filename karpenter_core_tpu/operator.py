@@ -360,6 +360,7 @@ class Operator:
         clock: Optional[Clock] = None,
         options: Optional[Options] = None,
         instance_types=None,
+        solver_client=None,
     ):
         self.clock = clock or Clock()
         # object timestamps (creation, condition transitions) follow the
@@ -404,7 +405,18 @@ class Operator:
         # fault-tolerant RPC client the provisioner routes solves through
         self.solver_supervisor = None
         self.solver_client = None
-        if self.options.solver == "tpu" and self.options.solver_mode == "sidecar":
+        if solver_client is not None:
+            # injection seam (the digital twin, twin/harness.py): the
+            # caller owns the client/router — typically one whose breaker
+            # cooldowns, retry sleeps and quarantine TTLs ride a VIRTUAL
+            # clock so days of fleet churn replay deterministically in
+            # minutes — and the tier it points at, so no supervisor spawns
+            if self.options.solver_mode != "sidecar":
+                raise ValueError(
+                    "solver_client injection requires solver_mode=sidecar"
+                )
+            self.solver_client = solver_client
+        elif self.options.solver == "tpu" and self.options.solver_mode == "sidecar":
             from karpenter_core_tpu.solver.remote import (
                 FleetRouter,
                 SolverClient,
@@ -523,6 +535,10 @@ class Operator:
             solver_client=self.solver_client,
             unavailable_offerings=self.unavailable_offerings,
             verify_results=self.options.solver_verify,
+            # pods already promised capacity by an in-flight nomination
+            # must not re-enter the solve (the bind-conflict double-book
+            # the twin's fuzzer found — see Provisioner._nominated_pods)
+            nominated_pods=self._nominated_pod_keys,
         )
         self.provisioner.profile_solves = self.options.profile_solves
         self.provisioner.profile_dir = self.options.profile_dir
@@ -595,6 +611,18 @@ class Operator:
         # or readyz would report a crash-loop forever with nothing failing
         self._pass_seen: set = set()
 
+    def _nominated_pod_keys(self) -> Dict[str, str]:
+        """{pod key -> target} for LIVE nominations (binder ledger): the
+        binder prunes dead targets every pass BEFORE provisioning runs,
+        so a claim that died returns its pods to the solve the same
+        pass. The provisioner excludes these pods from the solve AND
+        reserves their capacity on the target node."""
+        return {
+            key: target
+            for target, keys in self.nominations.items()
+            for key in keys
+        }
+
     def _trigger_on_pod(self, event: str, kind: str, obj) -> None:
         if kind != "Pod" or event == "DELETED":
             return
@@ -654,6 +682,14 @@ class Operator:
         if any(
             fault[2] >= CRASHLOOP_THRESHOLD
             for fault in self._controller_faults.values()
+        ):
+            return False
+        # a solverd member respawning past the storm threshold means the
+        # device tier is melting (supervisor.RESPAWN_STORM_*): solves
+        # still degrade to greedy, but the probe surface must say degraded
+        if (
+            self.solver_supervisor is not None
+            and self.solver_supervisor.respawn_storm()
         ):
             return False
         return self.cluster.synced()
@@ -880,6 +916,9 @@ class Operator:
                 waits.append(self.reconcile_backoff_wait_remaining())
                 if disrupt:
                     waits.append(self.disruption.validation_wait_remaining())
+                    # node-nomination TTLs gate disruption candidacy the
+                    # same way the validation TTL gates commands
+                    waits.append(self.cluster.nomination_wait_remaining())
                 waits = [w for w in waits if w > 0]
                 if waits and hasattr(self.clock, "step"):
                     # fire the nearest timer first (batch close / TTL elapse)
@@ -918,3 +957,8 @@ class Operator:
                     continue  # deleted or already bound elsewhere
                 self.kube.bind(pod, node.name)
             del self.nominations[target]
+            # every nominated bind landed: release the node's disruption
+            # protection now instead of waiting out the TTL backstop (a
+            # bind that CONFLICTED raised above, keeping entry AND
+            # nomination alive for the retry)
+            self.cluster.clear_node_nomination(node.name)

@@ -41,6 +41,13 @@ DRAIN_STREAK_CAP = 3
 # supervisor's drain() wait is sized PAST it + the exit grace, so a drain
 # that succeeds at the deadline is never misreported as a failure
 DRAIN_EXIT_DEADLINE_SECONDS = 30.0
+# respawn-storm alarm: a member that respawns more than STORM_THRESHOLD
+# times inside a sliding STORM_WINDOW is MELTING, not crash-only-churning
+# — the backoff keeps the operator responsive, but readyz must say the
+# tier is degraded (the digital twin and production probes both key on
+# it: routine churn is a counter, a storm is an alarm)
+RESPAWN_STORM_WINDOW = 600.0
+RESPAWN_STORM_THRESHOLD = 5
 
 
 def default_command(
@@ -131,6 +138,9 @@ class SolverSupervisor:
         spawn_timeout: float = 60.0,
         time_fn=time.monotonic,
         on_event: Optional[Callable[[str, str], None]] = None,
+        storm_window: float = RESPAWN_STORM_WINDOW,
+        storm_threshold: int = RESPAWN_STORM_THRESHOLD,
+        member: str = "0",
     ):
         self.command = command or default_command(
             port, prewarm, profile_dir,
@@ -176,6 +186,13 @@ class SolverSupervisor:
         # no-backoff path into a respawn storm; past the streak cap it is
         # treated as a crash
         self._drain_streak = 0
+        # respawn-storm alarm state: timestamps of recent respawns inside
+        # the sliding window; `member` labels the gauge so a fleet
+        # dashboard sees WHICH member is melting
+        self.storm_window = storm_window
+        self.storm_threshold = storm_threshold
+        self.member = member
+        self._respawn_times: List[float] = []
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -290,11 +307,42 @@ class SolverSupervisor:
 
         m.SOLVERD_RESTARTS.inc({"cause": self._exit_cause})
         self.restarts += 1
+        self._note_respawn(self.time_fn())
         self._down_since = None
         self._emit(
             "SidecarRestarted", f"solver sidecar respawned on {self.addr}"
         )
         return True
+
+    # -- respawn-storm alarm ----------------------------------------------
+
+    def _note_respawn(self, now: float) -> None:
+        """Record one respawn in the sliding storm window and export the
+        alarm gauge; the accounting is separate from _spawn so a fake
+        clock can drive it without subprocesses."""
+        self._respawn_times.append(now)
+        self._prune_storm(now)
+        self._export_storm()
+
+    def _prune_storm(self, now: float) -> None:
+        cutoff = now - self.storm_window
+        self._respawn_times = [t for t in self._respawn_times if t > cutoff]
+
+    def respawn_storm(self) -> bool:
+        """True while this member exceeded storm_threshold respawns inside
+        the sliding storm_window — the tier is melting, not churning;
+        readyz() degrades on it and solverd_respawn_storm exports it."""
+        self._prune_storm(self.time_fn())
+        self._export_storm()
+        return len(self._respawn_times) > self.storm_threshold
+
+    def _export_storm(self) -> None:
+        from karpenter_core_tpu.metrics import wiring as m
+
+        m.SOLVERD_RESPAWN_STORM.set(
+            1.0 if len(self._respawn_times) > self.storm_threshold else 0.0,
+            {"member": self.member},
+        )
 
     def drain(
         self, timeout: float = DRAIN_EXIT_DEADLINE_SECONDS + 15.0
@@ -373,7 +421,9 @@ class FleetSupervisor:
         self.on_event = on_event
         factory = supervisor_factory or SolverSupervisor
         self.members: List[SolverSupervisor] = [
-            factory(on_event=self._member_event(i), **child_kwargs)
+            factory(
+                on_event=self._member_event(i), member=str(i), **child_kwargs
+            )
             for i in range(n)
         ]
 
@@ -403,6 +453,14 @@ class FleetSupervisor:
         its crash backoff simply stays down this pass — the router keeps
         serving from the rest."""
         return [i for i, m in enumerate(self.members) if m.poll()]
+
+    def respawn_storm(self) -> bool:
+        """True while ANY member is inside a respawn storm (the operator's
+        readyz degrades on it; per-member detail rides the member-labeled
+        solverd_respawn_storm gauge)."""
+        # evaluate every member (not any()'s short-circuit) so each one's
+        # gauge series stays current
+        return any([m.respawn_storm() for m in self.members])
 
     def drain(self, i: int, **kwargs) -> bool:
         """Drain ONE member (rolling restarts: drain, poll-respawn,

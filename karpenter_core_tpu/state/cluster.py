@@ -276,6 +276,39 @@ class Cluster:
             if n == node_name and k in self._pods
         ]
 
+    def nominate_node(self, node_name: str, until: float) -> None:
+        """Mark the named node nominated (pending binds en route): the
+        disruption candidate filter skips it until the TTL elapses
+        (disruption/types.py; statenode nomination — the provisioner
+        calls this for every existing-node placement it returns)."""
+        for sn in self.state_nodes.values():
+            if sn.name == node_name:
+                sn.nominate(max(until, sn.nominated_until))
+                return
+
+    def clear_node_nomination(self, node_name: str) -> None:
+        """Drop the named node's nomination early: the binder calls this
+        once EVERY pod nominated onto the node has bound — the
+        protection window has served its purpose, and consolidation
+        should not wait out the TTL backstop."""
+        for sn in self.state_nodes.values():
+            if sn.name == node_name:
+                sn.nominated_until = 0.0
+                return
+
+    def nomination_wait_remaining(self) -> float:
+        """Seconds until the nearest node-nomination TTL lapses (0 when
+        none): a fake-clock driver (run_until_idle, the twin) elapses it
+        like the batcher/backoff/validation timers so consolidation is
+        dampened by the window, never parked behind it."""
+        now = self.clock.now()
+        waits = [
+            sn.nominated_until - now
+            for sn in self.state_nodes.values()
+            if sn.nominated_until > now
+        ]
+        return min(waits) if waits else 0.0
+
     # -- consolidation bookkeeping (cluster.go:397-423) --------------------
 
     def mark_unconsolidated(self) -> None:

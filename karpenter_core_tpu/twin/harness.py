@@ -1,0 +1,588 @@
+"""DigitalTwin: N simulated clusters + one solverd tier, one virtual
+timeline, every fault seam scripted — the closed loop, compressed.
+
+Each cluster is a full ``Operator`` (its own KubeStore, kwok provider
+with a DISTINCT catalog, chaos-wrapped kube/cloud seams) sharing one
+``VirtualClock``; with ``scenario.fleet`` > 0 the solve path runs through
+a REAL fleetd tier — in-thread solverd daemons behind HTTP, each
+operator's ``FleetRouter`` doing digest-affinity placement over them —
+whose client-side state (breaker cooldowns, retry sleeps, quarantine
+TTLs) rides the same virtual clock via the operator's ``solver_client``
+injection seam. Fleet-level faults compose on top of the chaos harness:
+
+* ``murder``    — a member's server is torn down (transport dies under
+  the client), respawning one tick later with a fresh daemon: empty
+  segment store, cold caches, new instance id — the client must pay one
+  miss/re-upload round and nothing else;
+* ``partition`` — an operator's view of the whole tier fails as
+  transport faults for a window (degrade-to-greedy, quarantine strikes,
+  never a lost pod);
+* ``amnesia``   — a member's segment store is swapped empty in place.
+
+Determinism contract: identical (seed, scenario) → byte-identical event
+trace and ledger JSON. Everything that could differ between two runs of
+one process — claim-name and uid counters, ephemeral port numbers,
+process-global metric absolutes — is reset, scrubbed, or delta'd.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_core_tpu.api.nodepool import NodePool, NodePoolSpec
+from karpenter_core_tpu.api.objects import ObjectMeta, Pod
+from karpenter_core_tpu.chaos import (
+    ChaosCloudProvider,
+    ChaosKubeClient,
+    ChaosSchedule,
+    IceStorm,
+    fold_seed,
+)
+from karpenter_core_tpu.cloudprovider.kwok import KwokCloudProvider, build_catalog
+from karpenter_core_tpu.cloudprovider.types import OfferingKey
+from karpenter_core_tpu.kube.store import KubeStore
+from karpenter_core_tpu.operator import Operator, Options
+from karpenter_core_tpu.twin import workloads
+from karpenter_core_tpu.twin.clock import VirtualClock
+from karpenter_core_tpu.twin.invariants import InvariantMonitor, Violation
+from karpenter_core_tpu.twin.ledger import Ledger, price_index
+from karpenter_core_tpu.twin.scenario import (
+    Scenario,
+    canonical_scenario,
+    scenario_fingerprint,
+    validate_scenario,
+    wave_ids,
+)
+
+# every twin run starts its virtual timeline here (FakeClock's epoch):
+# absolute virtual timestamps are deterministic because the origin is
+TWIN_EPOCH = 1_000_000.0
+
+# ephemeral ports differ between runs; the trace must not
+_PORT_RE = re.compile(r"127\.0\.0\.1:\d+")
+
+
+def _scrub(text: str) -> str:
+    return _PORT_RE.sub("127.0.0.1:<port>", text)
+
+
+def _counter_total(counter) -> float:
+    return sum(counter.values.values())
+
+
+def _metric_snapshot() -> Dict[str, float]:
+    from karpenter_core_tpu.metrics import wiring as m
+
+    return {
+        "rpc_fallbacks": _counter_total(m.SOLVER_RPC_FALLBACKS),
+        "result_rejected": _counter_total(m.SOLVER_RESULT_REJECTED),
+        "host_fallback_pods": _counter_total(m.SOLVER_HOST_FALLBACK_PODS),
+        "preemption_evictions": _counter_total(m.SOLVER_PREEMPTION_EVICTIONS),
+    }
+
+
+def _reset_identity_counters() -> None:
+    """Claim names and object uids draw from process-global counters; two
+    runs of one scenario in one process must mint identical identities
+    (the test_chaos _reset_claim_counter precedent, widened)."""
+    from karpenter_core_tpu.api import objects as apiobjects
+    from karpenter_core_tpu.controllers.provisioning.scheduling import (
+        nodeclaimtemplate,
+    )
+
+    apiobjects._uid_counter = itertools.count(1)
+    nodeclaimtemplate._claim_counter = itertools.count(1)
+
+
+def cluster_catalog(i: int):
+    """Distinct per-cluster instance catalogs (different cpu grids and
+    memory families), so the tier's prepared-state caches and the delta
+    wire's segment stores see N genuinely different problem halves."""
+    grids = ([1, 2, 4, 8, 16], [2, 4, 8, 16, 32], [1, 2, 4, 8, 16, 32])
+    mems = ([2, 4], [4, 8], [2, 8])
+    return build_catalog(
+        cpu_grid=list(grids[i % 3]), mem_factors=list(mems[i % 3])
+    )
+
+
+@dataclass
+class TwinResult:
+    scenario: Scenario
+    fingerprint: str
+    violations: List[Violation]
+    ledger: Ledger
+    trace: List[tuple]
+    counters: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def trace_json(self) -> str:
+        return json.dumps(
+            [list(entry) for entry in self.trace], separators=(",", ":")
+        )
+
+    def ledger_json(self) -> str:
+        return self.ledger.to_json()
+
+    def first_violation(self) -> Optional[Violation]:
+        return self.violations[0] if self.violations else None
+
+
+class _FleetTier:
+    """The shared solverd tier: in-thread daemons behind real HTTP, plus
+    the murder/respawn/amnesia machinery. In-thread (not subprocess) so a
+    tier-1 twin run costs no spawn latency and stays deterministic; the
+    transport, codec, gateway and caches are the production objects."""
+
+    def __init__(self, n: int, vclock: VirtualClock):
+        from karpenter_core_tpu.solver import fleet as fleetmod
+        from karpenter_core_tpu.solver import service
+
+        self._fleetmod = fleetmod
+        self._service = service
+        self.vclock = vclock
+        self.daemons: List = []
+        self.servers: List = []
+        self.addrs: List[str] = []
+        self.member_solves: Dict[int, int] = {}
+        for _ in range(n):
+            daemon, srv, addr = self._spawn()
+            self.daemons.append(daemon)
+            self.servers.append(srv)
+            self.addrs.append(addr)
+
+    def _spawn(self):
+        daemon = self._service.SolverDaemon(
+            quarantine=self._fleetmod.PoisonQuarantine(
+                site="gateway", time_fn=self.vclock.monotonic
+            ),
+        )
+        srv = self._service.serve(0, daemon=daemon)
+        addr = f"127.0.0.1:{srv.server_address[1]}"
+        return daemon, srv, addr
+
+    def murder(self, i: int) -> None:
+        """Tear the member down: its socket closes under any client."""
+        self._bank_solves(i)
+        self.servers[i].shutdown()
+        self.servers[i].server_close()
+        self.servers[i] = None
+
+    def respawn(self, i: int, routers: List) -> None:
+        """Fresh daemon (empty segment store, cold caches, new instance
+        id) on a fresh port; every operator's router re-points, exactly
+        as reconcile_once does after a FleetSupervisor restart."""
+        daemon, srv, addr = self._spawn()
+        self.daemons[i] = daemon
+        self.servers[i] = srv
+        self.addrs[i] = addr
+        for router in routers:
+            router.set_member_addr(i, addr)
+
+    def amnesia(self, i: int) -> None:
+        from karpenter_core_tpu.solver import segments
+
+        self.daemons[i].segment_store = segments.SegmentStore()
+
+    def _bank_solves(self, i: int) -> None:
+        self.member_solves[i] = (
+            self.member_solves.get(i, 0) + self.daemons[i].solves
+        )
+
+    def utilization(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for i, daemon in enumerate(self.daemons):
+            out[str(i)] = self.member_solves.get(i, 0) + (
+                daemon.solves if self.servers[i] is not None else 0
+            )
+        return out
+
+    def stop(self) -> None:
+        for srv in self.servers:
+            if srv is not None:
+                srv.shutdown()
+                srv.server_close()
+
+
+class DigitalTwin:
+    def __init__(self, scenario: Scenario, reconcile_iters: int = 300):
+        validate_scenario(scenario)
+        # canonical collection order: constructions that share a
+        # fingerprint (the encoder sorts) must also share a run
+        self.scenario = canonical_scenario(scenario)
+        self.reconcile_iters = reconcile_iters
+
+    # -- construction ------------------------------------------------------
+
+    def _make_router(self, cluster: int, tier: _FleetTier, vclock):
+        from karpenter_core_tpu.solver.fleet import PoisonQuarantine
+        from karpenter_core_tpu.solver.remote import FleetRouter, SolverClient
+
+        members = []
+        for j, addr in enumerate(tier.addrs):
+            client = SolverClient(
+                addr,
+                timeout=30.0,
+                tenant=f"c{cluster}",
+                wire_mode=self.scenario.wire,
+                member=str(j) if len(tier.addrs) > 1 else "",
+                sleep=vclock.sleep,
+            )
+            # the client's fault-tolerance state rides VIRTUAL time: a
+            # breaker cooldown or quarantine TTL elapses with the
+            # scenario, not with the wall — days of churn in minutes
+            client.breaker.time_fn = vclock.monotonic
+            members.append(client)
+        router = FleetRouter(
+            members,
+            tenant=f"c{cluster}",
+            quarantine=PoisonQuarantine(
+                site="client", time_fn=vclock.monotonic
+            ),
+        )
+        self._install_partition_gate(cluster, members)
+        return router
+
+    def _install_partition_gate(self, cluster: int, members) -> None:
+        from karpenter_core_tpu.solver.remote import RemoteSolverError
+
+        def active() -> bool:
+            offset = self._vclock.now() - TWIN_EPOCH
+            for fault in self.scenario.fleet_faults:
+                if fault.kind != "partition":
+                    continue
+                if fault.cluster not in (-1, cluster):
+                    continue
+                if fault.at <= offset < fault.at + fault.duration:
+                    return True
+            return False
+
+        for client in members:
+            orig = client.call
+
+            def gated(*args, _orig=orig, **kwargs):
+                if active():
+                    raise RemoteSolverError(
+                        "error", "twin: operator-fleet partition window"
+                    )
+                return _orig(*args, **kwargs)
+
+            client.call = gated
+
+    def _make_operator(
+        self, cluster: int, vclock, tier: Optional[_FleetTier]
+    ) -> Tuple[Operator, KubeStore, ChaosSchedule]:
+        s = self.scenario
+        catalog = cluster_catalog(cluster)
+        schedule = ChaosSchedule(
+            seed=fold_seed(s.seed, f"cluster{cluster}"),
+            rates=dict(s.rates),
+        )
+        store = KubeStore(vclock)
+        storms = []
+        for storm in s.storms:
+            if storm.cluster not in (-1, cluster):
+                continue
+            storms.append(IceStorm(
+                start=TWIN_EPOCH + storm.start,
+                duration=storm.duration,
+                offerings=tuple(
+                    OfferingKey(it.name, zone, ct)
+                    for it in catalog[: storm.head]
+                    for zone in storm.zones
+                    for ct in storm.capacity_types
+                ),
+            ))
+        provider = ChaosCloudProvider(
+            KwokCloudProvider(store, catalog),
+            schedule,
+            storms=storms,
+            clock=vclock,
+        )
+        kube = ChaosKubeClient(store, schedule)
+        if tier is not None:
+            options = Options(
+                solver="tpu",
+                solver_mode="sidecar",
+                solver_tenant=f"c{cluster}",
+                solver_wire=s.wire,
+            )
+            client = self._make_router(cluster, tier, vclock)
+        else:
+            options = Options(solver=s.solver)
+            client = None
+        op = Operator(
+            kube=kube,
+            cloud_provider=provider,
+            clock=vclock,
+            options=options,
+            solver_client=client,
+        )
+        pool = NodePool(metadata=ObjectMeta(name="default"))
+        pool.spec = NodePoolSpec()
+        store.create(pool)
+        return op, store, schedule
+
+    # -- the run -----------------------------------------------------------
+
+    def run(self) -> TwinResult:
+        s = self.scenario
+        _reset_identity_counters()
+        vclock = VirtualClock(TWIN_EPOCH)
+        self._vclock = vclock
+        tier = _FleetTier(s.fleet, vclock) if s.fleet else None
+        notes: List[tuple] = []
+        note_seq = itertools.count()
+
+        def note(kind: str, detail: str) -> None:
+            notes.append((
+                round(vclock.now() - TWIN_EPOCH, 3),
+                "twin",
+                next(note_seq),
+                kind,
+                _scrub(detail),
+            ))
+
+        operators: List[Operator] = []
+        stores: List[KubeStore] = []
+        schedules: List[ChaosSchedule] = []
+        routers: List = []
+        try:
+            for i in range(s.clusters):
+                op, store, schedule = self._make_operator(i, vclock, tier)
+                operators.append(op)
+                stores.append(store)
+                schedules.append(schedule)
+                if tier is not None:
+                    routers.append(op.solver_client)
+
+            price_indices = {
+                i: price_index(cluster_catalog(i)) for i in range(s.clusters)
+            }
+            monitor = InvariantMonitor(max_pending=s.max_pending)
+            ledger = Ledger()
+            baseline = _metric_snapshot()
+            expected: Dict[int, Dict[str, Pod]] = {
+                i: {} for i in range(s.clusters)
+            }
+            wave_names: Dict[str, List[str]] = {}
+            bound_seen: Dict[int, set] = {i: set() for i in range(s.clusters)}
+            active_partitions: set = set()
+            down_members: Dict[int, float] = {}  # member -> respawn due at
+
+            # the timeline: (due offset, kind order, seq) -> action.
+            # Wave identity is CONTENT-derived (scenario.wave_ids): pod
+            # names/RNG streams survive sibling waves being dropped or
+            # reordered
+            ids = wave_ids(s.waves)
+            events: List[tuple] = []
+            for wi, wave in enumerate(s.waves):
+                events.append((wave.at, 0, wi, "wave", wave))
+                if wave.lifetime > 0:
+                    events.append(
+                        (wave.at + wave.lifetime, 1, wi, "delete_wave", wave)
+                    )
+            for fi, fault in enumerate(s.fleet_faults):
+                if fault.kind in ("murder", "amnesia"):
+                    events.append((fault.at, 2, fi, fault.kind, fault))
+            for hi, hook in enumerate(s.hooks):
+                events.append((hook.at, 3, hi, hook.kind, hook))
+            events.sort(key=lambda e: e[:3])
+            cursor = 0
+
+            n_ticks = max(int(-(-s.duration // s.tick)), 1)
+            prev_t = 0.0
+            for k in range(1, n_ticks + 1):
+                t = min(k * s.tick, s.duration)
+                vclock.advance_to(TWIN_EPOCH + t)
+                # respawn members whose murder window elapsed
+                for member in sorted(down_members):
+                    if down_members[member] <= t:
+                        tier.respawn(member, routers)
+                        del down_members[member]
+                        note("respawn", f"fleet member {member} respawned")
+                # apply everything due by this tick
+                while cursor < len(events) and events[cursor][0] <= t:
+                    _, _, idx, kind, payload = events[cursor]
+                    cursor += 1
+                    if kind == "wave":
+                        self._apply_wave(
+                            payload, ids[idx], stores, expected, wave_names
+                        )
+                        note("wave", (
+                            f"cluster {payload.cluster}: {payload.kind}"
+                            f" wave {ids[idx]} x{payload.count}"
+                        ))
+                    elif kind == "delete_wave":
+                        self._delete_wave(
+                            payload, ids[idx], stores, expected, wave_names
+                        )
+                        note("delete_wave", (
+                            f"cluster {payload.cluster}: wave {ids[idx]}"
+                            " retired"
+                        ))
+                    elif kind == "murder":
+                        if payload.member not in down_members:
+                            tier.murder(payload.member)
+                            down_members[payload.member] = t + s.tick
+                            note("murder", (
+                                f"fleet member {payload.member} murdered"
+                            ))
+                    elif kind == "amnesia":
+                        if payload.member not in down_members:
+                            tier.amnesia(payload.member)
+                            note("amnesia", (
+                                f"fleet member {payload.member} segment"
+                                " store wiped"
+                            ))
+                    elif kind == "lose_bound_pod":
+                        self._apply_lose_pod(payload, stores, expected, note)
+                # partition window edges, at tick granularity
+                now_active = set()
+                for fi, fault in enumerate(s.fleet_faults):
+                    if fault.kind != "partition":
+                        continue
+                    if fault.at <= t < fault.at + fault.duration:
+                        now_active.add(fi)
+                for fi in sorted(now_active - active_partitions):
+                    note("partition_start", (
+                        f"cluster {s.fleet_faults[fi].cluster} partitioned"
+                        " from the fleet"
+                    ))
+                for fi in sorted(active_partitions - now_active):
+                    note("partition_end", "partition healed")
+                active_partitions = now_active
+
+                # one closed-loop settle per cluster
+                for op in operators:
+                    op.run_until_idle(max_iters=self.reconcile_iters)
+
+                # SLO accounting: first tick each expected pod shows bound
+                for i, op in enumerate(operators):
+                    live = expected[i]
+                    for name in sorted(live):
+                        if name in bound_seen[i]:
+                            continue
+                        pod = op.kube.get(Pod, name)
+                        if pod is None or not pod.node_name:
+                            continue
+                        bound_seen[i].add(name)
+                        latency = (
+                            vclock.now() - pod.metadata.creation_timestamp
+                        )
+                        ledger.record_bind(
+                            workloads.workload_class(pod), latency
+                        )
+                        if latency > s.max_pending:
+                            ledger.slo_misses += 1
+
+                monitor.check(vclock.now(), operators, expected)
+                ledger.sample(t - prev_t, operators, price_indices)
+                prev_t = t
+
+            after = _metric_snapshot()
+            delta = {
+                key: after[key] - baseline[key] for key in sorted(baseline)
+            }
+            ledger.preemption_evictions = int(delta["preemption_evictions"])
+            ledger.utilization = {
+                "chaos_draws": {
+                    str(i): schedules[i].draws for i in range(s.clusters)
+                },
+                # faults that actually FIRED (draws count every call,
+                # faulted or ok — a non-vacuousness gate needs these)
+                "chaos_injected": {
+                    str(i): (
+                        sum(operators[i].kube.injected.values())
+                        + sum(
+                            operators[i].cloud_provider.injected.values()
+                        )
+                    )
+                    for i in range(s.clusters)
+                },
+                "rpc_fallbacks": delta["rpc_fallbacks"],
+                "host_fallback_pods": delta["host_fallback_pods"],
+            }
+            if tier is not None:
+                ledger.utilization["member_solves"] = tier.utilization()
+
+            trace = self._merge_trace(notes, operators)
+            return TwinResult(
+                scenario=s,
+                fingerprint=scenario_fingerprint(s),
+                violations=list(monitor.violations),
+                ledger=ledger,
+                trace=trace,
+                counters=delta,
+            )
+        finally:
+            for op in operators:
+                op.shutdown()
+            if tier is not None:
+                tier.stop()
+
+    # -- event application -------------------------------------------------
+
+    def _apply_wave(self, wave, wave_id, stores, expected, wave_names):
+        pods, pdbs = workloads.pods_for_wave(
+            wave, wave_id, self.scenario.seed
+        )
+        store = stores[wave.cluster]
+        names = []
+        for pdb in pdbs:
+            store.create(pdb)
+        for pod in pods:
+            store.create(pod)
+            expected[wave.cluster][pod.name] = pod
+            names.append(pod.name)
+        wave_names[wave_id] = names
+
+    def _delete_wave(self, wave, wave_id, stores, expected, wave_names):
+        store = stores[wave.cluster]
+        for name in wave_names.get(wave_id, []):
+            pod = store.get(Pod, name)
+            if pod is not None:
+                store.delete(pod)
+            expected[wave.cluster].pop(name, None)
+        from karpenter_core_tpu.api.objects import PodDisruptionBudget
+
+        pdb = store.get(PodDisruptionBudget, f"pdb-{wave_id}")
+        if pdb is not None:
+            store.delete(pdb)
+
+    def _apply_lose_pod(self, hook, stores, expected, note) -> None:
+        """The test-only invariant saboteur: silently drop one bound pod
+        from the store, leaving the workload bookkeeping convinced it
+        still exists — pod conservation MUST catch this."""
+        store = stores[hook.cluster]
+        for name in sorted(expected[hook.cluster]):
+            pod = store.get(Pod, name)
+            if pod is not None and pod.node_name:
+                store.delete(pod)
+                note("lose_bound_pod", f"test hook dropped bound pod {name}")
+                return
+
+    # -- trace -------------------------------------------------------------
+
+    def _merge_trace(self, notes: List[tuple], operators) -> List[tuple]:
+        entries: List[tuple] = list(notes)
+        for i, op in enumerate(operators):
+            for seq, event in enumerate(op.recorder.events):
+                entries.append((
+                    round(event.timestamp - TWIN_EPOCH, 3),
+                    f"cluster{i}",
+                    seq,
+                    f"{event.type}/{event.reason}",
+                    _scrub(f"{event.involved_object}: {event.message}"),
+                ))
+        entries.sort(key=lambda e: (e[0], str(e[1]), e[2]))
+        return entries
+
+
+def run_scenario(scenario: Scenario, **kwargs) -> TwinResult:
+    return DigitalTwin(scenario, **kwargs).run()

@@ -1,0 +1,15 @@
+"""GL201 bad (twin flavor): unordered iteration inside a scenario/ledger
+encoder — arrival order would leak into the committed repro fixture and
+the byte-identical-ledger contract."""
+
+
+def encode_scenario(scenario):
+    rows = []
+    for key, rate in scenario.rates.items():  # dict arrival order
+        rows.append({"rate": rate, "seam": key})
+    clusters = [c for c in set(scenario.clusters_used)]  # set order
+    return {"clusters": clusters, "rates": rows}
+
+
+def ledger_fingerprint(samples):
+    return tuple(v for v in samples.values)  # set-attribute iteration

@@ -1,0 +1,15 @@
+"""GL201 good (twin flavor): canonical iteration in the encoders; free
+iteration outside the encode context."""
+
+
+def encode_scenario(scenario):
+    rows = []
+    for key, rate in sorted(scenario.rates.items()):
+        rows.append({"rate": rate, "seam": key})
+    clusters = sorted(set(scenario.clusters_used))
+    return {"clusters": clusters, "rates": rows}
+
+
+def apply_waves(scenario):
+    # not an encoding/fingerprint function: arrival order is fine here
+    return {w.at: w for w in scenario.waves}

@@ -94,6 +94,31 @@ def test_fast_bench_emits_well_formed_json():
         assert phase["aggregate_pods_per_sec"] > 0
     assert cfg13["affinity_cache_ok"] is True, cfg13
 
+    # the tiny cfg14 proves the closed-loop digital twin end-to-end
+    # (ISSUE 15): both scenarios ran the full operator loop on the
+    # virtual clock, the ledger schema is whole, the clean run degraded
+    # nothing, and NO scenario violated an invariant
+    cfg14 = line["detail"]["cfg14_twin"]
+    assert cfg14["twin_ok"] is True, cfg14
+    for phase_name in ("clean", "fault_storm"):
+        phase = cfg14[phase_name]
+        for key in ("wall_s", "virtual_s", "compression_x", "pods_bound",
+                    "cost_dollar_hours", "peak_nodes", "slo", "slo_misses",
+                    "preemption_evictions", "utilization",
+                    "invariant_violations", "rpc_fallbacks",
+                    "verifier_rejections"):
+            assert key in phase, (phase_name, key)
+        assert phase["invariant_violations"] == 0, phase
+        assert phase["pods_bound"] > 0
+        assert phase["cost_dollar_hours"] > 0
+        assert phase["compression_x"] > 1.0  # days-in-minutes contract
+        assert set(phase["slo"]) == {"batch", "serving", "training"}
+    assert cfg14["clean"]["rpc_fallbacks"] == 0
+    # faults actually FIRED during the storm (the zero-violations gate
+    # is not vacuous; draws alone count every healthy call too)
+    storm_injected = cfg14["fault_storm"]["utilization"]["chaos_injected"]
+    assert sum(int(v) for v in storm_injected.values()) > 0
+
     # the tiny cfg11 gangsched smoke (ISSUE 10): preemption fired, every
     # gang stayed atomic, and the eviction set stayed minimal
     gangs = line["detail"]["cfg11_gangs"]

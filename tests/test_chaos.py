@@ -520,6 +520,55 @@ class TestChaosSmoke:
         assert faults == ["conflict", "ok", "too_many_requests", "ok"]
 
 
+class TestSeamStreams:
+    """Per-seam child RNG streams (ISSUE 15 satellite): each seam's fault
+    sequence is a pure function of (seed, seam, its own rate keys) — the
+    monotonicity the twin's shrinker leans on when it drops one fault
+    class from a failing scenario."""
+
+    RATES_A = {"kube.create.conflict": 0.3, "kube.create.latency": 0.2}
+    RATES_B = {"cloud.create.create_error": 0.25}
+    KUBE_FAULTS = ChaosKubeClient.WRITE_FAULTS
+    CLOUD_FAULTS = ("create_error", "insufficient_capacity")
+
+    def _cloud_seq(self, schedule, n=40):
+        return [
+            schedule.next_fault("cloud.create", self.CLOUD_FAULTS)
+            for _ in range(n)
+        ]
+
+    def test_editing_one_seam_leaves_another_seams_sequence_identical(self):
+        both = ChaosSchedule(seed=9, rates={**self.RATES_A, **self.RATES_B})
+        # interleave heavy kube.create traffic between cloud draws
+        ref = []
+        for _ in range(40):
+            both.next_fault("kube.create", self.KUBE_FAULTS)
+            ref.append(both.next_fault("cloud.create", self.CLOUD_FAULTS))
+        # (a) REMOVE the kube seam's rates entirely: cloud unchanged
+        solo = ChaosSchedule(seed=9, rates=dict(self.RATES_B))
+        assert self._cloud_seq(solo) == ref
+        # (b) kube seam present but drawn a DIFFERENT number of times:
+        # cloud's stream must not shift (the pre-ISSUE-15 failure mode)
+        skewed = ChaosSchedule(seed=9, rates={**self.RATES_A, **self.RATES_B})
+        for _ in range(7):
+            skewed.next_fault("kube.create", self.KUBE_FAULTS)
+        assert self._cloud_seq(skewed) == ref
+
+    def test_same_seed_same_seam_replays(self):
+        a = ChaosSchedule(seed=4, rates=dict(self.RATES_B))
+        b = ChaosSchedule(seed=4, rates=dict(self.RATES_B))
+        assert self._cloud_seq(a) == self._cloud_seq(b)
+        c = ChaosSchedule(seed=5, rates=dict(self.RATES_B))
+        assert self._cloud_seq(c) != self._cloud_seq(a)
+
+    def test_seam_draw_ledger(self):
+        s = ChaosSchedule(seed=0, rates=dict(self.RATES_B))
+        self._cloud_seq(s, n=5)
+        s.next_fault("kube.create", self.KUBE_FAULTS)
+        assert s.seam_draws == {"cloud.create": 5, "kube.create": 1}
+        assert s.draws == 6
+
+
 # ---------------------------------------------------------------------------
 # device-tier chaos (ISSUE 8): wedged solves, corrupt wire, poison pills
 # ---------------------------------------------------------------------------

@@ -580,6 +580,79 @@ class TestSupervisor:
             sup.start()
 
 
+class TestRespawnStorm:
+    """ISSUE 15 satellite: the respawn-storm alarm tells a melting tier
+    (K+ respawns inside a sliding window) apart from routine crash-only
+    churn — gauge flips, readyz degrades, and the alarm decays once the
+    window slides past. Driven on a fake clock without subprocesses via
+    the accounting seam (_note_respawn) poll() feeds."""
+
+    def _sup(self, now, **kwargs):
+        import sys
+
+        from karpenter_core_tpu.solver.supervisor import SolverSupervisor
+
+        return SolverSupervisor(
+            command=[sys.executable, "-c", "pass"],
+            time_fn=lambda: now[0],
+            **kwargs,
+        )
+
+    def test_storm_trips_past_threshold_and_decays(self):
+        from karpenter_core_tpu.metrics import wiring as m
+
+        now = [0.0]
+        sup = self._sup(
+            now, storm_window=100.0, storm_threshold=3, member="7"
+        )
+        for i in range(3):
+            now[0] = float(i * 10)
+            sup._note_respawn(now[0])
+        # exactly the threshold: churn, not a storm
+        assert not sup.respawn_storm()
+        assert m.SOLVERD_RESPAWN_STORM.value({"member": "7"}) == 0.0
+        now[0] = 30.0
+        sup._note_respawn(now[0])  # the K+1'th inside the window
+        assert sup.respawn_storm()
+        assert m.SOLVERD_RESPAWN_STORM.value({"member": "7"}) == 1.0
+        # the window slides past the burst: alarm decays on its own
+        now[0] = 131.0
+        assert not sup.respawn_storm()
+        assert m.SOLVERD_RESPAWN_STORM.value({"member": "7"}) == 0.0
+
+    def test_fleet_aggregates_any_member_storm(self):
+        from karpenter_core_tpu.solver.supervisor import FleetSupervisor
+
+        now = [0.0]
+
+        def factory(on_event=None, member="0", **kwargs):
+            return self._sup(
+                now, storm_window=50.0, storm_threshold=2, member=member
+            )
+
+        fleet = FleetSupervisor(3, supervisor_factory=factory)
+        assert not fleet.respawn_storm()
+        for t in (0.0, 5.0, 10.0):
+            fleet.members[1]._note_respawn(t)
+        assert fleet.respawn_storm()
+        now[0] = 70.0
+        assert not fleet.respawn_storm()
+
+    def test_operator_readyz_degrades_during_storm(self):
+        op = new_operator("greedy")
+        op.kube.create(make_nodepool())
+        op.run_until_idle()
+        assert op.readyz()
+        now = [0.0]
+        sup = self._sup(now, storm_window=60.0, storm_threshold=1)
+        op.solver_supervisor = sup
+        sup._note_respawn(0.0)
+        sup._note_respawn(1.0)
+        assert not op.readyz()  # melting tier: degraded, loudly
+        now[0] = 90.0
+        assert op.readyz()
+
+
 class TestSchedulerReuse:
     """PR 3: the sidecar caches DeviceSchedulers per problem fingerprint
     (everything but the pods), carrying the prepared-state caches across

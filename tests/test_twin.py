@@ -1,0 +1,540 @@
+"""Digital twin (ISSUE 15): closed-loop determinism, invariant monitors,
+fleet faults, and failing-scenario shrinking.
+
+Tier-1 pins the whole contract at smoke scale:
+* identical seed + scenario → byte-identical event trace AND ledger JSON,
+  including a run with fleet faults (member murder, partition windows,
+  segment-store amnesia) enabled;
+* a clean scenario completes with zero invariant violations, zero
+  verifier rejections and zero greedy fallbacks; a fault-storm scenario
+  (ICE storm + member murder + partition) still completes with zero
+  invariant violations — degradation rides the shed/quarantine/fallback
+  ladder, never loses or double-places a pod;
+* the shrinker minimizes an intentionally-injected invariant bug (the
+  lose_bound_pod test hook) to a one-wave, one-cluster repro, and the
+  COMMITTED fixture (tests/twin_fixtures/shrunk_lost_pod.json) replays
+  the violation in well under 10 seconds.
+"""
+import json
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from karpenter_core_tpu.api.objects import (
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+)
+from karpenter_core_tpu.kube.store import KubeStore
+from karpenter_core_tpu.twin import (
+    FleetFault,
+    InvariantMonitor,
+    Scenario,
+    Storm,
+    TestHook,
+    VirtualClock,
+    WorkloadWave,
+    decode_scenario,
+    encode_scenario,
+    fuzz,
+    replay,
+    scenario_fingerprint,
+    scenario_from_json,
+    scenario_to_json,
+    shrink,
+)
+from karpenter_core_tpu.twin.harness import TWIN_EPOCH, run_scenario
+from karpenter_core_tpu.twin.workloads import pods_for_wave
+
+FIXTURES = Path(__file__).parent / "twin_fixtures"
+
+GIB = 2.0**30
+
+
+def _clean_scenario(**overrides) -> Scenario:
+    """~300 pods over 2 clusters, mixed Tesserae-shaped classes, no
+    faults (the tier-1 smoke shape named by the ISSUE)."""
+    base = dict(
+        seed=3,
+        clusters=2,
+        duration=300.0,
+        tick=30.0,
+        solver="greedy",
+        waves=(
+            WorkloadWave(at=0.0, cluster=0, kind="serving", count=80,
+                         min_available=4),
+            WorkloadWave(at=0.0, cluster=1, kind="training", count=64,
+                         gang_size=8, priority=100),
+            WorkloadWave(at=30.0, cluster=0, kind="batch", count=80,
+                         lifetime=180.0),
+            WorkloadWave(at=60.0, cluster=1, kind="serving", count=48,
+                         min_available=2),
+            WorkloadWave(at=90.0, cluster=0, kind="batch", count=40),
+        ),
+    )
+    base.update(overrides)
+    return Scenario(**base)
+
+
+def _storm_fleet_scenario(**overrides) -> Scenario:
+    """The fault-storm shape from the acceptance criteria: ICE storm +
+    chaos rates on the kube/cloud seams + fleet faults (murder of a
+    member mid-run, an operator↔fleet partition window, segment-store
+    amnesia), over a REAL in-thread solverd tier."""
+    base = dict(
+        seed=5,
+        clusters=2,
+        duration=300.0,
+        tick=30.0,
+        solver="tpu",
+        fleet=2,
+        wire="delta",
+        rates={
+            "kube.create.conflict": 0.05,
+            "kube.update.conflict": 0.04,
+            "cloud.create.insufficient_capacity": 0.03,
+        },
+        storms=(Storm(start=60.0, duration=90.0, cluster=0, head=4),),
+        waves=(
+            WorkloadWave(at=0.0, cluster=0, kind="serving", count=12,
+                         min_available=2),
+            WorkloadWave(at=30.0, cluster=1, kind="batch", count=12),
+            WorkloadWave(at=150.0, cluster=0, kind="batch", count=8),
+            WorkloadWave(at=210.0, cluster=1, kind="serving", count=8),
+        ),
+        fleet_faults=(
+            FleetFault(at=90.0, kind="amnesia", member=0),
+            FleetFault(at=120.0, kind="murder", member=1),
+            FleetFault(at=180.0, kind="partition", cluster=0, duration=60.0),
+        ),
+    )
+    base.update(overrides)
+    return Scenario(**base)
+
+
+# ---------------------------------------------------------------------------
+# scenario codec
+# ---------------------------------------------------------------------------
+
+
+class TestScenarioCodec:
+    def test_round_trip_and_fingerprint_stability(self):
+        s = _storm_fleet_scenario()
+        text = scenario_to_json(s)
+        back = scenario_from_json(text)
+        assert back == s
+        assert scenario_to_json(back) == text
+        assert scenario_fingerprint(back) == scenario_fingerprint(s)
+
+    def test_encoding_is_construction_order_independent(self):
+        a = _clean_scenario()
+        b = Scenario(**{
+            **encode_kwargs(a),
+            "waves": tuple(reversed(a.waves)),
+            "rates": dict(reversed(list(a.rates.items()))),
+        })
+        assert scenario_to_json(a) == scenario_to_json(b)
+
+    def test_unknown_fields_and_kinds_reject(self):
+        data = encode_scenario(_clean_scenario())
+        data["bogus"] = 1
+        with pytest.raises(ValueError, match="bogus"):
+            decode_scenario(data)
+        with pytest.raises(ValueError, match="wave kind"):
+            run_scenario(Scenario(waves=(
+                WorkloadWave(at=0.0, cluster=0, kind="mystery", count=1),
+            )))
+
+    def test_validation_rejects_out_of_range_targets(self):
+        with pytest.raises(ValueError, match="outside"):
+            run_scenario(Scenario(clusters=1, waves=(
+                WorkloadWave(at=0.0, cluster=3, kind="batch", count=1),
+            )))
+        with pytest.raises(ValueError, match="fleet"):
+            run_scenario(Scenario(fleet_faults=(
+                FleetFault(at=0.0, kind="murder", member=0),
+            )))
+        # a hand-edited fixture with a bogus hook/storm target must fail
+        # validation loudly, not IndexError mid-run
+        with pytest.raises(ValueError, match="outside"):
+            run_scenario(Scenario(clusters=2, hooks=(
+                TestHook(at=0.0, kind="lose_bound_pod", cluster=5),
+            )))
+        with pytest.raises(ValueError, match="outside"):
+            run_scenario(Scenario(clusters=1, storms=(
+                Storm(start=0.0, duration=10.0, cluster=2),
+            )))
+        with pytest.raises(ValueError, match="multiple"):
+            run_scenario(Scenario(clusters=1, waves=(
+                WorkloadWave(at=0.0, cluster=0, kind="training", count=12,
+                             gang_size=8),
+            )))
+
+    def test_wave_identity_is_content_derived(self):
+        from karpenter_core_tpu.twin.scenario import wave_ids
+
+        w1 = WorkloadWave(at=0.0, cluster=0, kind="serving", count=4)
+        w2 = WorkloadWave(at=30.0, cluster=0, kind="batch", count=4)
+        full = wave_ids((w1, w2))
+        # dropping a sibling (the shrinker) re-rolls NOTHING: same id,
+        # same pods, byte for byte
+        assert wave_ids((w2,))[0] == full[1]
+        a, _ = pods_for_wave(w2, full[1], seed=5)
+        b, _ = pods_for_wave(w2, wave_ids((w2,))[0], seed=5)
+        assert [(p.name, p.resource_requests) for p in a] == [
+            (p.name, p.resource_requests) for p in b
+        ]
+        # identical duplicate waves disambiguate deterministically
+        dup = wave_ids((w1, w1))
+        assert dup[0] != dup[1] and dup == wave_ids((w1, w1))
+
+    def test_reordered_construction_runs_identically(self):
+        base = _clean_scenario(duration=120.0)
+        flipped = Scenario(**{
+            **encode_kwargs(base), "waves": tuple(reversed(base.waves)),
+        })
+        # the encoder sorts, so these share one fingerprint — and the
+        # harness canonicalizes, so they must share one RUN
+        assert scenario_fingerprint(base) == scenario_fingerprint(flipped)
+        a = run_scenario(base)
+        b = run_scenario(flipped)
+        assert a.trace_json() == b.trace_json()
+        assert a.ledger_json() == b.ledger_json()
+
+
+def encode_kwargs(s: Scenario) -> dict:
+    d = encode_scenario(s)
+    d.pop("version")
+    return {
+        **d,
+        "waves": s.waves,
+        "storms": s.storms,
+        "fleet_faults": s.fleet_faults,
+        "hooks": s.hooks,
+        "rates": dict(s.rates),
+    }
+
+
+class TestVirtualClock:
+    def test_sleep_and_monotonic_ride_virtual_time(self):
+        clock = VirtualClock(1000.0)
+        assert clock.monotonic() == 1000.0
+        clock.sleep(2.5)
+        assert clock.now() == 1002.5
+        clock.advance_to(1001.0)  # never backward
+        assert clock.now() == 1002.5
+        clock.advance_to(1010.0)
+        assert clock.monotonic() == 1010.0
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 smoke: clean run, fault storm, byte determinism
+# ---------------------------------------------------------------------------
+
+
+class TestTwinSmoke:
+    def test_clean_scenario_zero_violations_zero_fallbacks(self):
+        result = run_scenario(_clean_scenario())
+        assert result.violations == []
+        assert result.counters["result_rejected"] == 0
+        assert result.counters["rpc_fallbacks"] == 0
+        ledger = result.ledger.encode()
+        # every workload class bound and accounted: 5 waves, 312 pods
+        n_bound = sum(c["n"] for c in ledger["slo"].values())
+        assert n_bound == 312
+        assert set(ledger["slo"]) == {"batch", "serving", "training"}
+        assert ledger["slo_misses"] == 0
+        # the judge surface is live: $-cost accumulated, nodes peaked
+        assert all(v > 0 for v in ledger["cost_dollar_hours"].values())
+        assert all(v > 0 for v in ledger["peak_nodes"].values())
+        assert ledger["ticks"] == 10
+
+    def test_identical_seed_byte_identical_trace_and_ledger(self):
+        scenario = _clean_scenario(rates={
+            "kube.create.conflict": 0.08,
+            # update/bind are the high-traffic seams (status writes every
+            # pass, one bind per pod): faults reliably FIRE here
+            "kube.update.conflict": 0.05,
+            "kube.bind.conflict": 0.05,
+            "cloud.create.insufficient_capacity": 0.04,
+        }, storms=(Storm(start=30.0, duration=90.0, head=4),))
+        a = run_scenario(scenario)
+        b = run_scenario(scenario)
+        assert a.trace_json() == b.trace_json()
+        assert a.ledger_json() == b.ledger_json()
+        # and chaos faults actually FIRED (the equality is not vacuous)
+        assert a.ledger.utilization["chaos_injected"]["0"] > 0
+
+    def test_different_seed_diverges(self):
+        scenario = _clean_scenario(rates={"kube.create.conflict": 0.2})
+        a = run_scenario(scenario)
+        b = run_scenario(Scenario(**{
+            **encode_kwargs(scenario), "seed": scenario.seed + 1
+        }))
+        # same shape, different seed: the chaos path must actually differ
+        assert a.trace_json() != b.trace_json()
+
+
+class TestTwinFleet:
+    """The real solverd tier behind each operator's FleetRouter — the
+    jax-backed half of the smoke (in-thread daemons, real HTTP/codec)."""
+
+    def test_fault_storm_zero_invariant_violations_and_determinism(self):
+        scenario = _storm_fleet_scenario()
+        a = run_scenario(scenario)
+        # ICE storm + murder + partition: the ladder degrades, the loop
+        # converges, and no pod is ever lost or double-placed
+        assert a.violations == []
+        assert a.counters["result_rejected"] == 0
+        # the murder/partition actually bit: some solves fell back
+        assert a.counters["rpc_fallbacks"] > 0
+        util = a.ledger.utilization
+        assert sum(util["member_solves"].values()) > 0
+        # identical seed: byte-identical trace AND ledger, fleet faults on
+        b = run_scenario(scenario)
+        assert a.trace_json() == b.trace_json()
+        assert a.ledger_json() == b.ledger_json()
+
+    def test_clean_fleet_run_zero_fallbacks(self):
+        scenario = _storm_fleet_scenario(
+            rates={}, storms=(), fleet_faults=(),
+            duration=120.0,
+            waves=(
+                WorkloadWave(at=0.0, cluster=0, kind="serving", count=10,
+                             min_available=2),
+                WorkloadWave(at=30.0, cluster=1, kind="batch", count=10),
+            ),
+        )
+        result = run_scenario(scenario)
+        assert result.violations == []
+        assert result.counters["rpc_fallbacks"] == 0
+        assert result.counters["result_rejected"] == 0
+        assert sum(
+            result.ledger.utilization["member_solves"].values()
+        ) > 0
+
+
+# ---------------------------------------------------------------------------
+# invariant monitor units (stub op: the monitor only reads op.kube)
+# ---------------------------------------------------------------------------
+
+
+def _stub_op():
+    store = KubeStore(VirtualClock(TWIN_EPOCH))
+    return SimpleNamespace(kube=store), store
+
+
+def _node(name: str, cpu: float = 4.0) -> Node:
+    return Node(
+        metadata=ObjectMeta(name=name),
+        status=NodeStatus(
+            capacity={"cpu": cpu, "memory": 8 * GIB},
+            allocatable={"cpu": cpu, "memory": 8 * GIB},
+        ),
+    )
+
+
+class TestInvariantMonitor:
+    def test_gang_strand_flags_atomicity(self):
+        op, store = _stub_op()
+        wave = WorkloadWave(
+            at=0.0, cluster=0, kind="training", count=4, gang_size=4
+        )
+        pods, _ = pods_for_wave(wave, "t0", seed=0)
+        store.create(_node("n1", cpu=32.0))
+        live = {}
+        for pod in pods:
+            store.create(pod)
+            live[pod.name] = pod
+        for pod in pods[:2]:  # bind HALF the gang: a strand
+            store.bind(store.get(Pod, pod.name), "n1")
+        monitor = InvariantMonitor()
+        fresh = monitor.check(TWIN_EPOCH + 1.0, [op], {0: live})
+        assert [v.invariant for v in fresh] == ["gang_atomicity"]
+        assert "2/4" in fresh[0].detail
+
+    def test_lost_pod_and_ghost_bind_flag_conservation(self):
+        op, store = _stub_op()
+        pod = Pod(metadata=ObjectMeta(name="p1"),
+                  resource_requests={"cpu": 1.0})
+        live = {"p1": pod, "p2": Pod(metadata=ObjectMeta(name="p2"))}
+        store.create(pod)
+        store.create(_node("n1"))
+        store.bind(store.get(Pod, "p1"), "n1")
+        ghost = store.get(Pod, "p1")
+        ghost.node_name = "no-such-node"
+        monitor = InvariantMonitor()
+        fresh = monitor.check(TWIN_EPOCH + 1.0, [op], {0: live})
+        kinds = sorted(v.invariant for v in fresh)
+        assert kinds == ["pod_conservation", "pod_conservation"]
+        details = " | ".join(v.detail for v in fresh)
+        assert "vanished" in details and "ghost" in details
+
+    def test_capacity_overcommit_flags(self):
+        op, store = _stub_op()
+        store.create(_node("n1", cpu=1.0))
+        pod = Pod(metadata=ObjectMeta(name="big"),
+                  resource_requests={"cpu": 4.0})
+        store.create(pod)
+        store.bind(store.get(Pod, "big"), "n1")
+        monitor = InvariantMonitor()
+        fresh = monitor.check(
+            TWIN_EPOCH + 1.0, [op], {0: {"big": pod}}
+        )
+        assert any(v.invariant == "capacity" for v in fresh)
+
+    def test_starved_pod_flags_after_max_pending(self):
+        op, store = _stub_op()
+        pod = Pod(metadata=ObjectMeta(name="stuck"))
+        store.create(pod)
+        monitor = InvariantMonitor(max_pending=100.0)
+        assert monitor.check(
+            TWIN_EPOCH + 50.0, [op], {0: {"stuck": pod}}
+        ) == []
+        fresh = monitor.check(
+            TWIN_EPOCH + 200.0, [op], {0: {"stuck": pod}}
+        )
+        assert [v.invariant for v in fresh] == ["pod_conservation"]
+        assert "pending" in fresh[0].detail
+
+
+# ---------------------------------------------------------------------------
+# the shrinker
+# ---------------------------------------------------------------------------
+
+
+def _buggy_scenario() -> Scenario:
+    return Scenario(
+        seed=11, clusters=2, duration=300.0, tick=30.0, solver="greedy",
+        rates={
+            "kube.create.conflict": 0.05,
+            "kube.update.conflict": 0.05,
+            "cloud.create.insufficient_capacity": 0.04,
+        },
+        storms=(Storm(start=30.0, duration=90.0, cluster=0, head=4),),
+        waves=(
+            WorkloadWave(at=0.0, cluster=0, kind="serving", count=20,
+                         min_available=2),
+            WorkloadWave(at=30.0, cluster=1, kind="training", count=16,
+                         gang_size=4, priority=100),
+            WorkloadWave(at=60.0, cluster=0, kind="batch", count=20,
+                         lifetime=120.0),
+        ),
+        hooks=(TestHook(at=120.0, kind="lose_bound_pod", cluster=0),),
+    )
+
+
+class TestShrinker:
+    def test_shrinks_injected_bug_to_minimal_scenario(self):
+        small = shrink(_buggy_scenario(), max_runs=80)
+        # the noise is gone: one cluster, one wave of one pod, no chaos
+        assert small.clusters == 1
+        assert small.rates == {}
+        assert small.storms == ()
+        assert len(small.waves) == 1
+        assert small.waves[0].count == 1
+        assert small.duration <= 150.0
+        assert len(small.hooks) == 1  # the bug itself survives
+        # and it still reproduces the violation
+        result = run_scenario(small)
+        assert [v.invariant for v in result.violations] == [
+            "pod_conservation"
+        ]
+
+    def test_shrink_refuses_a_healthy_scenario(self):
+        with pytest.raises(ValueError, match="does not violate"):
+            shrink(_clean_scenario(duration=60.0, waves=(
+                WorkloadWave(at=0.0, cluster=0, kind="batch", count=2),
+            ), clusters=1))
+
+    def test_committed_repro_replays_violation_fast(self):
+        path = FIXTURES / "shrunk_lost_pod.json"
+        t0 = time.perf_counter()
+        result = replay(str(path))
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 10.0, f"repro took {elapsed:.1f}s"
+        assert [v.invariant for v in result.violations] == [
+            "pod_conservation"
+        ]
+        # byte-deterministic replay: the fixture is a regression PIN
+        again = replay(str(path))
+        assert result.trace_json() == again.trace_json()
+        assert result.ledger_json() == again.ledger_json()
+
+    def test_nomination_overcommit_repro_stays_fixed(self):
+        """The fuzzer's first real catch, pinned: under bind-conflict +
+        launch-fault chaos, pods whose claim died re-solved into node
+        capacity that nominated-but-unbound pods already owned — a
+        per-node cpu overcommit. The shrunk scenario (this fixture, via
+        twin/shrink.py) reproduced it in one cluster/two waves/30s; the
+        fix (Provisioner._reserve_nominated + nominated-pod exclusion)
+        must keep it violation-free."""
+        result = replay(
+            str(FIXTURES / "shrunk_nomination_overcommit.json")
+        )
+        assert result.violations == []
+
+    def test_fixture_is_canonical_and_minimal(self):
+        data = json.loads((FIXTURES / "shrunk_lost_pod.json").read_text())
+        scenario = decode_scenario(data)
+        assert scenario.clusters == 1
+        assert len(scenario.waves) == 1
+        assert scenario.waves[0].count == 1
+        assert scenario.rates == {} and scenario.storms == ()
+
+
+# ---------------------------------------------------------------------------
+# fuzz soak + macro (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestTwinSoak:
+    def test_fuzz_sweep_finds_no_violations_in_healthy_code(self):
+        base = _clean_scenario(rates={
+            "kube.create.conflict": 0.08,
+            "kube.update.conflict": 0.05,
+            "kube.bind.conflict": 0.05,
+            "cloud.create.create_error": 0.05,
+            "cloud.create.insufficient_capacity": 0.04,
+            "cloud.delete.delete_error": 0.05,
+        }, storms=(Storm(start=30.0, duration=120.0, head=6),))
+        failing = fuzz(base, seeds=range(8), stop_after=0)
+        assert failing == [], [
+            (r.scenario.seed, r.first_violation()) for r in failing
+        ]
+
+    def test_fleet_fuzz_sweep_stays_clean(self):
+        failing = fuzz(_storm_fleet_scenario(), seeds=range(3), stop_after=0)
+        assert failing == [], [
+            (r.scenario.seed, r.first_violation()) for r in failing
+        ]
+
+    def test_macro_run_ledger_sane(self):
+        # thousands of pods over days of virtual churn in minutes of wall
+        scenario = _clean_scenario(
+            duration=3600.0 * 8, tick=600.0,
+            waves=tuple(
+                WorkloadWave(
+                    at=600.0 * i, cluster=i % 2, kind=kind, count=count,
+                    lifetime=7200.0 if kind != "serving" else 0.0,
+                    min_available=2 if kind == "serving" else 0,
+                    gang_size=8 if kind == "training" else 0,
+                    priority=100 if kind == "training" else 0,
+                )
+                for i, (kind, count) in enumerate(
+                    [("serving", 200), ("training", 160), ("batch", 400),
+                     ("batch", 300), ("serving", 150), ("training", 80),
+                     ("batch", 500), ("serving", 100)]
+                )
+            ),
+        )
+        result = run_scenario(scenario)
+        assert result.violations == []
+        ledger = result.ledger.encode()
+        assert ledger["virtual_seconds"] == 3600.0 * 8
+        assert sum(c["n"] for c in ledger["slo"].values()) == 1890
+        assert all(v > 0 for v in ledger["cost_dollar_hours"].values())

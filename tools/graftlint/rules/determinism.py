@@ -23,11 +23,16 @@ from typing import Iterator, Optional, Tuple
 from tools.graftlint.engine import ParsedFile, Rule, dotted_name, register
 
 # the modules whose encode paths feed tensor ids, wire bytes, or cache keys
+# — plus the twin's scenario/ledger serialization (ISSUE 15): a shrunk
+# repro fixture and the byte-identical-ledger determinism contract both
+# hang off canonical encoding there
 _SCOPED_FILES = (
     "solver/vocab.py",
     "solver/codec.py",
     "solver/snapshot.py",
     "models/provisioner.py",
+    "twin/scenario.py",
+    "twin/ledger.py",
 )
 
 _CONTEXT_FN = re.compile(
