@@ -340,6 +340,32 @@ SOLVER_FLEET_ROUTED = REGISTRY.counter(
     " open so the next-best healthy member served",
 )
 
+# -- incremental re-solve (solver/incremental.py, ISSUE 16) ----------------
+
+SOLVER_INCREMENTAL = REGISTRY.counter(
+    "solver_incremental_total",
+    "Solves that entered the incremental engine, by outcome: warm = the"
+    " whole prior packing replayed (zero diff), partial = clean classes"
+    " pinned + dirty pods sub-solved, full = fresh solve (ledger miss /"
+    " amnesia, core change, topology/gang structure, or a dirty set past"
+    " the proportionality bound), drift_reset = the drift controller"
+    " forced the full solve (interval or node-count regression),"
+    " rejected = a replayed packing failed the self-check verifier and"
+    " degraded to a fresh solve (deliberately NOT counted on"
+    " solver_result_rejected_total — that counter is the client-facing"
+    " corruption signal and stays unmoved by engine self-distrust)",
+)
+SOLVER_LEDGER_ENTRIES = REGISTRY.gauge(
+    "solver_packing_ledger_entries",
+    "Prior-solve packings resident in the PackingLedger — the warm-start"
+    " working set keyed by mode-suffixed problem fingerprint",
+)
+SOLVER_LEDGER_BYTES = REGISTRY.gauge(
+    "solver_packing_ledger_bytes",
+    "Approximate bytes pinned by resident ledger entries (uid/name"
+    " reference accounting, never exceeds the configured bound)",
+)
+
 # -- continuous cross-tenant solve batching (solver/fleet.py coalescer) ----
 
 SOLVERD_BATCH_SIZE = REGISTRY.histogram(

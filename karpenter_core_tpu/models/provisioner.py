@@ -324,8 +324,9 @@ class _KernelRequest:
     ev: object = None  # ops/gangsched.EvPlanes
     node_rounds: int = gangsched.NODE_ROUNDS
     # relaxsolve assignment inputs (kind == "relax"): the ops/relax
-    # constraint planes (viable, k_cs, podcost, counts, gang_id,
-    # base_template, base_kstar) plus the static iteration/gang counts
+    # constraint planes (viable, k_cs, k_node, podcost, counts, gang_id,
+    # base_template, base_kstar, warm_template) plus the static
+    # iteration/gang counts
     relax: tuple = None
     relax_iters: int = 0
     relax_gangs: int = 0
@@ -680,6 +681,14 @@ class DeviceScheduler:
             else relax_ops.DEFAULT_ITERS
         )
         self.relax_budget_s = relax_budget_s
+        # incsolve warm start (ISSUE 16): {class signature -> nodepool
+        # name} from the PackingLedger's prior accepted packing. Set by
+        # solver/incremental before a solve; _relax_improve lowers it to
+        # the per-class warm_template vector so the projected-gradient
+        # loop starts at last round's vertex instead of the simplex
+        # center. None (the default) keeps the kernel's cold start and is
+        # bit-identical to pre-warm behavior.
+        self._relax_warm: Optional[Dict] = None
         # ICE'd offerings project onto the catalog exactly like the greedy
         # path (apply_unavailable), so the host-side machinery — template
         # prefilter, decode refit, host fallback, price ordering — all see
@@ -1415,6 +1424,23 @@ class DeviceScheduler:
         if self._relax_expired():
             outcome("deadline")
             return state, takes_bc, unplaced_bc, extra
+        # incsolve warm start (ISSUE 16): lower the ledger's prior
+        # per-class template choice ({signature -> nodepool name}, set by
+        # solver/incremental) to a [Cp] index vector over THIS prep's
+        # template axis; -1 (cold) everywhere the ledger is silent or the
+        # pool no longer templates, so a ledger-less solve dispatches the
+        # bit-identical cold kernel.
+        Cp = int(prep.new_template.shape[0])
+        wvec = np.full((Cp,), -1, dtype=np.int32)
+        if self._relax_warm:
+            pool_to_tmpl = {
+                t.nodepool_name: si for si, t in enumerate(self.templates)
+            }
+            for ci, cls in enumerate(prep.classes[:Cp]):
+                si = pool_to_tmpl.get(self._relax_warm.get(cls.signature))
+                if si is not None:
+                    wvec[ci] = si
+            rstats["warm_classes"] = int((wvec >= 0).sum())
         nt, ks, changed, dt = yield _KernelRequest(
             init_state=None, steps=None, statics=None,
             level_iters=prep.level_iters, step_class=None,
@@ -1424,6 +1450,7 @@ class DeviceScheduler:
                 planes["viable"], planes["k_cs"], planes["k_node"],
                 planes["podcost"], planes["counts"], planes["gang_id"],
                 prep.new_template, prep.kstar,
+                jnp.asarray(wvec),
             ),
             relax_iters=self.relax_iters, relax_gangs=planes["n_gangs"],
         )

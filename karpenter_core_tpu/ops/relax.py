@@ -203,12 +203,28 @@ def _relax_choose_impl(
     gang_id,  # [C] int32 — same-template gang index, -1 outside any
     base_template,  # [C] int32 — fresh_viability's first-wins choice
     base_kstar,  # [C] int32
+    warm_template,  # [C] int32 — prior solve's template choice, -1 = none
     iters: int,
     num_gangs: int,
 ):
     vf = viable.astype(jnp.float32)
     nv = jnp.sum(vf, axis=1, keepdims=True)
-    x0 = vf / jnp.maximum(nv, 1.0)
+    uniform = vf / jnp.maximum(nv, 1.0)
+    # warm start (incsolve, ISSUE 16): rows carrying a prior solution
+    # start at that solution's vertex instead of the simplex center —
+    # a slowly-drifting problem's optimum is near last round's, so the
+    # contraction has almost no distance to cover and the same iteration
+    # budget lands measurably closer. A warm index that is no longer
+    # viable (catalog drift) falls back to the uniform start; cold rows
+    # (sentinel -1) are untouched, so a no-ledger solve is bit-identical
+    # to the pre-warm kernel.
+    S = viable.shape[1]
+    wt = jnp.clip(warm_template, 0)
+    warm_viable = (warm_template >= 0) & jnp.take_along_axis(
+        viable, wt[:, None], axis=1
+    )[:, 0]
+    onehot = jax.nn.one_hot(wt, S, dtype=jnp.float32)
+    x0 = jnp.where(warm_viable[:, None], onehot, uniform)
     # linear objective: total fractional $-cost of the assignment. The
     # per-cell coefficient is the class's pod mass times its $/pod via
     # that template; normalized to [0, 1] over the viable support so the
@@ -258,14 +274,14 @@ relax_choose = partial(
 
 def _relax_choose_batched_impl(
     viable, k_cs, k_node, podcost, counts, gang_id, base_template,
-    base_kstar, iters: int, num_gangs: int,
+    base_kstar, warm_template, iters: int, num_gangs: int,
 ):
     return jax.vmap(
-        lambda v, k, kn, p, c, gi, bt, bk: _relax_choose_impl(
-            v, k, kn, p, c, gi, bt, bk, iters, num_gangs
+        lambda v, k, kn, p, c, gi, bt, bk, wt: _relax_choose_impl(
+            v, k, kn, p, c, gi, bt, bk, wt, iters, num_gangs
         )
     )(viable, k_cs, k_node, podcost, counts, gang_id, base_template,
-      base_kstar)
+      base_kstar, warm_template)
 
 
 # vmapped twin for the PR 9 coalescer: stacked relax problems in one

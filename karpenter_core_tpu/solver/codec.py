@@ -171,7 +171,15 @@ def decode_response(data: bytes) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
 # SAME fingerprint, so the scheduler cache never splits on wire form).
 # The full-wire form stays first-class at v5 — it is the fallback when a
 # sidecar cannot resolve a manifest even after the re-upload round.
-SOLVE_WIRE_VERSION = 5
+# v6: prev_fingerprint — the prior-solve reference (incsolve, ISSUE 16).
+# NOT load-bearing for correctness (a daemon that ignores it just solves
+# fresh, which is always a valid answer), but the version bumps anyway:
+# the wire-schema lock (GL403) makes every field-set change an explicit,
+# reviewed bump, and a mixed deployment degrades EXPLICITLY through the
+# version-skew error → greedy fallback instead of silently shedding the
+# warm-start. Key omitted when empty, so a non-incremental request's
+# header carries no trace of the feature.
+SOLVE_WIRE_VERSION = 6
 
 # the solver backends a request may select; "" means unspecified (the
 # serving daemon's default applies)
@@ -473,6 +481,7 @@ def encode_solve_request(
     unavailable_offerings=(),
     tenant: str = "default",
     solver_mode: str = "ffd",
+    prev_fingerprint: str = "",
 ) -> bytes:
     """Serialize a full scheduler input for the solverd sidecar.
     ``unavailable_offerings`` is the control plane's ICE-cache snapshot
@@ -487,7 +496,13 @@ def encode_solve_request(
     (relaxsolve, ISSUE 13): "ffd" (first-fit-decreasing, the classic
     path) or "relax" (convex-relaxation optimizer with the FFD result as
     the scored/anytime fallback); it also rides the X-Solver-Mode header
-    so the gateway can route pre-decode."""
+    so the gateway can route pre-decode.
+    ``prev_fingerprint`` names the problem fingerprint of the CLIENT's
+    last verified solve against this sidecar (incsolve, ISSUE 16): the
+    serving daemon may replay the unchanged half of that packing from
+    its ledger. Non-load-bearing like ``tenant`` — a sidecar that drops
+    or predates it solves fresh, never wrongly — so it rides the same
+    wire version, omitted when empty (the evictions idiom)."""
     return _json_payload(_encode_solve_header(
         nodepools,
         instance_types,
@@ -499,6 +514,7 @@ def encode_solve_request(
         unavailable_offerings=unavailable_offerings,
         tenant=tenant,
         solver_mode=solver_mode,
+        prev_fingerprint=prev_fingerprint,
     ))
 
 
@@ -513,6 +529,7 @@ def _encode_solve_header(
     unavailable_offerings=(),
     tenant: str = "default",
     solver_mode: str = "ffd",
+    prev_fingerprint: str = "",
 ) -> dict:
     """The full solve header as a dict — encode_solve_request's payload
     before the npz container, shared by the full wire (v1..v5 shape) and
@@ -558,6 +575,13 @@ def _encode_solve_header(
         "tenant": tenant,
         "solver_mode": solver_mode,
     }
+    # prior-solve reference (incsolve, ISSUE 16 / wire v6): key omitted
+    # when empty so a non-incremental request's header carries no trace
+    # of the feature — and the fingerprint probes (solver/segments.py)
+    # never see it either way, so naming a predecessor cannot churn the
+    # scheduler-cache key it warms
+    if prev_fingerprint:
+        header.update({"prev_fingerprint": prev_fingerprint})
     return header
 
 
@@ -782,6 +806,7 @@ def _encode_manifest_inline(header: dict) -> dict:
         "unavailable_offerings": header.get("unavailable_offerings", []),
         "has_topology": topo is not None,
         "topo_excluded": None if topo is None else topo.get("excluded"),
+        "prev_fingerprint": header.get("prev_fingerprint", ""),
     }
 
 
@@ -959,6 +984,7 @@ def _decode_manifest_inline(inline) -> dict:
         "unavailable_offerings": inline.get("unavailable_offerings", []),
         "has_topology": bool(inline.get("has_topology")),
         "topo_excluded": inline.get("topo_excluded"),
+        "prev_fingerprint": inline.get("prev_fingerprint", ""),
     }
 
 
@@ -1038,6 +1064,9 @@ def _decode_solve_header(h: dict, fingerprint: str = None) -> dict:
         # the decode net — an invalid mode must not surface as a
         # DeviceScheduler constructor raise inside the device window.
         "solver_mode": _check_mode(h.get("solver_mode", "")),
+        # prior-solve reference (incsolve, ISSUE 16): absent/empty means
+        # no predecessor — the daemon solves fresh, exactly as pre-16
+        "prev_fingerprint": str(h.get("prev_fingerprint", "") or ""),
     }
 
 

@@ -229,6 +229,13 @@ class SolverClient:
 
         self.segcache = SentCache()
         self._seen_instance = ""
+        # incsolve predecessor reference (ISSUE 16): the fingerprint of
+        # this client's last verified solve, sent as prev_fingerprint by
+        # an incremental-opted RemoteScheduler. Lives here (not on the
+        # per-solve facade) for the same reason the quarantine does; a
+        # respawned sidecar's empty ledger just misses it — amnesia is a
+        # full solve, never a wrong bind.
+        self.prev_fingerprint = ""
         # client-side poison quarantine, keyed on the request-body digest:
         # lives HERE (not on the per-solve RemoteScheduler) because the
         # strike streak must survive across solves, like the breaker. A
@@ -535,6 +542,18 @@ class RemoteScheduler:
         self.solver_mode = (device_scheduler_opts or {}).get(
             "solver_mode", "ffd"
         )
+        # incremental re-solve opt-in (incsolve, ISSUE 16): when set, each
+        # request names the fingerprint of this client's last VERIFIED
+        # solve so the sidecar may replay the unchanged half of that
+        # packing from its ledger. The memory lives on the CLIENT (the
+        # durable object — this facade is rebuilt per solve, the SentCache
+        # lesson) and is cleared on every degradation below: a fallback
+        # round must never advertise a predecessor the operator did not
+        # actually bind. Off by default — the wire is byte-identical to a
+        # pre-incsolve client's unless the operator opts in.
+        self.incremental = bool(
+            (device_scheduler_opts or {}).get("incremental", False)
+        )
         # the ICE-cache snapshot ships on the wire so the sidecar masks the
         # same offerings; the greedy fallback applies it locally too
         self.unavailable_offerings = frozenset(unavailable_offerings)
@@ -571,6 +590,11 @@ class RemoteScheduler:
                     unavailable_offerings=self.unavailable_offerings,
                     tenant=self.client.tenant,
                     solver_mode=self.solver_mode,
+                    prev_fingerprint=(
+                        getattr(self.client, "prev_fingerprint", "")
+                        if self.incremental
+                        else ""
+                    ),
                 )
                 if wire_mode == "delta":
                     # delta wire (ISSUE 14): split into content-addressed
@@ -669,6 +693,17 @@ class RemoteScheduler:
                 return self._fallback_solve(pods, gangsched)
         if quarantine is not None and digest is not None:
             quarantine.clear(digest)
+        if self.incremental:
+            # remember the VERIFIED solve as the next request's
+            # predecessor: the manifest path derives the fingerprint from
+            # the plan it already split; the full wire re-canonicalizes
+            from karpenter_core_tpu.solver import segments as segmod
+
+            self.client.prev_fingerprint = (
+                segmod.fingerprint_of_parts(plan.listing, plan.inline)
+                if plan is not None
+                else codec.problem_fingerprint(header)
+            )
         return results
 
     def _note_rpc_failure(self, e: RemoteSolverError, digest) -> None:
@@ -702,6 +737,14 @@ class RemoteScheduler:
             Scheduler,
         )
         from karpenter_core_tpu.solver import gangs as gangmod
+
+        # incsolve fallback contract (ISSUE 16): a greedy round's packing
+        # was never remembered by any sidecar ledger, so the next request
+        # must not name it as a predecessor — clearing routes that solve
+        # down the full path (a stale reference would only miss anyway;
+        # this keeps the reference honest and the miss accounting clean)
+        if getattr(self, "incremental", False):
+            self.client.prev_fingerprint = ""
 
         def make_scheduler():
             return Scheduler(
@@ -914,6 +957,11 @@ class FleetRouter:
         self._inflight = [0] * len(self.members)
         self._tl = threading.local()
         self.routed: Dict[str, int] = {}
+        # incsolve predecessor reference (ISSUE 16): one slot suffices —
+        # digest affinity pins a snapshot's lineage to one member, whose
+        # ledger is the one this fingerprint can hit; a spill/degraded
+        # re-route lands on a member that simply misses (full solve)
+        self.prev_fingerprint = ""
 
     # -- SolverClient surface ---------------------------------------------
 

@@ -478,7 +478,7 @@ def assemble_solve_header(
             raise ValueError(f"pod layout entry ({b},{m}) out of range")
         pods.append(batches[b][m])
 
-    return {
+    header = {
         "version": codec.SOLVE_WIRE_VERSION,
         "nodepools": groups[KIND_NODEPOOLS][0],
         "it_table": catalog["it_table"],
@@ -492,6 +492,15 @@ def assemble_solve_header(
         "tenant": inline.get("tenant", "default"),
         "solver_mode": inline.get("solver_mode", ""),
     }
+    # prior-solve reference (incsolve, ISSUE 16): pod-half inline —
+    # deliberately OUTSIDE fingerprint_of_parts' probe, so a request
+    # naming its predecessor fingerprints identically to one that
+    # doesn't (it must, or the reference could never name a hit). Key
+    # omitted when empty, mirroring _encode_solve_header — assembly must
+    # stay byte-exact against the full wire either way.
+    if inline.get("prev_fingerprint"):
+        header["prev_fingerprint"] = inline["prev_fingerprint"]
+    return header
 
 
 class SegmentStore:

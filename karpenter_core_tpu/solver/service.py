@@ -71,6 +71,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from karpenter_core_tpu.kube.httpserver import read_body, send_body
 from karpenter_core_tpu.solver import codec, fleet, segments
+from karpenter_core_tpu.solver import incremental as incsolve
 from karpenter_core_tpu.solver.supervisor import (
     DRAIN_EXIT_CODE,
     DRAIN_EXIT_DEADLINE_SECONDS,
@@ -226,6 +227,7 @@ class SolverDaemon:
         exit_fn=None,
         default_mode: str = "ffd",
         segment_store: segments.SegmentStore = None,
+        incremental=None,
     ):
         self.ready = False
         self.solves = 0
@@ -246,6 +248,21 @@ class SolverDaemon:
             if segment_store is not None
             else segments.SegmentStore()
         )
+        # incremental re-solve engine (incsolve, ISSUE 16): entered only
+        # when a request names its predecessor (prev_fingerprint on the
+        # wire), so non-incremental clients never change behavior. The
+        # ledger is process-local like the scheduler cache — a respawned
+        # member's empty ledger degrades to a full solve (amnesia), and
+        # the fleet router's digest affinity keeps a snapshot's requests
+        # on the member whose ledger is warm. ``False`` disables; None
+        # builds the default engine; an engine instance is adopted
+        # (`is None` would wrongly re-enable an explicit False).
+        if incremental is False:
+            self.incremental = None
+        elif incremental is None:
+            self.incremental = incsolve.IncrementalEngine()
+        else:
+            self.incremental = incremental
         # solver backend served when a request names none (relaxsolve,
         # ISSUE 13): the wire field / X-Solver-Mode header select
         # per-request; this is the daemon-wide default (solverd
@@ -567,10 +584,31 @@ class SolverDaemon:
                         # steady-state manifest body is a few hundred
                         # bytes and would let N delta-wire tenants pin N
                         # full schedulers past the --cache-mib bound
-                        scheduler = self._scheduler_for(
-                            problem_i,
-                            problem_i.get("approx_bytes") or len(body_i)
-                        )
+                        # incremental path (incsolve, ISSUE 16): when the
+                        # request names its predecessor and the engine is
+                        # on, a lazy wrapper rides the batch entry — the
+                        # engine replays the unchanged half of the prior
+                        # packing and only constructs the real scheduler
+                        # (through this same cache seam) when it decides
+                        # it needs a fresh solve
+                        if (
+                            self.incremental is not None
+                            and problem_i.get("prev_fingerprint")
+                        ):
+                            bytes_i = (
+                                problem_i.get("approx_bytes") or len(body_i)
+                            )
+                            scheduler = self.incremental.wrap(
+                                problem_i,
+                                lambda p=problem_i, b=bytes_i: (
+                                    self._scheduler_for(p, b)
+                                ),
+                            )
+                        else:
+                            scheduler = self._scheduler_for(
+                                problem_i,
+                                problem_i.get("approx_bytes") or len(body_i)
+                            )
                     except Exception as e:
                         outcomes[i] = ("error", e)
                         continue
@@ -826,6 +864,14 @@ class SolverDaemon:
             # coalescer is currently buying back (mean problems per grant,
             # lifetime coalesced count, the configured window/size bounds)
             "batch": self.gateway.batch_stats(),
+            # incremental re-solve (incsolve, ISSUE 16): ledger residency
+            # + drift-controller config + the last solve's outcome, so a
+            # fleet dashboard can tell "warm ledger" from "amnesiac"
+            "incremental": (
+                self.incremental.stats()
+                if self.incremental is not None
+                else {"enabled": False}
+            ),
         }
 
     # -- boot warm-up ------------------------------------------------------
@@ -1118,6 +1164,38 @@ def main() -> int:
         " expires from the store (references refresh it)",
     )
     ap.add_argument(
+        "--no-incremental", action="store_true",
+        help="disable the incremental re-solve engine: every request"
+        " solves fresh even when it names a prev_fingerprint (the"
+        " packing ledger is never consulted or populated)",
+    )
+    ap.add_argument(
+        "--incremental-interval", type=int,
+        default=incsolve.DEFAULT_FULL_INTERVAL,
+        help="drift controller: force a full solve after this many"
+        " consecutive warm/partial replays of one problem lineage, so"
+        " incremental packings cannot ratchet into bad node sets",
+    )
+    ap.add_argument(
+        "--incremental-max-dirty", type=float,
+        default=incsolve.DEFAULT_MAX_DIRTY_FRACTION,
+        help="proportionality bound: past this dirty-pod fraction the"
+        " engine skips the replay and solves fresh (diff bookkeeping"
+        " stops paying for itself)",
+    )
+    ap.add_argument(
+        "--ledger-entries", type=int,
+        default=incsolve.DEFAULT_MAX_ENTRIES,
+        help="packing ledger entry bound (one remembered packing per"
+        " mode-suffixed problem fingerprint, LRU past it)",
+    )
+    ap.add_argument(
+        "--ledger-mib", type=int,
+        default=incsolve.DEFAULT_MAX_BYTES >> 20,
+        help="packing ledger approximate-byte bound, in MiB (uid/name"
+        " reference accounting per entry)",
+    )
+    ap.add_argument(
         "--quarantine-journal", default=None,
         help="path for the crash-only poison journal: the digest in"
         " flight on the device is recorded here, so a problem that"
@@ -1137,6 +1215,12 @@ def main() -> int:
         ap.error("--segment-cache-mib must be positive")
     if args.segment_ttl <= 0:
         ap.error("--segment-ttl must be positive")
+    if args.incremental_interval < 1:
+        ap.error("--incremental-interval must be >= 1")
+    if not (0.0 <= args.incremental_max_dirty <= 1.0):
+        ap.error("--incremental-max-dirty must be in [0, 1]")
+    if args.ledger_entries < 1 or args.ledger_mib < 1:
+        ap.error("--ledger-entries/--ledger-mib must be positive")
 
     daemon = SolverDaemon(
         profile_dir=args.profile_dir,
@@ -1156,6 +1240,18 @@ def main() -> int:
         segment_store=segments.SegmentStore(
             max_bytes=args.segment_cache_mib << 20,
             ttl=args.segment_ttl,
+        ),
+        incremental=(
+            False
+            if args.no_incremental
+            else incsolve.IncrementalEngine(
+                ledger=incsolve.PackingLedger(
+                    max_entries=args.ledger_entries,
+                    max_bytes=args.ledger_mib << 20,
+                ),
+                full_interval=args.incremental_interval,
+                max_dirty_fraction=args.incremental_max_dirty,
+            )
         ),
         quarantine=fleet.PoisonQuarantine(
             strikes=args.quarantine_strikes,

@@ -81,6 +81,13 @@ def _metric_snapshot() -> Dict[str, float]:
         "result_rejected": _counter_total(m.SOLVER_RESULT_REJECTED),
         "host_fallback_pods": _counter_total(m.SOLVER_HOST_FALLBACK_PODS),
         "preemption_evictions": _counter_total(m.SOLVER_PREEMPTION_EVICTIONS),
+        # incsolve (ISSUE 16): warm/partial replays actually served — the
+        # drift-judge tests gate on these to stay non-vacuous
+        "incremental_warm": (
+            m.SOLVER_INCREMENTAL.values.get((("outcome", "warm"),), 0.0)
+            + m.SOLVER_INCREMENTAL.values.get((("outcome", "partial"),), 0.0)
+        ),
+        "incremental_total": _counter_total(m.SOLVER_INCREMENTAL),
     }
 
 
@@ -311,6 +318,12 @@ class DigitalTwin:
                 solver_mode="sidecar",
                 solver_tenant=f"c{cluster}",
                 solver_wire=s.wire,
+                # incsolve (ISSUE 16): the client names its prior solve's
+                # fingerprint on every request; the tier's PackingLedger
+                # replays the unchanged half of last round's packing
+                device_scheduler_opts=(
+                    {"incremental": True} if s.incremental else {}
+                ),
             )
             client = self._make_router(cluster, tier, vclock)
         else:

@@ -56,6 +56,11 @@ class Ledger:
         self.cost_dollar_hours: Dict[int, float] = {}
         # cluster -> peak concurrent nodes seen at any tick
         self.peak_nodes: Dict[int, int] = {}
+        # cluster -> accumulated node·seconds (node-count integral over
+        # virtual time): the node-quality surface the incremental
+        # re-solve drift judge compares against a fresh-solve twin run
+        # (incsolve, ISSUE 16 — mean_nodes = node_seconds / duration)
+        self.node_seconds: Dict[int, float] = {}
         # workload class -> list of time-to-bind seconds (virtual)
         self.bind_latencies: Dict[str, List[float]] = {}
         self.ticks = 0
@@ -82,6 +87,9 @@ class Ledger:
             )
             self.peak_nodes[cluster] = max(
                 self.peak_nodes.get(cluster, 0), len(nodes)
+            )
+            self.node_seconds[cluster] = (
+                self.node_seconds.get(cluster, 0.0) + len(nodes) * dt
             )
 
     def record_bind(self, workload_class: str, latency_s: float) -> None:
@@ -112,6 +120,10 @@ class Ledger:
             "peak_nodes": {
                 str(cluster): self.peak_nodes[cluster]
                 for cluster in sorted(self.peak_nodes)
+            },
+            "node_seconds": {
+                str(cluster): round(self.node_seconds[cluster], 6)
+                for cluster in sorted(self.node_seconds)
             },
             "slo": self.slo(),
             "slo_misses": self.slo_misses,

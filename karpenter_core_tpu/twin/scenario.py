@@ -107,6 +107,11 @@ class Scenario:
     # shared by every cluster through a FleetRouter (requires solver=tpu)
     fleet: int = 0
     wire: str = "delta"  # delta | full (fleet mode's request wire)
+    # incremental re-solve (incsolve, ISSUE 16): clients name their prior
+    # solve's fingerprint on every request so the solverd tier's
+    # PackingLedger warm-starts churn-proportional re-solves (requires a
+    # fleet tier — the ledger lives daemon-side)
+    incremental: bool = False
     # SLO bound doubling as the starvation invariant: an expected pod
     # pending longer than this at a stable tick is a violation
     max_pending: float = 600.0
@@ -142,6 +147,7 @@ def encode_scenario(s: Scenario) -> dict:
         "solver": s.solver,
         "fleet": s.fleet,
         "wire": s.wire,
+        "incremental": s.incremental,
         "max_pending": s.max_pending,
         "rates": dict(sorted(s.rates.items())),
         "waves": _encode_items(s.waves, WorkloadWave),
@@ -191,6 +197,7 @@ def decode_scenario(data: dict) -> Scenario:
         solver=data.get("solver", "greedy"),
         fleet=int(data.get("fleet", 0)),
         wire=data.get("wire", "delta"),
+        incremental=bool(data.get("incremental", False)),
         max_pending=float(data.get("max_pending", 600.0)),
         rates={k: float(v) for k, v in sorted((data.get("rates") or {}).items())},
         waves=_decode_items(data.get("waves"), WorkloadWave),
@@ -217,6 +224,10 @@ def validate_scenario(s: Scenario) -> None:
         raise ValueError(f"unknown scenario wire {s.wire!r}")
     if s.fleet and s.solver != "tpu":
         raise ValueError("a fleet tier requires solver=tpu")
+    if s.incremental and not s.fleet:
+        # the PackingLedger lives daemon-side; without a solverd tier
+        # there is no ledger to warm-start from
+        raise ValueError("incremental re-solve requires a fleet tier")
     def _cluster_in_range(what: str, cluster: int, wildcard: bool) -> None:
         lo = -1 if wildcard else 0  # -1 = every cluster, where allowed
         if not (lo <= cluster < s.clusters):

@@ -1,0 +1,28 @@
+# GL501 bad (incsolve, ISSUE 16): an incremental-replay-shaped relax
+# pass warm-starts from the ledger and then re-scores the replayed
+# packing — but builds the scorer's SlotState straight from the ledger's
+# host-side record (numpy planes, provenance {host}): nothing routed
+# through parallel.mesh placement, so on a multi-device scheduler the
+# score dispatch compiles against absent shardings and gathers the whole
+# slot axis. The warm vector being placed correctly does not excuse the
+# state. Lint corpus only — never imported.
+import numpy as np
+
+from karpenter_core_tpu.ops.ffd import SlotState
+from karpenter_core_tpu.ops.relax import relax_score
+
+
+class DeviceScheduler:
+    def _state_from_ledger(self, record, n_slots):
+        # replayed planes decoded from the PackingLedger entry: host
+        # numpy end to end, never placed
+        return SlotState(
+            kind=np.asarray(record["kind"], dtype=np.int8),
+            template=np.asarray(record["template"], dtype=np.int32),
+            podcount=np.asarray(record["podcount"], dtype=np.int32),
+        )
+
+    def _relax_warm_rescore(self, record, tmpl_price, unplaced_bc,
+                            n_slots):
+        state = self._state_from_ledger(record, n_slots)
+        return relax_score(state, tmpl_price, unplaced_bc)  # GL501

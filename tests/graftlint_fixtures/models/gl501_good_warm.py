@@ -1,0 +1,53 @@
+# GL501 good (incsolve, ISSUE 16): the production warm-start shape —
+# the ledger's prior choice lowers to a [C] warm_template index vector
+# that rides the relax assignment planes through relax_plane_shardings
+# (replicated: no slot axis), and the state the scorer consumes is the
+# FINISHED solve's SlotState, whose planes were placed through the
+# sanctioned parallel.mesh routes (_dev_slots -> axis_sharding) before
+# the solve dispatch. Warm-starting changes where the contraction
+# starts, never where the arrays live. Lint corpus only — never
+# imported.
+import jax
+import numpy as np
+
+from karpenter_core_tpu.ops.ffd import SlotState, ffd_solve_donated
+from karpenter_core_tpu.ops.relax import relax_choose, relax_score
+from karpenter_core_tpu.parallel import mesh as pmesh
+
+
+class DeviceScheduler:
+    def __init__(self, mesh):
+        self._mesh = mesh
+        self._relax_warm = None  # {class signature -> nodepool name}
+
+    def _dev_slots(self, a):
+        return jax.device_put(a, pmesh.axis_sharding(self._mesh, a.ndim, 0))
+
+    def _make_init_state(self, n_slots):
+        return SlotState(
+            kind=self._dev_slots(np.zeros((n_slots,), dtype=np.int8)),
+            template=self._dev_slots(np.full((n_slots,), -1, np.int32)),
+            podcount=self._dev_slots(np.zeros((n_slots,), dtype=np.int32)),
+        )
+
+    def _warm_vec(self, classes, pool_to_tmpl, n_classes):
+        wvec = np.full((n_classes,), -1, dtype=np.int32)
+        for ci, cls in enumerate(classes[:n_classes]):
+            si = pool_to_tmpl.get((self._relax_warm or {}).get(cls.signature))
+            if si is not None:
+                wvec[ci] = si
+        return wvec
+
+    def _relax_improve(self, steps, statics, planes, classes,
+                       pool_to_tmpl, tmpl_price, unplaced_bc, n_slots):
+        wvec = self._warm_vec(classes, pool_to_tmpl, len(classes))
+        planes = planes + (wvec,)
+        planes = jax.device_put(
+            planes, pmesh.relax_plane_shardings(self._mesh, planes)
+        )
+        nt, ks, _changed = relax_choose(
+            *planes, iters=8, num_gangs=0
+        )
+        init = self._make_init_state(n_slots)
+        state, _takes, unplaced = ffd_solve_donated(init, steps, statics)
+        return nt, ks, relax_score(state, tmpl_price, unplaced_bc)

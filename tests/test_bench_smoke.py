@@ -119,6 +119,26 @@ def test_fast_bench_emits_well_formed_json():
     storm_injected = cfg14["fault_storm"]["utilization"]["chaos_injected"]
     assert sum(int(v) for v in storm_injected.values()) > 0
 
+    # the tiny cfg15 proves the incremental re-solve engine end-to-end
+    # (ISSUE 16): churn rounds actually replayed (warm/partial), node
+    # count matched the fresh daemon exactly, the self-verify pass never
+    # discarded a replay, and the client-facing rejection counter never
+    # moved. The 5x p50 gate is judged at full scale — a tiny fresh
+    # solve costs ~nothing to beat — so incremental_ok is only required
+    # to be present (and boolean) here.
+    cfg15 = line["detail"]["cfg15_incremental"]
+    for key in ("p50_fresh_resolve_s", "p50_incremental_resolve_s",
+                "speedup_x", "node_delta_pct_max", "outcomes",
+                "replayed_rounds", "incremental_rejected",
+                "verifier_rejections", "ledger", "incremental_ok"):
+        assert key in cfg15, key
+    assert cfg15["replayed_rounds"] > 0, cfg15
+    assert cfg15["node_delta_pct_max"] <= 2.0, cfg15
+    assert cfg15["incremental_rejected"] == 0, cfg15
+    assert cfg15["verifier_rejections"] == 0, cfg15
+    assert cfg15["ledger"]["entries"] > 0
+    assert isinstance(cfg15["incremental_ok"], bool)
+
     # the tiny cfg11 gangsched smoke (ISSUE 10): preemption fired, every
     # gang stayed atomic, and the eviction set stayed minimal
     gangs = line["detail"]["cfg11_gangs"]

@@ -207,6 +207,10 @@ def sample_problem() -> dict:
         # a non-default backend so the relaxsolve mode selector provably
         # survives the wire (ISSUE 13; same reasoning as the tenant)
         solver_mode="relax",
+        # a non-empty prior-solve reference so the incsolve warm-start
+        # key provably survives the wire (ISSUE 16; same reasoning) —
+        # empty means "no predecessor" and is omitted from the header
+        prev_fingerprint="a" * 24 + "+mrelax",
     )
 
 

@@ -1,0 +1,670 @@
+"""incsolve (ISSUE 16): the churn-proportional incremental re-solve
+engine behind the Solver seam.
+
+The battery pins the contract layers separately:
+
+* replay fidelity — every fuzz seed plus the topology/gang/relax shapes
+  re-solved through the incremental path must be byte-identical (modulo
+  solve_seconds) to the fresh answer, with the client-facing rejection
+  counter UNMOVED (the engine's self-verify must never masquerade as a
+  wire/device corruption);
+* churn proportionality — pinned classes never re-enter the scan: the
+  engine's dirty/pinned accounting proves only the churned class paid;
+* the drift controller — the interval forces periodic full solves, and a
+  replayed packing regressing past the node bound resets instead of
+  ratcheting;
+* amnesia — a fresh daemon (respawned member) misses and solves fully,
+  never wrongly; the client clears its prev-fingerprint on every
+  degradation so a recovered sidecar is never asked to warm-start from
+  a solve it neither performed nor remembers;
+* bounds — the PackingLedger is LRU in entries and bytes.
+"""
+import copy
+
+import pytest
+
+from tests.helpers import make_nodepool, make_pod
+from tests.test_fuzz_parity import fuzz_scenario
+
+from karpenter_core_tpu.cloudprovider.fake import fake_instance_types
+from karpenter_core_tpu.metrics import wiring as m
+from karpenter_core_tpu.solver import codec, service
+from karpenter_core_tpu.solver import incremental as incsolve
+from karpenter_core_tpu.solver.gangs import GANG_ANNOTATION
+
+
+def _strip(data: bytes) -> dict:
+    h = codec.decode_solve_results(data)
+    h.pop("solve_seconds", None)
+    return h
+
+
+def _fp(body: bytes) -> str:
+    return codec.problem_fingerprint(codec._json_header(body))
+
+
+def _encode(pools, its, existing, ds, pods, **kw) -> bytes:
+    return codec.encode_solve_request(
+        copy.deepcopy(pools), its, copy.deepcopy(existing),
+        copy.deepcopy(ds), copy.deepcopy(pods), **kw
+    )
+
+
+def _outcomes():
+    return dict(m.SOLVER_INCREMENTAL.values)
+
+
+# ---------------------------------------------------------------------------
+# replay fidelity: warm replays are byte-identical to fresh solves
+# ---------------------------------------------------------------------------
+
+
+class TestWarmReplayParity:
+    @pytest.mark.parametrize("seed", range(14))
+    def test_fuzz_seed_warm_parity(self, seed):
+        pods, existing, pools, its = fuzz_scenario(seed)
+        daemon = service.SolverDaemon()
+        body = _encode(pools, its, existing, [], pods, max_slots=128)
+        rejected = dict(m.SOLVER_RESULT_REJECTED.values)
+        inc = _encode(
+            pools, its, existing, [], pods, max_slots=128,
+            prev_fingerprint=_fp(body),
+        )
+        out1, _ = daemon.solve(inc)
+        assert daemon.incremental.last["outcome"] == "full"
+        assert daemon.incremental.last["reason"] == "miss"
+        out2, _ = daemon.solve(inc)
+        assert daemon.incremental.last["outcome"] == "warm", (
+            daemon.incremental.last
+        )
+        assert _strip(out1) == _strip(out2)
+        # the trust anchor's client-facing counter never moves for a
+        # replay: self-verify rejections are a degradation, not a reject
+        assert dict(m.SOLVER_RESULT_REJECTED.values) == rejected
+
+    def test_topology_problem_warm_parity(self):
+        from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (  # noqa: E501
+            Topology,
+        )
+
+        pools = [make_nodepool()]
+        its = {"default": fake_instance_types(4)}
+        pods = [
+            make_pod(cpu=0.5, name=f"sp{i}", spread_zone=True)
+            for i in range(6)
+        ]
+        topo = Topology(domains={"topology.kubernetes.io/zone": {
+            "zone-a": 0, "zone-b": 0,
+        }})
+        daemon = service.SolverDaemon()
+        body = _encode(pools, its, [], [], pods, topology=topo)
+        inc = _encode(
+            pools, its, [], [], pods, topology=topo,
+            prev_fingerprint=_fp(body),
+        )
+        out1, _ = daemon.solve(inc)
+        out2, _ = daemon.solve(inc)
+        assert daemon.incremental.last["outcome"] == "warm"
+        assert _strip(out1) == _strip(out2)
+
+    def test_gang_problem_warm_parity(self):
+        pools = [make_nodepool()]
+        its = {"default": fake_instance_types(4)}
+        pods = []
+        for i in range(4):
+            p = make_pod(cpu=1.0, name=f"g{i}")
+            p.metadata.annotations[GANG_ANNOTATION] = "job-1"
+            pods.append(p)
+        daemon = service.SolverDaemon()
+        body = _encode(pools, its, [], [], pods)
+        inc = _encode(
+            pools, its, [], [], pods, prev_fingerprint=_fp(body)
+        )
+        out1, _ = daemon.solve(inc)
+        out2, _ = daemon.solve(inc)
+        assert daemon.incremental.last["outcome"] == "warm"
+        assert _strip(out1) == _strip(out2)
+
+    def test_relax_problem_warm_parity_and_mode_keyed_ledger(self):
+        pools = [make_nodepool()]
+        its = {"default": fake_instance_types(4)}
+        pods = [make_pod(cpu=1.0, name=f"r{i}") for i in range(8)]
+        daemon = service.SolverDaemon()
+        for mode in ("ffd", "relax"):
+            body = _encode(pools, its, [], [], pods, solver_mode=mode)
+            inc = _encode(
+                pools, its, [], [], pods, solver_mode=mode,
+                prev_fingerprint=_fp(body),
+            )
+            out1, _ = daemon.solve(inc)
+            assert daemon.incremental.last["outcome"] == "full"
+            out2, _ = daemon.solve(inc)
+            assert daemon.incremental.last["outcome"] == "warm"
+            assert _strip(out1) == _strip(out2)
+        # the raw fingerprint is mode-blind; the ledger key must not be
+        # (an ffd packing replayed for a relax request would dodge the
+        # optimizer the client asked for)
+        assert daemon.incremental.ledger.stats()["entries"] == 2
+
+
+# ---------------------------------------------------------------------------
+# churn proportionality: pinned classes never re-enter the scan
+# ---------------------------------------------------------------------------
+
+
+class TestChurnSequences:
+    # geometry chosen so the two classes exactly fill SEPARATE 8-cpu
+    # nodes: churn in class b then touches no node holding class a, so
+    # class a must stay pinned (a shared node would legitimately dirty
+    # both classes — that conservatism is covered by the drift tests)
+    POOLS = [make_nodepool()]
+    ITS = {"default": fake_instance_types(4)}
+
+    def _pods(self, big, small):
+        return (
+            [make_pod(cpu=1.0, name=f"a{i}") for i in range(big)]
+            + [make_pod(cpu=2.0, name=f"b{i}") for i in range(small)]
+        )
+
+    def test_count_change_dirties_only_that_class(self):
+        daemon = service.SolverDaemon()
+        pods = self._pods(8, 4)
+        body = _encode(self.POOLS, self.ITS, [], [], pods)
+        inc = _encode(
+            self.POOLS, self.ITS, [], [], pods,
+            prev_fingerprint=_fp(body),
+        )
+        daemon.solve(inc)
+        grown = self._pods(8, 5)  # class b grows, class a untouched
+        out, _ = daemon.solve(_encode(
+            self.POOLS, self.ITS, [], [], grown,
+            prev_fingerprint=_fp(body),
+        ))
+        last = daemon.incremental.last
+        assert last["outcome"] == "partial", last
+        assert last["dirty_classes"] == 1
+        assert last["dirty_pods"] == 5      # all of class b re-enters
+        assert last["pinned_pods"] == 8     # class a never re-enters
+        # every current pod is accounted for in the merged result
+        h = _strip(out)
+        placed = {u for c in h["claims"] for u in c["pod_uids"]}
+        placed |= {u for s in h["existing"] for u in s["pod_uids"]}
+        assert placed == {p.uid for p in grown}
+
+    def test_steady_churn_rounds_stay_incremental(self):
+        # chained lineage, as the real client drives it: each round
+        # names the previous round's fingerprint, not the original's
+        daemon = service.SolverDaemon()
+        pods = self._pods(8, 4)
+        body = _encode(self.POOLS, self.ITS, [], [], pods)
+        prev = _fp(body)
+        daemon.solve(_encode(
+            self.POOLS, self.ITS, [], [], pods, prev_fingerprint=prev
+        ))
+        before = _outcomes()
+        for round_ in range(4):
+            pods = self._pods(8, 4 + round_ + 1)
+            body = _encode(self.POOLS, self.ITS, [], [], pods)
+            daemon.solve(_encode(
+                self.POOLS, self.ITS, [], [], pods,
+                prev_fingerprint=prev,
+            ))
+            prev = _fp(body)
+            last = daemon.incremental.last
+            assert last["outcome"] == "partial", last
+            assert last["pinned_pods"] == 8
+        delta = {
+            k: _outcomes().get(k, 0) - before.get(k, 0)
+            for k in _outcomes()
+        }
+        assert delta.get((("outcome", "partial"),), 0) == 4
+
+    def test_new_class_is_dirty_alone(self):
+        daemon = service.SolverDaemon()
+        pods = self._pods(8, 0)
+        body = _encode(self.POOLS, self.ITS, [], [], pods)
+        daemon.solve(_encode(
+            self.POOLS, self.ITS, [], [], pods,
+            prev_fingerprint=_fp(body),
+        ))
+        withnew = pods + [make_pod(cpu=4.0, name="new0")]
+        daemon.solve(_encode(
+            self.POOLS, self.ITS, [], [], withnew,
+            prev_fingerprint=_fp(body),
+        ))
+        last = daemon.incremental.last
+        assert last["outcome"] == "partial", last
+        assert (last["dirty_classes"], last["dirty_pods"]) == (1, 1)
+        assert last["pinned_pods"] == 8
+
+
+# ---------------------------------------------------------------------------
+# drift controller
+# ---------------------------------------------------------------------------
+
+
+class TestDriftController:
+    POOLS = [make_nodepool()]
+    ITS = {"default": fake_instance_types(4)}
+
+    def _daemon(self, **kw):
+        return service.SolverDaemon(
+            incremental=incsolve.IncrementalEngine(**kw)
+        )
+
+    def test_interval_forces_periodic_full_solves(self):
+        daemon = self._daemon(full_interval=3)
+        pods = [make_pod(cpu=1.0, name=f"d{i}") for i in range(6)]
+        body = _encode(self.POOLS, self.ITS, [], [], pods)
+        inc = _encode(
+            self.POOLS, self.ITS, [], [], pods,
+            prev_fingerprint=_fp(body),
+        )
+        seen = []
+        for _ in range(7):
+            daemon.solve(inc)
+            seen.append(daemon.incremental.last["outcome"])
+        assert seen == [
+            "full", "warm", "warm", "drift_reset", "warm", "warm",
+            "drift_reset",
+        ]
+
+    def test_node_regression_resets_instead_of_ratcheting(self):
+        daemon = self._daemon()
+        # big pods: one claim each, so the claim count is legible
+        pods = [make_pod(cpu=8.0, name=f"n{i}") for i in range(3)]
+        body = _encode(self.POOLS, self.ITS, [], [], pods)
+        inc = _encode(
+            self.POOLS, self.ITS, [], [], pods,
+            prev_fingerprint=_fp(body),
+        )
+        daemon.solve(inc)
+        engine = daemon.incremental
+        entry = next(iter(engine.ledger._entries.values()))
+        assert entry.node_count >= 2
+        # simulate a stale baseline: the last full solve (claims to) have
+        # needed zero nodes, so any replay carrying claims regresses
+        entry.baseline_nodes = 0
+        grown = pods + [make_pod(cpu=0.5, name="tiny")]
+        out, _ = daemon.solve(_encode(
+            self.POOLS, self.ITS, [], [], grown,
+            prev_fingerprint=_fp(body),
+        ))
+        assert engine.last["outcome"] == "drift_reset"
+        assert engine.last["reason"] == "node_regression"
+        # the served answer is the fresh solve, not the regressed replay
+        placed = {
+            u for c in _strip(out)["claims"] for u in c["pod_uids"]
+        }
+        assert placed == {p.uid for p in grown}
+
+    def test_tampered_replay_is_rejected_by_self_verify(self):
+        daemon = self._daemon()
+        pods = [make_pod(cpu=1.0, name=f"v{i}") for i in range(6)]
+        body = _encode(self.POOLS, self.ITS, [], [], pods)
+        inc = _encode(
+            self.POOLS, self.ITS, [], [], pods,
+            prev_fingerprint=_fp(body),
+        )
+        daemon.solve(inc)
+        engine = daemon.incremental
+        entry = next(iter(engine.ledger._entries.values()))
+        # sabotage the remembered packing: drop a placed pod so the
+        # replay under-covers (the exact wrong-bind shape the verifier
+        # exists to catch)
+        for c in entry.claims:
+            if c["pod_uids"]:
+                c["pod_uids"] = c["pod_uids"][1:]
+                break
+        rejected = dict(m.SOLVER_RESULT_REJECTED.values)
+        out, _ = daemon.solve(inc)
+        assert engine.last["outcome"] == "rejected"
+        assert engine.last["reason"].startswith("verify:")
+        # degraded to a fresh (correct) solve, and the client-facing
+        # rejection counter never moved
+        placed = {
+            u for c in _strip(out)["claims"] for u in c["pod_uids"]
+        }
+        assert placed == {p.uid for p in pods}
+        assert dict(m.SOLVER_RESULT_REJECTED.values) == rejected
+
+
+# ---------------------------------------------------------------------------
+# amnesia: a respawned member misses and solves fully, never wrongly
+# ---------------------------------------------------------------------------
+
+
+class TestAmnesia:
+    POOLS = [make_nodepool()]
+    ITS = {"default": fake_instance_types(4)}
+
+    def test_fresh_daemon_with_prev_fingerprint_solves_full(self):
+        pods = [make_pod(cpu=1.0, name=f"m{i}") for i in range(5)]
+        body = _encode(self.POOLS, self.ITS, [], [], pods)
+        inc = _encode(
+            self.POOLS, self.ITS, [], [], pods,
+            prev_fingerprint=_fp(body),
+        )
+        first = service.SolverDaemon()
+        out1, _ = first.solve(inc)
+        out1b, _ = first.solve(inc)
+        assert first.incremental.last["outcome"] == "warm"
+        # the member restarts: empty ledger == miss == full solve, and
+        # determinism makes the answer identical anyway
+        respawned = service.SolverDaemon()
+        out2, _ = respawned.solve(inc)
+        assert respawned.incremental.last["outcome"] == "full"
+        assert respawned.incremental.last["reason"] == "miss"
+        assert _strip(out1) == _strip(out2)
+
+    def test_no_incremental_daemon_never_enters_engine(self):
+        pods = [make_pod(cpu=1.0, name=f"x{i}") for i in range(4)]
+        body = _encode(self.POOLS, self.ITS, [], [], pods)
+        inc = _encode(
+            self.POOLS, self.ITS, [], [], pods,
+            prev_fingerprint=_fp(body),
+        )
+        daemon = service.SolverDaemon(incremental=False)
+        before = _outcomes()
+        out, _ = daemon.solve(inc)
+        assert _outcomes() == before
+        assert daemon.health()["incremental"] == {"enabled": False}
+        placed = {
+            u for c in _strip(out)["claims"] for u in c["pod_uids"]
+        }
+        assert placed == {p.uid for p in pods}
+
+    def test_request_without_prev_fingerprint_bypasses_engine(self):
+        pods = [make_pod(cpu=1.0, name=f"y{i}") for i in range(4)]
+        daemon = service.SolverDaemon()
+        before = _outcomes()
+        daemon.solve(_encode(self.POOLS, self.ITS, [], [], pods))
+        assert _outcomes() == before
+        assert daemon.incremental.ledger.stats()["entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the client contract: prev-fingerprint memory + degradation clearing
+# ---------------------------------------------------------------------------
+
+
+class TestClientContract:
+    def test_remote_scheduler_round_trip_warms_daemon(self):
+        from karpenter_core_tpu.solver.remote import (
+            RemoteScheduler,
+            SolverClient,
+        )
+
+        daemon = service.SolverDaemon()
+        srv = service.serve(0, daemon=daemon)
+        try:
+            addr = f"127.0.0.1:{srv.server_address[1]}"
+            client = SolverClient(addr)
+            pools = [make_nodepool()]
+            its = {"default": fake_instance_types(4)}
+            pods = [make_pod(cpu=1.0, name=f"c{i}") for i in range(5)]
+
+            def solve_once():
+                # the provisioner rebuilds the RemoteScheduler per solve;
+                # prev-fingerprint memory must live on the durable client
+                rs = RemoteScheduler(
+                    client, copy.deepcopy(pools), its,
+                    device_scheduler_opts={"incremental": True},
+                )
+                return rs.solve(copy.deepcopy(pods))
+
+            assert client.prev_fingerprint == ""
+            before = _outcomes()
+            solve_once()
+            assert client.prev_fingerprint
+            assert _outcomes() == before  # first request named no prior
+            solve_once()  # names the first: miss, records the packing
+            assert daemon.incremental.last["outcome"] == "full"
+            assert daemon.incremental.last["reason"] == "miss"
+            solve_once()  # names the second: replay
+            assert daemon.incremental.last["outcome"] == "warm"
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_non_incremental_client_sends_no_reference(self):
+        from karpenter_core_tpu.solver.remote import (
+            RemoteScheduler,
+            SolverClient,
+        )
+
+        daemon = service.SolverDaemon()
+        srv = service.serve(0, daemon=daemon)
+        try:
+            addr = f"127.0.0.1:{srv.server_address[1]}"
+            client = SolverClient(addr)
+            client.prev_fingerprint = "stale"
+            pools = [make_nodepool()]
+            its = {"default": fake_instance_types(4)}
+            pods = [make_pod(cpu=1.0, name=f"z{i}") for i in range(4)]
+            before = _outcomes()
+            RemoteScheduler(client, pools, its).solve(pods)
+            RemoteScheduler(client, pools, its).solve(pods)
+            assert _outcomes() == before
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_degradation_clears_the_reference(self):
+        import socket
+
+        from karpenter_core_tpu.solver.remote import (
+            RemoteScheduler,
+            SolverClient,
+        )
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()  # nothing listens: connection refused
+        client = SolverClient(
+            f"127.0.0.1:{port}", timeout=0.5, max_retries=1,
+            sleep=lambda _s: None,
+        )
+        client.prev_fingerprint = "doomed"
+        pools = [make_nodepool()]
+        its = {"default": fake_instance_types(3)}
+        pods = [make_pod(cpu=1.0, name=f"f{i}") for i in range(3)]
+        rs = RemoteScheduler(
+            client, pools, its,
+            device_scheduler_opts={"incremental": True},
+        )
+        results = rs.solve(pods)
+        assert results.all_pods_scheduled()  # greedy fallback served
+        # the next request must NOT name a predecessor the fleet never
+        # acknowledged — degradation resets the lineage
+        assert client.prev_fingerprint == ""
+
+    def test_fleet_router_carries_the_memory(self):
+        # digest affinity pins a snapshot's lineage to one member, so one
+        # reference slot on the router suffices — and an incremental
+        # RemoteScheduler over a fleet warms that member's ledger
+        from karpenter_core_tpu.solver.remote import (
+            FleetRouter,
+            RemoteScheduler,
+            SolverClient,
+        )
+
+        daemon = service.SolverDaemon()
+        srv = service.serve(0, daemon=daemon)
+        try:
+            addr = f"127.0.0.1:{srv.server_address[1]}"
+            router = FleetRouter([SolverClient(addr)])
+            assert router.prev_fingerprint == ""
+            pools = [make_nodepool()]
+            its = {"default": fake_instance_types(4)}
+            pods = [make_pod(cpu=1.0, name=f"fl{i}") for i in range(5)]
+            for _ in range(3):
+                RemoteScheduler(
+                    router, copy.deepcopy(pools), its,
+                    device_scheduler_opts={"incremental": True},
+                ).solve(copy.deepcopy(pods))
+            assert router.prev_fingerprint
+            assert daemon.incremental.last["outcome"] == "warm"
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# ledger bounds + observability
+# ---------------------------------------------------------------------------
+
+
+def _entry(key: str, nbytes: int = 100) -> incsolve.LedgerEntry:
+    return incsolve.LedgerEntry(
+        key=key, core_digest="c", topo_digest="t", node_digests={},
+        label_aware=False, classes={}, claims=[], existing=[], errors={},
+        evictions={}, node_count=0, baseline_nodes=0, nbytes=nbytes,
+    )
+
+
+class TestPackingLedger:
+    def test_entry_bound_evicts_lru(self):
+        led = incsolve.PackingLedger(max_entries=2)
+        led.remember(_entry("a"))
+        led.remember(_entry("b"))
+        led.get("a")  # refresh a: b becomes the eviction victim
+        led.remember(_entry("c"))
+        assert led.get("b") is None
+        assert led.get("a") is not None and led.get("c") is not None
+        assert led.evictions == {"entries": 1}
+
+    def test_byte_bound_evicts_but_keeps_newest(self):
+        led = incsolve.PackingLedger(max_entries=10, max_bytes=250)
+        led.remember(_entry("a", nbytes=100))
+        led.remember(_entry("b", nbytes=100))
+        led.remember(_entry("big", nbytes=1000))  # alone over the bound
+        assert led.get("a") is None and led.get("b") is None
+        assert led.get("big") is not None  # never evict down to zero
+        assert led.evictions["bytes"] == 2
+
+    def test_rewrite_replaces_bytes_not_duplicates(self):
+        led = incsolve.PackingLedger()
+        led.remember(_entry("a", nbytes=100))
+        led.remember(_entry("a", nbytes=300))
+        stats = led.stats()
+        assert (stats["entries"], stats["bytes"]) == (1, 300)
+
+    def test_gauges_track_residency(self):
+        led = incsolve.PackingLedger()
+        led.remember(_entry("a", nbytes=128))
+        assert m.SOLVER_LEDGER_ENTRIES.values[()] == 1.0
+        assert m.SOLVER_LEDGER_BYTES.values[()] == 128.0
+
+    def test_healthz_exposes_engine_stats(self):
+        daemon = service.SolverDaemon()
+        h = daemon.health()["incremental"]
+        assert h["enabled"] is True
+        assert h["full_interval"] == incsolve.DEFAULT_FULL_INTERVAL
+        assert set(h["ledger"]) >= {"entries", "bytes", "evictions"}
+
+
+# ---------------------------------------------------------------------------
+# the twin as drift judge: a churning day, incremental vs fresh
+# ---------------------------------------------------------------------------
+
+
+class TestTwinDriftJudge:
+    """The closed loop is where warm-start packing could quietly rot:
+    each replay seeds the next, so per-solve parity doesn't by itself
+    bound a day of compounding. The twin runs the same churning day
+    twice — incremental on and off — and judges the node-count integral
+    (ledger.node_seconds), the ISSUE's node-quality surface."""
+
+    def _day(self, incremental: bool):
+        from karpenter_core_tpu.twin.scenario import (
+            Scenario,
+            WorkloadWave,
+        )
+
+        # a simulated day at 30-minute ticks: a standing serving base
+        # plus a trickle of short-lived batch waves — every tick a few
+        # pods arrive and a few expire, the steady low-churn regime the
+        # incremental path exists for
+        half_hour = 1800.0
+        waves = [
+            WorkloadWave(at=0.0, cluster=0, kind="serving", count=16,
+                         min_available=2),
+        ]
+        for i in range(1, 46):
+            waves.append(WorkloadWave(
+                at=i * half_hour, cluster=0, kind="batch", count=2,
+                lifetime=3 * half_hour,
+            ))
+        return Scenario(
+            seed=11,
+            clusters=1,
+            duration=86400.0,
+            tick=half_hour,
+            solver="tpu",
+            fleet=1,
+            incremental=incremental,
+            waves=tuple(waves),
+        )
+
+    @pytest.mark.slow
+    def test_day_of_churn_node_quality_within_two_percent(self):
+        from karpenter_core_tpu.twin.harness import run_scenario
+
+        inc = run_scenario(self._day(incremental=True))
+        fresh = run_scenario(self._day(incremental=False))
+
+        # the engine actually carried the day (non-vacuous) and never
+        # served a packing the verifier wouldn't stand behind
+        assert inc.counters["incremental_warm"] > 0
+        assert inc.counters["result_rejected"] == 0
+        assert inc.violations == []
+        assert fresh.counters["incremental_total"] == 0
+
+        inc_ns = inc.ledger.node_seconds[0]
+        fresh_ns = fresh.ledger.node_seconds[0]
+        assert fresh_ns > 0
+        # node-quality drift: the day's node-count integral must stay
+        # within 2% of the fresh-solve twin (the acceptance bound)
+        assert abs(inc_ns - fresh_ns) <= 0.02 * fresh_ns, (
+            inc_ns, fresh_ns
+        )
+        # and nothing binds late because of replays
+        assert inc.ledger.slo_misses == fresh.ledger.slo_misses
+
+    def test_incremental_scenario_requires_fleet(self):
+        from karpenter_core_tpu.twin.scenario import (
+            Scenario,
+            WorkloadWave,
+            validate_scenario,
+        )
+
+        s = Scenario(
+            incremental=True,
+            waves=(WorkloadWave(at=0.0, cluster=0, kind="batch",
+                                count=2),),
+        )
+        with pytest.raises(ValueError, match="fleet"):
+            validate_scenario(s)
+
+    def test_incremental_survives_scenario_codec(self):
+        from karpenter_core_tpu.twin.scenario import (
+            Scenario,
+            WorkloadWave,
+            decode_scenario,
+            encode_scenario,
+        )
+
+        s = Scenario(
+            solver="tpu", fleet=1, incremental=True,
+            waves=(WorkloadWave(at=0.0, cluster=0, kind="batch",
+                                count=2),),
+        )
+        assert decode_scenario(encode_scenario(s)).incremental is True
+        # absent on the wire decodes to off: old encodings stay valid
+        old = {
+            k: v for k, v in encode_scenario(s).items()
+            if k != "incremental"
+        }
+        assert decode_scenario(old).incremental is False
