@@ -78,6 +78,18 @@ Secondary lines (reported in `detail`):
                   engine outcome mix, and the zero-rejections gates. A
                   tiny version runs under BENCH_FAST=1 so tier-1 smokes
                   the warm-replay path
+  cfg16_elastic   the closed-loop elastic solver tier (ISSUE 17): an
+                  autoscaled tier (TierAutoscaler over real spawn/drain)
+                  vs a max-fixed-size control on an identical
+                  surge-then-quiet trace — member-seconds on a virtual
+                  tick clock (gate: >=30% below the control), post-ramp
+                  per-tenant queue-wait p99 (gate at full scale:
+                  equal-or-better), the resize-cost audit (zero miss
+                  rounds / fallbacks / open breakers across remaps), and
+                  the brownout ladder firing 1->2->3 and clearing
+                  3->2->1->0 in order under forced max-scale overload
+                  with the verifier counter unmoved. A tiny version runs
+                  under BENCH_FAST=1 so tier-1 smokes the elastic path
   cfg9_verified   the verification trust anchor's cost: the primary
                   config runs with the ResultVerifier ON (the production
                   default — every config above already pays it), and this
@@ -2000,6 +2012,388 @@ def _incremental_bench(
     }
 
 
+def _elastic_bench(
+    n_tenants=6,
+    n_types=48,
+    n_pods=36,
+    surge_ticks=6,
+    quiet_ticks=8,
+    tick_s=30.0,
+    max_members=4,
+):
+    """cfg16_elastic: the closed-loop elastic solver tier (ISSUE 17).
+
+    Phase 1 (economics): N tenants with distinct catalogs drive a
+    surge-then-quiet load trace against two tiers serving the identical
+    workload — one autoscaled (starts at 1 member, TierAutoscaler grows
+    it through the real spawn path and retires through the faultless
+    drain path), one pinned at max size (the control). Member-seconds
+    are charged on a virtual tick clock (live size x tick), so the
+    economics are deterministic; queue waits are measured from the real
+    gateways AFTER the autoscaler's ramp window, when both tiers serve
+    at full size. Resize cost is audited the way the contract states it:
+    rendezvous re-keys only the retired/granted member's digests, so a
+    resize costs at most one upload round per remapped lineage and
+    NOTHING else — zero segment-miss repair rounds, zero greedy
+    fallbacks, every surviving breaker closed.
+
+    Phase 2 (ladder): a tier pinned at max size is driven over budget;
+    the brownout rungs must fire 1 -> 2 -> 3 strictly in order (relax
+    served as FFD, batch window widened, admission halved), then clear
+    3 -> 2 -> 1 -> 0 restoring the gateway shape, with the verifier
+    rejection counter unmoved throughout.
+
+    Gates: `saving_ok` (autoscaled member-seconds >= 30% below the
+    fixed-size control — structural, the sizes ride the deterministic
+    policy), `resize_cost_ok` (miss rounds 0, fallbacks 0, breakers
+    closed), `brownout_order_ok` (rungs fire and clear in order, shape
+    restored, rejections unmoved); `p99_ok` and the headline
+    `elastic_ok` are judged at the full-scale round."""
+    import copy
+    import threading
+
+    from karpenter_core_tpu.cloudprovider.kwok import bench_catalog
+    from karpenter_core_tpu.metrics import wiring as m
+    from karpenter_core_tpu.solver import fleet as fleetmod
+    from karpenter_core_tpu.solver import remote, service
+    from karpenter_core_tpu.solver.autoscale import (
+        MemberSignal,
+        TierAutoscaler,
+        TierSignals,
+    )
+
+    tenant_problems = []
+    for t in range(n_tenants):
+        # floor 20: below that bench_catalog lacks the shapes
+        # _plain_pods needs (the cfg13 fleet-phase floor)
+        tcat = bench_catalog(max(n_types // 2 + 5 * t, 20))
+        tenant_problems.append((
+            f"tenant{t}",
+            [_pool()],
+            {"default": list(tcat)},
+            _plain_pods(n_pods),
+        ))
+    vnow = [0.0]
+
+    # per-member capacity (solves per tick) chosen so the surge at full
+    # tenant fan-in is under budget ONLY at max size — the autoscaled
+    # tier must ramp all the way — while a single quiet tenant sits in
+    # the scale-down band even at max size
+    member_capacity = n_tenants / (max_members - 0.5)
+
+    class BenchTier:
+        """The autoscaler's tier surface over in-thread daemons: the
+        pressure signal is offered load per live member (deterministic —
+        the resize trace must not ride CPU timing), everything else is
+        the production path (real spawn, real drain, real routers)."""
+
+        def __init__(self, start):
+            self.daemons, self.servers = [], []
+            self.addrs, self.ids = [], []
+            self.routers, self.tenants = [], []
+            self._next = 0
+            self.offered = 0.0
+            self.remapped = 0
+            for _ in range(start):
+                self._spawn()
+
+        def _spawn(self):
+            daemon = service.SolverDaemon(gateway=fleetmod.FleetGateway(
+                max_depth=8, max_batch=4, batch_window=0.002,
+            ))
+            srv = service.serve(0, daemon=daemon)
+            self.daemons.append(daemon)
+            self.servers.append(srv)
+            self.addrs.append(f"127.0.0.1:{srv.server_address[1]}")
+            self.ids.append(str(self._next))
+            self._next += 1
+            return len(self.ids) - 1
+
+        def client(self, addr, mid, tenant):
+            return remote.SolverClient(
+                addr, timeout=600, member=mid, tenant=tenant,
+                wire_mode="delta",
+            )
+
+        def observe(self):
+            members = [MemberSignal(member=mid) for mid in self.ids]
+            pressure = self.offered / (len(self.ids) * member_capacity)
+            return TierSignals(
+                members=members, pressure=pressure, storm=False
+            )
+
+        def _winners(self):
+            out = {}
+            for router in self.routers:
+                with router._lock:
+                    if router._lineage_key is not None:
+                        out[router] = router._lineage_winner_locked()
+            return out
+
+        def _count_remaps(self, before):
+            for router, winner in before.items():
+                with router._lock:
+                    if router._lineage_winner_locked() != winner:
+                        self.remapped += 1
+
+        def scale_up(self):
+            before = self._winners()
+            idx = self._spawn()
+            for tenant, router in zip(self.tenants, self.routers):
+                router.add_member(
+                    self.client(self.addrs[idx], self.ids[idx], tenant),
+                    member_id=self.ids[idx],
+                )
+            self._count_remaps(before)
+
+        def scale_down(self, index):
+            before = self._winners()
+            for router in self.routers:
+                router.remove_member(index)
+            daemon = self.daemons.pop(index)
+            srv = self.servers.pop(index)
+            self.addrs.pop(index)
+            self.ids.pop(index)
+            # the faultless retirement path: flush queued tickets (503,
+            # degrade-without-charge on the client), then the socket
+            daemon.drain()
+            srv.shutdown()
+            srv.server_close()
+            self._count_remaps(before)
+
+        def set_rung(self, rung):
+            for daemon in self.daemons:
+                daemon.set_brownout(rung)
+
+        def stop(self):
+            for srv in self.servers:
+                srv.shutdown()
+                srv.server_close()
+
+    def counter_total(counter):
+        return sum(counter.values.values())
+
+    def run_tier(autoscale):
+        fall0 = counter_total(m.SOLVER_RPC_FALLBACKS)
+        miss0 = m.SOLVER_RPC_FAILURES.value({"cause": "segment_miss"})
+        tier = BenchTier(1 if autoscale else max_members)
+        scheds = {}
+        try:
+            for tenant, tpools, tits, _tp in tenant_problems:
+                members = [
+                    tier.client(addr, mid, tenant)
+                    for addr, mid in zip(tier.addrs, tier.ids)
+                ]
+                router = remote.FleetRouter(members, tenant=tenant)
+                tier.routers.append(router)
+                tier.tenants.append(tenant)
+                scheds[tenant] = remote.RemoteScheduler(
+                    router, tpools, tits,
+                    device_scheduler_opts={"max_slots": 256},
+                    verify=not NO_VERIFY,
+                )
+            autoscaler = TierAutoscaler(
+                tier, 1, max_members,
+                up_stable=1, down_stable=2,
+                # 0.45: a lone quiet tenant must sit in the scale-down
+                # band at EVERY size down to 2 members (1/(2*capacity)),
+                # or the descent stalls halfway
+                down_pressure=0.45,
+                up_cooldown_s=0.0, down_cooldown_s=0.0,
+                time_fn=lambda: vnow[0],
+            ) if autoscale else None
+            # both runs judge queue waits only AFTER this many ticks —
+            # the window the autoscaled tier needs to reach max size
+            ramp = max_members - 1
+            member_seconds = 0.0
+            sizes = []
+            for tick in range(surge_ticks + quiet_ticks):
+                surge = tick < surge_ticks
+                active = (
+                    tenant_problems if surge
+                    else tenant_problems[tick % n_tenants:][:1]
+                )
+                tier.offered = float(len(active))
+                vnow[0] += tick_s
+                if autoscaler is not None:
+                    autoscaler.step()
+                threads = [
+                    threading.Thread(
+                        target=lambda te=tenant, tp=tpods: scheds[te]
+                        .solve(copy.deepcopy(tp)),
+                        daemon=True,
+                    )
+                    for tenant, _tp_, _ti, tpods in active
+                ]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                member_seconds += len(tier.ids) * tick_s
+                sizes.append(len(tier.ids))
+                if tick == ramp - 1:
+                    for daemon in tier.daemons:
+                        daemon.gateway.snapshot(reset=True)
+            p99 = {}
+            for daemon in tier.daemons:
+                snap = daemon.gateway.snapshot()
+                for tenant, row in snap["tenants"].items():
+                    p99[tenant] = max(
+                        p99.get(tenant, 0.0), row["wait_p99_s"]
+                    )
+            open_breakers = sum(
+                1 for router in tier.routers for c in router.members
+                if c.breaker.state != remote.STATE_CLOSED
+            )
+            return {
+                "sizes": sizes,
+                "member_seconds": member_seconds,
+                "p99_by_tenant": {
+                    t: round(v, 4) for t, v in sorted(p99.items())
+                },
+                "p99_max_s": round(max(p99.values() or [0.0]), 4),
+                "remapped_lineages": tier.remapped,
+                "miss_rounds": int(
+                    m.SOLVER_RPC_FAILURES.value(
+                        {"cause": "segment_miss"}
+                    ) - miss0
+                ),
+                "fallbacks": int(
+                    counter_total(m.SOLVER_RPC_FALLBACKS) - fall0
+                ),
+                "open_breakers": open_breakers,
+                "decisions": (
+                    [list(d) for d in autoscaler.decisions]
+                    if autoscaler else None
+                ),
+            }
+        finally:
+            tier.stop()
+
+    auto = run_tier(autoscale=True)
+    fixed = run_tier(autoscale=False)
+
+    # -- phase 2: the brownout ladder at forced max-scale overload ---------
+
+    def brownout_ladder():
+        tier = BenchTier(1)
+        tenant, tpools, tits, tpods = tenant_problems[0]
+        try:
+            tier.routers.append(remote.FleetRouter(
+                [tier.client(tier.addrs[0], tier.ids[0], tenant)],
+                tenant=tenant,
+            ))
+            tier.tenants.append(tenant)
+            sched_relax = remote.RemoteScheduler(
+                tier.routers[0], tpools, tits,
+                device_scheduler_opts={
+                    "max_slots": 256, "solver_mode": "relax",
+                },
+                verify=not NO_VERIFY,
+            )
+            autoscaler = TierAutoscaler(
+                tier, 1, 1,
+                up_stable=1, down_stable=10 ** 6,
+                rung_up_stable=1, rung_down_stable=1,
+                time_fn=lambda: vnow[0],
+            )
+            daemon = tier.daemons[0]
+            base_window = daemon.gateway.batch_window
+            base_depth = daemon.gateway.max_depth
+            rej0 = counter_total(m.SOLVER_RESULT_REJECTED)
+            served0 = counter_total(m.SOLVERD_BROWNOUT_SERVED)
+            rungs = []
+            tier.offered = 100.0  # over budget, nowhere left to scale
+            for _ in range(3):
+                vnow[0] += tick_s
+                autoscaler.step()
+                rungs.append(daemon.brownout_rung)
+            at_max = {
+                "window_s": daemon.gateway.batch_window,
+                "depth": daemon.gateway.max_depth,
+            }
+            # rung >= 1: a relax request is served in FFD mode (anytime
+            # answer, verification still on)
+            res = sched_relax.solve(copy.deepcopy(tpods))
+            served = int(
+                counter_total(m.SOLVERD_BROWNOUT_SERVED) - served0
+            )
+            tier.offered = 0.0
+            for _ in range(3):
+                vnow[0] += tick_s
+                autoscaler.step()
+                rungs.append(daemon.brownout_rung)
+            order = [
+                int(arg) for _ts, action, arg in autoscaler.decisions
+                if action in ("rung_up", "rung_down")
+            ]
+            restored = (
+                daemon.gateway.batch_window == base_window
+                and daemon.gateway.max_depth == base_depth
+            )
+            rejections = int(
+                counter_total(m.SOLVER_RESULT_REJECTED) - rej0
+            )
+            return {
+                "rungs": rungs,
+                "rung_order": order,
+                "relax_served_as_ffd": served,
+                "relax_scheduled": bool(res.all_pods_scheduled()),
+                "window_at_max_s": round(at_max["window_s"], 4),
+                "depth_at_max": at_max["depth"],
+                "base_window_s": round(base_window, 4),
+                "base_depth": base_depth,
+                "restored": bool(restored),
+                "verifier_rejections": rejections,
+                "brownout_order_ok": bool(
+                    order == [1, 2, 3, 2, 1, 0]
+                    and served > 0
+                    and res.all_pods_scheduled()
+                    and at_max["window_s"] > base_window
+                    and at_max["depth"] < base_depth
+                    and restored
+                    and rejections == 0
+                ),
+            }
+        finally:
+            tier.stop()
+
+    ladder = brownout_ladder()
+
+    saving = 1.0 - auto["member_seconds"] / max(
+        fixed["member_seconds"], 1e-9
+    )
+    p99_ok = auto["p99_max_s"] <= fixed["p99_max_s"] + 0.05
+    resize_cost_ok = bool(
+        auto["miss_rounds"] == 0
+        and auto["fallbacks"] == 0
+        and auto["open_breakers"] == 0
+        and fixed["fallbacks"] == 0
+    )
+    return {
+        "tenants": n_tenants,
+        "pods_per_tenant": n_pods,
+        "surge_ticks": surge_ticks,
+        "quiet_ticks": quiet_ticks,
+        "tick_s": tick_s,
+        "max_members": max_members,
+        "autoscaled": auto,
+        "fixed": fixed,
+        "member_seconds_saving_pct": round(100.0 * saving, 1),
+        # structural: the size trace rides the deterministic policy
+        "saving_ok": bool(saving >= 0.30),
+        "p99_ok": bool(p99_ok),
+        "resize_cost_ok": resize_cost_ok,
+        "brownout": ladder,
+        "elastic_ok": bool(
+            saving >= 0.30
+            and p99_ok
+            and resize_cost_ok
+            and ladder["brownout_order_ok"]
+        ),
+    }
+
+
 def _restart_probe() -> None:
     """Child mode: a FRESH process (persistent compile cache on disk warm
     from the parent's solves) boots a DeviceScheduler, pre-warms the shape
@@ -2181,7 +2575,7 @@ def main():
             "cfg5_sidecar", "cfg6_ice_storm", "cfg7_fleet", "cfg8_multidev",
             "cfg9_verified", "cfg10_batch", "cfg11_gangs", "cfg12_relax",
             "cfg13_delta", "cfg14_twin", "cfg15_incremental",
-            "shape_churn", "restart",
+            "cfg16_elastic", "shape_churn", "restart",
         )
         bogus = [
             o for o in only
@@ -2296,6 +2690,8 @@ def main():
                 n_pods=min(2000, max(N_PODS, 400)),
                 n_nodes=min(600, max(N_PODS // 3, 100)),
             )
+        if sel("cfg16_elastic"):
+            detail["cfg16_elastic"] = _elastic_bench()
         if sel("restart"):
             detail["restart"] = _run_restart_probe()
     else:
@@ -2335,6 +2731,14 @@ def main():
         # fresh solve costs ~nothing to beat
         detail["cfg15_incremental"] = _incremental_bench(
             n_pods=160, n_nodes=24, n_types=16, churn=0.05, rounds=3,
+        )
+        # ... and a tiny cfg16 proves the elastic tier end to end (the
+        # autoscaled-vs-fixed member-seconds economics, the resize-cost
+        # audit, the brownout ladder firing and clearing in order); the
+        # p99 comparison is judged at full scale
+        detail["cfg16_elastic"] = _elastic_bench(
+            n_tenants=3, n_types=12, n_pods=12,
+            surge_ticks=4, quiet_ticks=8, max_members=3,
         )
 
     pods_per_sec = primary["pods_per_sec"]

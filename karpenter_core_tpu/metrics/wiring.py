@@ -340,6 +340,34 @@ SOLVER_FLEET_ROUTED = REGISTRY.counter(
     " open so the next-best healthy member served",
 )
 
+# -- elastic tier + brownout ladder (solver/autoscale.py, ISSUE 17) --------
+
+SOLVER_FLEET_SIZE = REGISTRY.gauge(
+    "solver_fleet_size",
+    "Live solverd fleet members after the autoscaler's last action — the"
+    " tier-$ surface the ledger charges member-seconds against",
+)
+SOLVER_FLEET_SCALE = REGISTRY.counter(
+    "solver_fleet_scale_total",
+    "Autoscaler actions taken, by direction: up = a member spawned"
+    " (FleetSupervisor.add_member), down = the least-loaded member"
+    " retired through the faultless drain path (retire_member),"
+    " rung_up/rung_down = a brownout ladder transition pushed to the"
+    " fleet at max scale",
+)
+SOLVERD_BROWNOUT_RUNG = REGISTRY.gauge(
+    "solverd_brownout_rung",
+    "This daemon's brownout ladder rung (0 = clear, 1 = relax served as"
+    " FFD, 2 = + widened batch window, 3 = + halved admission capacity)"
+    " — an explicit degradation STATE, never a verification change",
+)
+SOLVERD_BROWNOUT_SERVED = REGISTRY.counter(
+    "solverd_brownout_served_total",
+    "Relax-mode requests rewritten to FFD by a held brownout rung, by"
+    " rung — the anytime answers the ladder's cheapest rung bought"
+    " instead of sheds",
+)
+
 # -- incremental re-solve (solver/incremental.py, ISSUE 16) ----------------
 
 SOLVER_INCREMENTAL = REGISTRY.counter(

@@ -150,6 +150,15 @@ class Options:
     # FleetRouter). 1 = the classic single sidecar. An external
     # --solver-addr may name a comma-separated member list instead.
     solver_fleet: int = 1
+    # closed-loop elastic tier (solver/autoscale.py, ISSUE 17): when
+    # enabled, a TierAutoscaler sizes the SPAWNED fleet between min/max
+    # off the gateways' queue-wait/shed signals — scale-up through
+    # FleetSupervisor.add_member, scale-down through the faultless drain
+    # path, brownout ladder at max scale. --solver-fleet stays the
+    # STARTING size; 0 min/max default to 1 / max(fleet, min).
+    solver_autoscale: bool = False
+    solver_fleet_min: int = 0
+    solver_fleet_max: int = 0
     # solve-request wire form: delta = content-addressed segment
     # manifests with miss repair and full-wire fallback (unchanged
     # catalogs never re-upload); full = every request ships the whole
@@ -219,6 +228,15 @@ class Options:
         ),
         "solver_fleet": (
             "--solver-fleet", "KARPENTER_SOLVER_FLEET", int,
+        ),
+        "solver_autoscale": (
+            "--solver-autoscale", "KARPENTER_SOLVER_AUTOSCALE", _parse_bool,
+        ),
+        "solver_fleet_min": (
+            "--solver-fleet-min", "KARPENTER_SOLVER_FLEET_MIN", int,
+        ),
+        "solver_fleet_max": (
+            "--solver-fleet-max", "KARPENTER_SOLVER_FLEET_MAX", int,
         ),
         "solver_wire": (
             "--solver-wire", "KARPENTER_SOLVER_WIRE", str,
@@ -324,6 +342,47 @@ class Options:
                 " fleet pass a comma-separated member list as"
                 " --solver-addr instead"
             )
+        if opts.solver_fleet_min < 0 or opts.solver_fleet_max < 0:
+            raise ValueError(
+                "--solver-fleet-min/--solver-fleet-max must be >= 0"
+                " (0 = derive from --solver-fleet), got"
+                f" {opts.solver_fleet_min}/{opts.solver_fleet_max}"
+            )
+        if opts.solver_autoscale:
+            if opts.solver_addr:
+                # the autoscaler spawns and retires SUPERVISED members;
+                # an external fleet's lifecycle is not ours to resize
+                raise ValueError(
+                    "--solver-autoscale governs spawned sidecars and"
+                    " cannot combine with --solver-addr"
+                )
+            if opts.solver != "tpu" or opts.solver_mode != "sidecar":
+                raise ValueError(
+                    "--solver-autoscale requires --solver=tpu"
+                    " --solver-mode=sidecar (there is no tier to size"
+                    f" under solver={opts.solver!r}"
+                    f" mode={opts.solver_mode!r})"
+                )
+            mn = opts.solver_fleet_min or 1
+            mx = opts.solver_fleet_max or max(opts.solver_fleet, mn)
+            if mx < mn:
+                raise ValueError(
+                    f"--solver-fleet-max ({mx}) must be >="
+                    f" --solver-fleet-min ({mn})"
+                )
+            if not mn <= opts.solver_fleet <= mx:
+                raise ValueError(
+                    f"--solver-fleet ({opts.solver_fleet}) must start"
+                    f" inside [--solver-fleet-min, --solver-fleet-max]"
+                    f" = [{mn}, {mx}]"
+                )
+        elif opts.solver_fleet_min or opts.solver_fleet_max:
+            # bounds without the loop would silently do nothing — the
+            # user believes they have elasticity; tell them otherwise
+            raise ValueError(
+                "--solver-fleet-min/--solver-fleet-max require"
+                " --solver-autoscale"
+            )
         if opts.solver_wire not in ("delta", "full"):
             raise ValueError(
                 f"unknown solver wire mode {opts.solver_wire!r}"
@@ -405,6 +464,7 @@ class Operator:
         # fault-tolerant RPC client the provisioner routes solves through
         self.solver_supervisor = None
         self.solver_client = None
+        self.solver_autoscaler = None
         if solver_client is not None:
             # injection seam (the digital twin, twin/harness.py): the
             # caller owns the client/router — typically one whose breaker
@@ -472,9 +532,15 @@ class Operator:
                         else None
                     ),
                 )
-                if self.options.solver_fleet > 1:
+                if (
+                    self.options.solver_fleet > 1
+                    or self.options.solver_autoscale
+                ):
                     # N children on distinct ports; the router below does
-                    # digest-affinity placement across them (ISSUE 14)
+                    # digest-affinity placement across them (ISSUE 14).
+                    # The autoscaler needs the fleet shape even at a
+                    # starting size of 1 — add_member/retire_member are
+                    # its actuators.
                     self.solver_supervisor = FleetSupervisor(
                         self.options.solver_fleet,
                         on_event=self._publish_sidecar_event,
@@ -488,7 +554,11 @@ class Operator:
                     )
                     addrs = [self.solver_supervisor.start()]
 
-            def _make_client(i: int, a: str) -> "SolverClient":
+            fleet_shaped = (
+                len(addrs) > 1 or self.options.solver_autoscale
+            )
+
+            def _make_client(a: str, member: str) -> "SolverClient":
                 return SolverClient(
                     a,
                     timeout=self.options.solver_timeout,
@@ -498,18 +568,44 @@ class Operator:
                     tenant=self.options.solver_tenant,
                     # delta vs full solve-request wire (ISSUE 14)
                     wire_mode=self.options.solver_wire,
-                    member=str(i) if len(addrs) > 1 else "",
+                    member=member if fleet_shaped else "",
                 )
 
-            if len(addrs) > 1:
+            if fleet_shaped:
                 # the router shares ONE client-side poison quarantine
                 # across members and per-member breakers/sent-caches
                 self.solver_client = FleetRouter(
-                    [_make_client(i, a) for i, a in enumerate(addrs)],
+                    [
+                        _make_client(a, str(i))
+                        for i, a in enumerate(addrs)
+                    ],
                     tenant=self.options.solver_tenant,
                 )
             else:
-                self.solver_client = _make_client(0, addrs[0])
+                self.solver_client = _make_client(addrs[0], "0")
+            if (
+                self.options.solver_autoscale
+                and self.solver_supervisor is not None
+            ):
+                from karpenter_core_tpu.solver.autoscale import (
+                    SpawnedTier,
+                    TierAutoscaler,
+                )
+
+                mn = self.options.solver_fleet_min or 1
+                mx = self.options.solver_fleet_max or max(
+                    self.options.solver_fleet, mn
+                )
+                self.solver_autoscaler = TierAutoscaler(
+                    SpawnedTier(
+                        self.solver_supervisor,
+                        [self.solver_client],
+                        _make_client,
+                    ),
+                    mn,
+                    mx,
+                    on_decision=self._publish_autoscale_event,
+                )
         # in-proc TPU solves follow --solver-devices (sidecar mode leaves
         # the device choice to the child, which owns the chips); an
         # explicit device_scheduler_opts["devices"] wins over the flag
@@ -643,6 +739,20 @@ class Operator:
             else "Normal",
             reason=reason,
             message=message,
+        ))
+
+    def _publish_autoscale_event(self, action: str, arg: str) -> None:
+        """Autoscaler decisions -> the event stream so the ops surface can
+        audit every resize/brownout transition after the fact."""
+        from karpenter_core_tpu.events import Event
+
+        if action == "hold":
+            return
+        self.recorder.publish(Event(
+            involved_object="Solverd/sidecar",
+            type="Warning" if action.startswith("rung") else "Normal",
+            reason="SolverFleetScale",
+            message=f"autoscaler decided {action} ({arg})",
         ))
 
     def _publish_circuit_event(self, state: str) -> None:
@@ -797,6 +907,12 @@ class Operator:
                         )
                 elif restarted:
                     self.solver_client.set_addr(self.solver_supervisor.addr)
+        if self.solver_autoscaler is not None:
+            # one observe->decide->actuate step per reconcile pass; the
+            # controller loop IS the autoscaler's clock, so twin replays
+            # that drive reconcile_once on a virtual clock stay
+            # deterministic.
+            self._guarded("solver.autoscale", self.solver_autoscaler.step)
         for pool in list(self.kube.list_nodepools()):
             self._guarded("nodepool.hash", self.nodepool_hash.reconcile, pool)
             self._guarded(

@@ -105,6 +105,26 @@ class DrainError(Exception):
         super().__init__(message)
 
 
+class UnknownMemberError(LookupError):
+    """A member-indexed fleet entry point (router ``set_member_addr``,
+    supervisor ``drain``/``retire_member``, …) named an index outside the
+    live member set. With dynamic membership (elastic scale, ISSUE 17)
+    indices shift under retirement, so a stale index is an expected
+    coordination race, not a programming error — callers catch THIS
+    (``LookupError``) and re-observe, instead of a bare ``IndexError``
+    escaping from list internals."""
+
+    def __init__(self, index: int, size: int, site: str = ""):
+        where = f" in {site}" if site else ""
+        super().__init__(
+            f"member index {index} outside live member set"
+            f" [0, {size}){where}"
+        )
+        self.index = index
+        self.size = size
+        self.site = site
+
+
 class QuarantinedError(Exception):
     """A request refused because its problem fingerprint is quarantined as
     a poison pill. The HTTP layer answers 422; the client routes the solve
@@ -698,6 +718,24 @@ class FleetGateway:
         with self._lock:
             return self._draining
 
+    def set_batch_window(self, seconds: float) -> None:
+        """Retune the coalescing window live (brownout rung 2 widens it
+        to force deeper batches; descent restores the original)."""
+        if seconds < 0:
+            raise ValueError(f"batch_window must be >= 0, got {seconds}")
+        with self._lock:
+            self.batch_window = seconds
+
+    def set_max_depth(self, depth: int) -> None:
+        """Retune admission capacity live (brownout rung 3 halves it so
+        shedding starts earlier; descent restores the original). Already
+        queued tickets above a lowered bound stay queued — the bound
+        gates NEW admissions only."""
+        if depth <= 0:
+            raise ValueError(f"max_depth must be positive, got {depth}")
+        with self._lock:
+            self.max_depth = depth
+
     def batch_stats(self) -> dict:
         """Lightweight batch telemetry for /healthz (snapshot() computes
         percentiles — too heavy for a probe path)."""
@@ -752,6 +790,7 @@ class FleetGateway:
                 "sheds": dict(sorted(self._shed_counts.items())),
                 "grants": self._grant_count,
                 "depth": self._pending,
+                "draining": self._draining,
                 "device_p50_s": round(self._device_p50_locked(), 6),
                 "batch": {
                     "max_batch": self.max_batch,

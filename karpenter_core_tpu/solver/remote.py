@@ -954,7 +954,21 @@ class FleetRouter:
         for c in self.members:
             c.quarantine = quarantine  # one verdict ledger, N transports
         self._lock = threading.RLock()
-        self._inflight = [0] * len(self.members)
+        # stable member identities: the rendezvous hash runs over THESE,
+        # not list positions, so dynamic membership (elastic resize,
+        # ISSUE 17) remaps only the departing/arriving member's keys.
+        # The defaults reproduce the founding indices, keeping the hash
+        # byte-identical to the static fleet's for unchanged membership.
+        ids = [getattr(c, "member", "") or str(i)
+               for i, c in enumerate(self.members)]
+        if len(set(ids)) != len(ids):
+            ids = [str(i) for i in range(len(self.members))]
+        self._ids: List[str] = ids
+        self._next_id = len(self.members)
+        self._inflight: Dict[str, int] = {mid: 0 for mid in self._ids}
+        # members currently serving a SPILL on this thread's behalf: the
+        # autoscaler must never drain the tier's active safety valve
+        self._spilling: Dict[str, int] = {mid: 0 for mid in self._ids}
         self._tl = threading.local()
         self.routed: Dict[str, int] = {}
         # incsolve predecessor reference (ISSUE 16): one slot suffices —
@@ -962,6 +976,11 @@ class FleetRouter:
         # ledger is the one this fingerprint can hit; a spill/degraded
         # re-route lands on a member that simply misses (full solve)
         self.prev_fingerprint = ""
+        # the routing key of the last /solve placed: a membership change
+        # compares its affinity winner before/after, and a remapped
+        # lineage clears prev_fingerprint proactively (a guaranteed
+        # ledger miss becomes a PLANNED full solve, not daemon amnesia)
+        self._lineage_key: Optional[str] = None
 
     # -- SolverClient surface ---------------------------------------------
 
@@ -973,18 +992,28 @@ class FleetRouter:
     def breaker(self):
         """The breaker of the member that served THIS thread's last call
         — what RemoteScheduler charges on a corrupt result. Falls back to
-        member 0 before any call has routed."""
-        i = getattr(self._tl, "last", 0)
-        return self.members[i].breaker
+        member 0 before any call has routed. Holds the serving CLIENT
+        (not its index), so the charge still lands on the right breaker
+        when membership shifted underneath a long solve."""
+        client = getattr(self._tl, "last", None)
+        return (client if client is not None else self.members[0]).breaker
 
     @property
     def addr(self) -> str:
         return ",".join(c.addr for c in self.members)
 
+    def _check_index(self, i: int, site: str) -> None:
+        if not 0 <= i < len(self.members):
+            from karpenter_core_tpu.solver.fleet import UnknownMemberError
+
+            raise UnknownMemberError(i, len(self.members), site)
+
     def set_member_addr(self, i: int, addr: str) -> None:
         """Follow a respawned fleet member to its new port (the operator
         calls this after FleetSupervisor.poll reports a restart)."""
-        self.members[i].set_addr(addr)
+        with self._lock:
+            self._check_index(i, "set_member_addr")
+            self.members[i].set_addr(addr)
 
     def set_addr(self, addr: str) -> None:
         """SolverClient duck-typing for the single-member router: a bare
@@ -1014,64 +1043,89 @@ class FleetRouter:
     def _least_loaded_locked(self, candidates: List[int]) -> int:
         with self._lock:
             return min(
-                candidates, key=lambda i: (self._inflight[i], i)
+                candidates,
+                key=lambda i: (self._inflight[self._ids[i]], i),
             )
+
+    def _rank_locked(self, i: int, routing_key: str) -> bytes:
+        with self._lock:
+            return hashlib.sha256(
+                f"{self._ids[i]}|{routing_key}".encode()
+            ).digest()
 
     def _pick(self, routing_key: Optional[str]) -> int:
-        healthy = self._healthy_locked()
-        if self.affinity and routing_key:
-            ranked = max(
-                healthy,
-                key=lambda i: hashlib.sha256(
-                    f"{i}|{routing_key}".encode()
-                ).digest(),
-            )
-            degraded = len(healthy) < len(self.members) and ranked != max(
-                range(len(self.members)),
-                key=lambda i: hashlib.sha256(
-                    f"{i}|{routing_key}".encode()
-                ).digest(),
-            )
-            self._count_routed_locked(
-                "degraded" if degraded else "affinity"
-            )
-            return ranked
-        member = self._least_loaded_locked(healthy)
-        self._count_routed_locked("spill")
+        with self._lock:
+            healthy = self._healthy_locked()
+            if self.affinity and routing_key:
+                ranked = max(
+                    healthy,
+                    key=lambda i: self._rank_locked(i, routing_key),
+                )
+                degraded = len(healthy) < len(self.members) and (
+                    ranked != max(
+                        range(len(self.members)),
+                        key=lambda i: self._rank_locked(i, routing_key),
+                    )
+                )
+                reason = "degraded" if degraded else "affinity"
+                member = ranked
+            else:
+                member = self._least_loaded_locked(healthy)
+                reason = "spill"
+        self._count_routed_locked(reason)
         return member
 
-    def _run(self, i: int, fn):
+    def _run(self, client: SolverClient, mid: str, fn, spill: bool = False):
         with self._lock:
-            self._inflight[i] += 1
-        self._tl.last = i
+            if mid in self._inflight:
+                self._inflight[mid] += 1
+                if spill:
+                    self._spilling[mid] += 1
+        self._tl.last = client
         try:
-            return fn(self.members[i])
+            return fn(client)
         finally:
             with self._lock:
-                self._inflight[i] -= 1
+                # the member may have been removed mid-call: its
+                # counters left with it
+                if mid in self._inflight:
+                    self._inflight[mid] -= 1
+                    if spill:
+                        self._spilling[mid] = max(
+                            0, self._spilling[mid] - 1
+                        )
 
     def _routed(self, fn, routing_key: Optional[str]):
         """Place fn on the affinity pick; spill ONCE to the least-loaded
         healthy other member when the pick answers with a refusal (shed/
         drain/poisoned — it is regulating or restarting, not dead; a
         transport FAULT does not spill, the breaker machinery owns it)."""
-        first = self._pick(routing_key)
+        with self._lock:
+            first = self._pick(routing_key)
+            first_client, first_mid = self.members[first], self._ids[first]
         try:
-            return self._run(first, fn)
+            return self._run(first_client, first_mid, fn)
         except RemoteSolverError as e:
             if (
                 e.cause not in ("shed", "drain", "poisoned")
                 or len(self.members) < 2
             ):
                 raise
-            others = [
-                i for i in self._healthy_locked() if i != first
-            ]
-            if not others:
-                raise
-            spill = self._least_loaded_locked(others)
+            with self._lock:
+                # exclude the refusing member by IDENTITY, not index —
+                # membership may have shifted under the first call
+                others = [
+                    i for i in self._healthy_locked()
+                    if self.members[i] is not first_client
+                ]
+                if not others:
+                    raise
+                spill = self._least_loaded_locked(others)
+                spill_client, spill_mid = (
+                    self.members[spill], self._ids[spill]
+                )
             self._count_routed_locked("spill")
-            return self._run(spill, fn)
+            return self._run(spill_client, spill_mid, fn, spill=True)
 
     def call(self, path: str, body: bytes, headers: dict = None,
              routing_key: str = None):
@@ -1080,14 +1134,91 @@ class FleetRouter:
             # from callers that did not thread one): derive a stable one
             # from the body so repeat traffic still lands warm
             routing_key = hashlib.sha256(body).hexdigest()
+        if path == "/solve":
+            with self._lock:
+                self._lineage_key = routing_key
         return self._routed(
             lambda c: c.call(path, body, headers), routing_key
         )
 
     def solve_delta(self, plan, headers: dict = None):
+        with self._lock:
+            self._lineage_key = plan.catalog_digest
         return self._routed(
             lambda c: c.solve_delta(plan, headers), plan.catalog_digest
         )
+
+    # -- dynamic membership (elastic resize, ISSUE 17) ---------------------
+
+    def member_loads(self) -> Dict[str, tuple]:
+        """member id -> (inflight, spilling): the autoscaler's view of
+        who is busy and who is answering a spill right now."""
+        with self._lock:
+            return {
+                mid: (self._inflight[mid], self._spilling[mid])
+                for mid in self._ids
+            }
+
+    def _lineage_winner_locked(self) -> Optional[str]:
+        with self._lock:
+            key = self._lineage_key
+            if not key or not self.affinity or not self.members:
+                return None
+            win = max(
+                range(len(self.members)),
+                key=lambda i: self._rank_locked(i, key),
+            )
+            return self._ids[win]
+
+    def _lineage_remap_locked(self, before: Optional[str]) -> None:
+        with self._lock:
+            after = self._lineage_winner_locked()
+            if before is not None and before != after:
+                # the lineage's routing key now ranks a different member:
+                # its predecessor entry lives in the old member's ledger,
+                # so the reference is a guaranteed miss. Clear it — the
+                # next round is a PLANNED full solve, not an incremental
+                # attempt the metrics would count as daemon amnesia.
+                self.prev_fingerprint = ""
+
+    def add_member(
+        self, client: SolverClient, member_id: Optional[str] = None
+    ) -> int:
+        """Grow the live member set (autoscaler scale-up). Rendezvous
+        hashing means the new member takes ONLY the keys it now wins —
+        every survivor keeps its warm-cache keys. Returns the new
+        member's index."""
+        with self._lock:
+            mid = member_id or getattr(client, "member", "") or ""
+            while not mid or mid in self._ids:
+                mid = str(self._next_id)
+                self._next_id += 1
+            before = self._lineage_winner_locked()
+            client.quarantine = self.quarantine
+            self.members.append(client)
+            self._ids.append(mid)
+            self._inflight[mid] = 0
+            self._spilling[mid] = 0
+            self._lineage_remap_locked(before)
+            return len(self.members) - 1
+
+    def remove_member(self, i: int) -> SolverClient:
+        """Shrink the live member set (autoscaler scale-down): retiring
+        member k remaps only k's digests — each costs one miss/re-upload
+        round on its next solve, breakers untouched, fallbacks unmoved
+        (the PR 13 respawn contract extended to resize). Returns the
+        removed client (the caller owns its teardown)."""
+        with self._lock:
+            self._check_index(i, "remove_member")
+            if len(self.members) < 2:
+                raise ValueError("cannot remove the last fleet member")
+            before = self._lineage_winner_locked()
+            client = self.members.pop(i)
+            mid = self._ids.pop(i)
+            self._inflight.pop(mid, None)
+            self._spilling.pop(mid, None)
+            self._lineage_remap_locked(before)
+            return client
 
     # -- observability -----------------------------------------------------
 
@@ -1129,8 +1260,10 @@ class FleetRouter:
                 "members": [
                     {
                         "addr": c.addr,
+                        "member": self._ids[i],
                         "breaker": _STATE_NAMES[c.breaker.state],
-                        "inflight": self._inflight[i],
+                        "inflight": self._inflight[self._ids[i]],
+                        "spilling": self._spilling[self._ids[i]],
                     }
                     for i, c in enumerate(self.members)
                 ],

@@ -72,6 +72,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from karpenter_core_tpu.kube.httpserver import read_body, send_body
 from karpenter_core_tpu.solver import codec, fleet, segments
 from karpenter_core_tpu.solver import incremental as incsolve
+from karpenter_core_tpu.solver.autoscale import BROWNOUT_MAX_RUNG
 from karpenter_core_tpu.solver.supervisor import (
     DRAIN_EXIT_CODE,
     DRAIN_EXIT_DEADLINE_SECONDS,
@@ -79,6 +80,15 @@ from karpenter_core_tpu.solver.supervisor import (
 )
 
 _OCTET = "application/octet-stream"
+
+# brownout ladder shape (ISSUE 17): rung 2 widens the coalescing window
+# by WINDOW_FACTOR (with a floor so a zero-window gateway still widens),
+# rung 3 scales admission capacity by SHED_FACTOR so shedding starts
+# earlier. Rung 1 costs nothing here — it only rewrites relax -> ffd in
+# solve(). Verification is NEVER touched by any rung.
+BROWNOUT_WINDOW_FACTOR = 4.0
+BROWNOUT_WINDOW_FLOOR = 0.01
+BROWNOUT_SHED_FACTOR = 0.5
 
 # grace window between flushing the queue (503s written by their handler
 # threads) and the crash-only process exit — long enough for in-memory
@@ -287,6 +297,12 @@ class SolverDaemon:
             else fleet.BoundedSchedulerCache()
         )
         self._state_lock = threading.Lock()
+        # brownout ladder state (ISSUE 17): the current rung (0 = clear)
+        # and the gateway shape captured at first rung entry, restored on
+        # descent. The rung itself is read un-locked on the solve path
+        # (an atomic int read; a one-request-late rung switch is fine).
+        self.brownout_rung = 0
+        self._brownout_base = None
         # poison-pill quarantine: a request whose body digest has crashed
         # the device step N times is refused pre-decode (HTTP 422), so one
         # tenant's poison cannot re-wedge the shared sidecar for everyone
@@ -344,6 +360,53 @@ class SolverDaemon:
             time.sleep(0.05)
         time.sleep(_EXIT_GRACE_SECONDS)
         self.exit_fn(DRAIN_EXIT_CODE)
+
+    def set_brownout(self, rung: int) -> dict:
+        """POST /brownout: enter/exit one rung of the explicit degradation
+        ladder (the autoscaler owns the hysteresis; this applies effects).
+        Rung 1: relax requests are served in FFD mode (the anytime answer
+        — solve() rewrites the effective mode, verifier untouched).
+        Rung 2: the batch window widens for deeper coalescing. Rung 3:
+        admission capacity halves so shedding starts earlier. Descent
+        restores the captured gateway shape; every rung is visible on
+        /healthz and the solverd_brownout_rung gauge."""
+        from karpenter_core_tpu.metrics import wiring as m
+
+        if not 0 <= int(rung) <= BROWNOUT_MAX_RUNG:
+            raise ValueError(
+                f"brownout rung must be in [0, {BROWNOUT_MAX_RUNG}],"
+                f" got {rung!r}"
+            )
+        rung = int(rung)
+        with self._state_lock:
+            previous = self.brownout_rung
+            if self._brownout_base is None:
+                self._brownout_base = (
+                    self.gateway.batch_window, self.gateway.max_depth
+                )
+            base_window, base_depth = self._brownout_base
+            self.brownout_rung = rung
+        # gateway retunes take the GATEWAY lock — applied after the
+        # daemon state lock is released, never nested under it
+        if rung >= 2 and self.gateway.max_batch > 1:
+            window = max(
+                base_window * BROWNOUT_WINDOW_FACTOR, BROWNOUT_WINDOW_FLOOR
+            )
+        else:
+            window = base_window
+        self.gateway.set_batch_window(window)
+        depth = (
+            max(int(base_depth * BROWNOUT_SHED_FACTOR), 1)
+            if rung >= 3 else base_depth
+        )
+        self.gateway.set_max_depth(depth)
+        m.SOLVERD_BROWNOUT_RUNG.set(float(rung))
+        return {
+            "rung": rung,
+            "previous": previous,
+            "batch_window_s": window,
+            "queue_capacity": depth,
+        }
 
     # -- endpoints ---------------------------------------------------------
 
@@ -405,6 +468,16 @@ class SolverDaemon:
                 or problem.get("solver_mode")
                 or self.default_mode
             )
+            # brownout rung 1+ (ISSUE 17): relax traffic is served in FFD
+            # mode — the anytime answer. The REQUEST is honored (a real
+            # verified placement comes back, phases say mode=ffd), only
+            # the iterative-refinement budget is browned out; the
+            # verifier runs unchanged on every rung.
+            if self.brownout_rung >= 1 and eff_mode == "relax":
+                eff_mode = "ffd"
+                m.SOLVERD_BROWNOUT_SERVED.inc(
+                    {"rung": str(self.brownout_rung)}
+                )
             problem["solver_mode"] = eff_mode
             # the codec fingerprint deliberately excludes the raw
             # mode field (a mode-less wire and an explicit default
@@ -860,6 +933,11 @@ class SolverDaemon:
             "watchdog_trips": (
                 self.watchdog.trips if self.watchdog is not None else 0
             ),
+            # brownout ladder rung (ISSUE 17): 0 = clear; 1 = relax
+            # served as FFD; 2 = + widened batch window; 3 = + halved
+            # admission capacity — a metric-labeled state, never a
+            # verification change
+            "brownout_rung": self.brownout_rung,
             # continuous-batching stats: how much device serialization the
             # coalescer is currently buying back (mean problems per grant,
             # lifetime coalesced count, the configured window/size bounds)
@@ -914,7 +992,23 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def do_GET(self) -> None:
-        path = self.path.split("?")[0]
+        path, _, query = self.path.partition("?")
+        if path == "/statz":
+            # the gateway snapshot (per-tenant queue-wait percentiles,
+            # shed counts, depth, draining): the autoscaler's control
+            # signal. ?reset=1 makes the window per-poll — the
+            # autoscaler is the sole consumer of the reset form.
+            from urllib.parse import parse_qs
+
+            reset = parse_qs(query).get("reset", ["0"])[0] not in (
+                "0", "false", "off",
+            )
+            return send_body(
+                self, 200,
+                json.dumps(
+                    self.daemon.gateway.snapshot(reset=reset)
+                ).encode(),
+            )
         if path == "/healthz":
             health = self.daemon.health()
             send_body(
@@ -991,6 +1085,18 @@ class _Handler(BaseHTTPRequestHandler):
                 # flush the queue (503s), exit with DRAIN_EXIT_CODE once
                 # the in-flight device step clears
                 state = self.daemon.drain()
+                return send_body(self, 200, json.dumps(state).encode())
+            elif path == "/brownout":
+                # autoscaler-driven ladder transition (ISSUE 17)
+                try:
+                    req = json.loads(body or b"{}")
+                    state = self.daemon.set_brownout(
+                        int(req.get("rung", 0))
+                    )
+                except (ValueError, TypeError):
+                    return send_body(
+                        self, 400, b'{"error": "bad brownout rung"}'
+                    )
                 return send_body(self, 200, json.dumps(state).encode())
             else:
                 return send_body(self, 404, b'{"error": "not found"}')

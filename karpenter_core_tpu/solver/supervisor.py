@@ -419,20 +419,37 @@ class FleetSupervisor:
         if n < 1:
             raise ValueError(f"fleet size must be >= 1, got {n}")
         self.on_event = on_event
-        factory = supervisor_factory or SolverSupervisor
+        # retained for elastic growth (TierAutoscaler scale-up): a member
+        # added later spawns with exactly the same child configuration as
+        # the founding set
+        self._factory = supervisor_factory or SolverSupervisor
+        self._child_kwargs = dict(child_kwargs)
+        # monotonic member-label source: labels are never reused after a
+        # retirement, so the router's rendezvous hash (keyed on the label)
+        # and the member-labeled metric series never alias a successor to
+        # a retired member
+        self._next_member = n
         self.members: List[SolverSupervisor] = [
-            factory(
-                on_event=self._member_event(i), member=str(i), **child_kwargs
+            self._factory(
+                on_event=self._member_event(str(i)),
+                member=str(i),
+                **self._child_kwargs,
             )
             for i in range(n)
         ]
 
-    def _member_event(self, i: int) -> Callable[[str, str], None]:
+    def _member_event(self, member: str) -> Callable[[str, str], None]:
         def emit(reason: str, message: str) -> None:
             if self.on_event is not None:
-                self.on_event(reason, f"[member {i}] {message}")
+                self.on_event(reason, f"[member {member}] {message}")
 
         return emit
+
+    def _check_index(self, i: int, site: str) -> None:
+        if not 0 <= i < len(self.members):
+            from karpenter_core_tpu.solver.fleet import UnknownMemberError
+
+            raise UnknownMemberError(i, len(self.members), site)
 
     def start(self) -> List[str]:
         """Spawn every member; returns their host:port addresses in
@@ -457,14 +474,52 @@ class FleetSupervisor:
     def respawn_storm(self) -> bool:
         """True while ANY member is inside a respawn storm (the operator's
         readyz degrades on it; per-member detail rides the member-labeled
-        solverd_respawn_storm gauge)."""
-        # evaluate every member (not any()'s short-circuit) so each one's
-        # gauge series stays current
-        return any([m.respawn_storm() for m in self.members])
+        solverd_respawn_storm gauge). Short-circuits: each member's gauge
+        series stays current through its own _note_respawn/respawn_storm
+        calls, so the aggregate need not touch every member on every
+        probe."""
+        return any(m.respawn_storm() for m in self.members)
+
+    def add_member(self, start: bool = True) -> int:
+        """Grow the fleet by one member (TierAutoscaler scale-up): spawn a
+        child with the retained configuration under a fresh, never-reused
+        member label. Returns the new member's index; its address is at
+        ``self.members[index].addr``."""
+        member = str(self._next_member)
+        self._next_member += 1
+        sup = self._factory(
+            on_event=self._member_event(member),
+            member=member,
+            **self._child_kwargs,
+        )
+        self.members.append(sup)
+        if start:
+            sup.start()
+        return len(self.members) - 1
+
+    def retire_member(
+        self, i: int, timeout: float = DRAIN_EXIT_DEADLINE_SECONDS + 15.0
+    ) -> bool:
+        """Scale-down = the faultless drain path: POST /drain closes the
+        member's admission, flushes its queue with 503s (answered
+        refusals — no breaker charge for callers), and the child exits
+        ``DRAIN_EXIT_CODE``; instead of respawning, the supervisor reaps
+        it and drops it from the fleet. Returns True when the child
+        exited through the drain contract (False = it had to be
+        terminated, which ``stop()`` does regardless)."""
+        self._check_index(i, "retire_member")
+        if len(self.members) <= 1:
+            raise ValueError("cannot retire the last fleet member")
+        sup = self.members[i]
+        clean = sup.drain(timeout=timeout)
+        sup.stop()
+        self.members.pop(i)
+        return clean
 
     def drain(self, i: int, **kwargs) -> bool:
         """Drain ONE member (rolling restarts: drain, poll-respawn,
         next) — the fleet keeps serving from the others meanwhile."""
+        self._check_index(i, "drain")
         return self.members[i].drain(**kwargs)
 
     def stop(self) -> None:

@@ -156,12 +156,45 @@ class _FleetTier:
         self.daemons: List = []
         self.servers: List = []
         self.addrs: List[str] = []
-        self.member_solves: Dict[int, int] = {}
+        # stable member identities surviving index shifts under elastic
+        # resize (fleetscale, ISSUE 17): ids are never reused, so the
+        # routers' rendezvous ranks and the utilization ledger never
+        # alias a retired member's successor
+        self.member_ids: List[str] = []
+        self._next = 0
+        self.member_solves: Dict[str, int] = {}
         for _ in range(n):
-            daemon, srv, addr = self._spawn()
-            self.daemons.append(daemon)
-            self.servers.append(srv)
-            self.addrs.append(addr)
+            self.grow()
+
+    def grow(self) -> int:
+        """Spawn one fresh member (autoscaler scale-up actuator); returns
+        its index in the live member list."""
+        daemon, srv, addr = self._spawn()
+        self.daemons.append(daemon)
+        self.servers.append(srv)
+        self.addrs.append(addr)
+        self.member_ids.append(str(self._next))
+        self._next += 1
+        return len(self.daemons) - 1
+
+    def retire(self, i: int) -> None:
+        """Crash-only scale-down, in-thread: flush the member's queue
+        (each queued request answers 503 — the faultless drain path),
+        close its socket, drop it from the live set. Indices above i
+        shift down, exactly like FleetRouter.remove_member — the run
+        keeps the two aligned."""
+        if self.servers[i] is not None:
+            self._bank_solves(i)
+            self.daemons[i].drain()
+            self.servers[i].shutdown()
+            self.servers[i].server_close()
+        self.daemons.pop(i)
+        self.servers.pop(i)
+        self.addrs.pop(i)
+        self.member_ids.pop(i)
+
+    def live_count(self) -> int:
+        return sum(1 for srv in self.servers if srv is not None)
 
     def _spawn(self):
         daemon = self._service.SolverDaemon(
@@ -197,16 +230,19 @@ class _FleetTier:
         self.daemons[i].segment_store = segments.SegmentStore()
 
     def _bank_solves(self, i: int) -> None:
-        self.member_solves[i] = (
-            self.member_solves.get(i, 0) + self.daemons[i].solves
+        mid = self.member_ids[i]
+        self.member_solves[mid] = (
+            self.member_solves.get(mid, 0) + self.daemons[i].solves
         )
 
     def utilization(self) -> Dict[str, int]:
-        out: Dict[str, int] = {}
+        # keyed by stable member id: a retired member's banked solves
+        # survive it leaving the live list
+        out: Dict[str, int] = dict(self.member_solves)
         for i, daemon in enumerate(self.daemons):
-            out[str(i)] = self.member_solves.get(i, 0) + (
-                daemon.solves if self.servers[i] is not None else 0
-            )
+            if self.servers[i] is not None:
+                mid = self.member_ids[i]
+                out[mid] = out.get(mid, 0) + daemon.solves
         return out
 
     def stop(self) -> None:
@@ -214,6 +250,61 @@ class _FleetTier:
             if srv is not None:
                 srv.shutdown()
                 srv.server_close()
+
+
+class _TwinTierAdapter:
+    """The TierAutoscaler's tier surface over the in-thread fleet,
+    DETERMINISTIC by construction: production SpawnedTier reads wall-time
+    queue-wait percentiles off /statz, which two replays of one scenario
+    would never reproduce byte-for-byte — so the twin derives pressure
+    from the scenario's own state instead (expected-but-unbound pods per
+    live member, a pure function of the virtual timeline). Scale-up grows
+    a member and hands every cluster's router a gated virtual-clock
+    client; scale-down retires through the in-thread drain path with the
+    router un-routed FIRST, same ordering as production."""
+
+    # how many backlogged pods one member absorbs per tick before the
+    # tier counts as over budget (pressure 1.0)
+    PODS_PER_MEMBER = 8.0
+
+    def __init__(self, tier: _FleetTier, routers, new_clients, backlog_fn):
+        self.tier = tier
+        self.routers = routers
+        self.new_clients = new_clients  # (addr, member_id) -> [client/router]
+        self.backlog_fn = backlog_fn
+
+    def observe(self):
+        from karpenter_core_tpu.solver.autoscale import (
+            MemberSignal,
+            TierSignals,
+        )
+
+        members = [
+            MemberSignal(
+                member=mid, draining=self.tier.servers[i] is None
+            )
+            for i, mid in enumerate(self.tier.member_ids)
+        ]
+        live = sum(1 for ms in members if not ms.draining) or 1
+        pressure = self.backlog_fn() / (live * self.PODS_PER_MEMBER)
+        return TierSignals(members=members, pressure=pressure, storm=False)
+
+    def scale_up(self) -> None:
+        idx = self.tier.grow()
+        addr = self.tier.addrs[idx]
+        mid = self.tier.member_ids[idx]
+        for router, client in zip(self.routers, self.new_clients(addr, mid)):
+            router.add_member(client, member_id=mid)
+
+    def scale_down(self, index: int) -> None:
+        for router in self.routers:
+            router.remove_member(index)
+        self.tier.retire(index)
+
+    def set_rung(self, rung: int) -> None:
+        for i, daemon in enumerate(self.tier.daemons):
+            if self.tier.servers[i] is not None:
+                daemon.set_brownout(rung)
 
 
 class DigitalTwin:
@@ -226,36 +317,49 @@ class DigitalTwin:
 
     # -- construction ------------------------------------------------------
 
+    def _member_client(self, cluster: int, addr: str, member: str, vclock):
+        """One cluster's client for one tier member: virtual-clock
+        breaker, partition gate — shared by founding members and any the
+        autoscaler grows later."""
+        from karpenter_core_tpu.solver.remote import SolverClient
+
+        client = SolverClient(
+            addr,
+            timeout=30.0,
+            tenant=f"c{cluster}",
+            wire_mode=self.scenario.wire,
+            member=member,
+            sleep=vclock.sleep,
+        )
+        # the client's fault-tolerance state rides VIRTUAL time: a
+        # breaker cooldown or quarantine TTL elapses with the
+        # scenario, not with the wall — days of churn in minutes
+        client.breaker.time_fn = vclock.monotonic
+        self._install_partition_gate(cluster, client)
+        return client
+
     def _make_router(self, cluster: int, tier: _FleetTier, vclock):
         from karpenter_core_tpu.solver.fleet import PoisonQuarantine
-        from karpenter_core_tpu.solver.remote import FleetRouter, SolverClient
+        from karpenter_core_tpu.solver.remote import FleetRouter
 
-        members = []
-        for j, addr in enumerate(tier.addrs):
-            client = SolverClient(
-                addr,
-                timeout=30.0,
-                tenant=f"c{cluster}",
-                wire_mode=self.scenario.wire,
-                member=str(j) if len(tier.addrs) > 1 else "",
-                sleep=vclock.sleep,
+        # autoscaled tiers label members even at a starting size of 1:
+        # the set is about to change and rendezvous ranks key off ids
+        labeled = len(tier.addrs) > 1 or self.scenario.autoscale
+        members = [
+            self._member_client(
+                cluster, addr, tier.member_ids[j] if labeled else "", vclock
             )
-            # the client's fault-tolerance state rides VIRTUAL time: a
-            # breaker cooldown or quarantine TTL elapses with the
-            # scenario, not with the wall — days of churn in minutes
-            client.breaker.time_fn = vclock.monotonic
-            members.append(client)
-        router = FleetRouter(
+            for j, addr in enumerate(tier.addrs)
+        ]
+        return FleetRouter(
             members,
             tenant=f"c{cluster}",
             quarantine=PoisonQuarantine(
                 site="client", time_fn=vclock.monotonic
             ),
         )
-        self._install_partition_gate(cluster, members)
-        return router
 
-    def _install_partition_gate(self, cluster: int, members) -> None:
+    def _install_partition_gate(self, cluster: int, client) -> None:
         from karpenter_core_tpu.solver.remote import RemoteSolverError
 
         def active() -> bool:
@@ -269,17 +373,16 @@ class DigitalTwin:
                     return True
             return False
 
-        for client in members:
-            orig = client.call
+        orig = client.call
 
-            def gated(*args, _orig=orig, **kwargs):
-                if active():
-                    raise RemoteSolverError(
-                        "error", "twin: operator-fleet partition window"
-                    )
-                return _orig(*args, **kwargs)
+        def gated(*args, _orig=orig, **kwargs):
+            if active():
+                raise RemoteSolverError(
+                    "error", "twin: operator-fleet partition window"
+                )
+            return _orig(*args, **kwargs)
 
-            client.call = gated
+        client.call = gated
 
     def _make_operator(
         self, cluster: int, vclock, tier: Optional[_FleetTier]
@@ -386,7 +489,50 @@ class DigitalTwin:
             wave_names: Dict[str, List[str]] = {}
             bound_seen: Dict[int, set] = {i: set() for i in range(s.clusters)}
             active_partitions: set = set()
-            down_members: Dict[int, float] = {}  # member -> respawn due at
+            down_members: Dict[str, float] = {}  # member id -> respawn due
+
+            autoscaler = None
+            if s.autoscale and tier is not None:
+                from karpenter_core_tpu.solver.autoscale import (
+                    TierAutoscaler,
+                )
+
+                def _backlog() -> float:
+                    # expected-but-unbound pods across every cluster: the
+                    # deterministic demand signal (wall-free, replayable)
+                    total = 0
+                    for i in range(s.clusters):
+                        for name in expected[i]:
+                            pod = stores[i].get(Pod, name)
+                            if pod is not None and not pod.node_name:
+                                total += 1
+                    return float(total)
+
+                def _new_clients(addr: str, mid: str):
+                    return [
+                        self._member_client(i, addr, mid, vclock)
+                        for i in range(len(routers))
+                    ]
+
+                autoscaler = TierAutoscaler(
+                    _TwinTierAdapter(tier, routers, _new_clients, _backlog),
+                    s.fleet_min or 1,
+                    s.fleet_max or max(s.fleet, s.fleet_min or 1),
+                    # hysteresis in TICKS of virtual time: react after one
+                    # over-budget tick, relax after two quiet ones, with a
+                    # longer scale-down cooldown (the production shape,
+                    # compressed to the scenario's timescale)
+                    up_stable=1,
+                    down_stable=2,
+                    up_cooldown_s=s.tick,
+                    down_cooldown_s=2 * s.tick,
+                    rung_up_stable=1,
+                    rung_down_stable=2,
+                    time_fn=lambda: vclock.now() - TWIN_EPOCH,
+                    on_decision=lambda action, arg: note(
+                        "autoscale", f"{action} {arg}"
+                    ),
+                )
 
             # the timeline: (due offset, kind order, seq) -> action.
             # Wave identity is CONTENT-derived (scenario.wave_ids): pod
@@ -413,12 +559,14 @@ class DigitalTwin:
             for k in range(1, n_ticks + 1):
                 t = min(k * s.tick, s.duration)
                 vclock.advance_to(TWIN_EPOCH + t)
-                # respawn members whose murder window elapsed
-                for member in sorted(down_members):
-                    if down_members[member] <= t:
-                        tier.respawn(member, routers)
-                        del down_members[member]
-                        note("respawn", f"fleet member {member} respawned")
+                # respawn members whose murder window elapsed (looked up
+                # by stable id — a scale-down may have shifted indices)
+                for mid in sorted(down_members):
+                    if down_members[mid] <= t:
+                        del down_members[mid]
+                        if mid in tier.member_ids:
+                            tier.respawn(tier.member_ids.index(mid), routers)
+                            note("respawn", f"fleet member {mid} respawned")
                 # apply everything due by this tick
                 while cursor < len(events) and events[cursor][0] <= t:
                     _, _, idx, kind, payload = events[cursor]
@@ -440,17 +588,26 @@ class DigitalTwin:
                             " retired"
                         ))
                     elif kind == "murder":
-                        if payload.member not in down_members:
+                        # under autoscale the index targets the CURRENT
+                        # live list; an empty slot (never grown, already
+                        # retired) skips deterministically
+                        if payload.member < len(tier.member_ids) and (
+                            tier.servers[payload.member] is not None
+                        ):
+                            mid = tier.member_ids[payload.member]
                             tier.murder(payload.member)
-                            down_members[payload.member] = t + s.tick
+                            down_members[mid] = t + s.tick
                             note("murder", (
-                                f"fleet member {payload.member} murdered"
+                                f"fleet member {mid} murdered"
                             ))
                     elif kind == "amnesia":
-                        if payload.member not in down_members:
+                        if payload.member < len(tier.member_ids) and (
+                            tier.servers[payload.member] is not None
+                        ):
+                            mid = tier.member_ids[payload.member]
                             tier.amnesia(payload.member)
                             note("amnesia", (
-                                f"fleet member {payload.member} segment"
+                                f"fleet member {mid} segment"
                                 " store wiped"
                             ))
                     elif kind == "lose_bound_pod":
@@ -470,6 +627,13 @@ class DigitalTwin:
                 for fi in sorted(active_partitions - now_active):
                     note("partition_end", "partition healed")
                 active_partitions = now_active
+
+                # autoscaler step BEFORE the settle: the tier resizes on
+                # the backlog the tick arrived with, then the operators
+                # solve against the resized tier (one control period per
+                # tick, riding the virtual clock)
+                if autoscaler is not None:
+                    autoscaler.step()
 
                 # one closed-loop settle per cluster
                 for op in operators:
@@ -495,7 +659,10 @@ class DigitalTwin:
                             ledger.slo_misses += 1
 
                 monitor.check(vclock.now(), operators, expected)
-                ledger.sample(t - prev_t, operators, price_indices)
+                ledger.sample(
+                    t - prev_t, operators, price_indices,
+                    tier_members=tier.live_count() if tier else 0,
+                )
                 prev_t = t
 
             after = _metric_snapshot()

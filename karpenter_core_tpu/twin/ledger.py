@@ -65,6 +65,12 @@ class Ledger:
         self.bind_latencies: Dict[str, List[float]] = {}
         self.ticks = 0
         self.virtual_seconds = 0.0
+        # elastic solver tier (fleetscale, ISSUE 17): member-count
+        # integral over virtual time — the tier-$ half of the drift
+        # judge's node-$ + tier-$ score against a fixed-size control
+        # (mean_members = member_seconds / duration)
+        self.member_seconds = 0.0
+        self.peak_members = 0
         # filled by the harness at finish() from metric deltas/tier state
         self.preemption_evictions = 0
         self.slo_misses = 0
@@ -72,11 +78,16 @@ class Ledger:
 
     # -- accumulation ------------------------------------------------------
 
-    def sample(self, dt: float, operators, price_indices) -> None:
+    def sample(
+        self, dt: float, operators, price_indices, tier_members: int = 0
+    ) -> None:
         """One tick's cost integral: each cluster's live nodes priced from
-        ITS catalog, charged for dt virtual seconds."""
+        ITS catalog, charged for dt virtual seconds; the solver tier's
+        live member count charged the same way (member·seconds)."""
         self.ticks += 1
         self.virtual_seconds += dt
+        self.member_seconds += tier_members * dt
+        self.peak_members = max(self.peak_members, tier_members)
         for cluster, op in enumerate(operators):
             prices = price_indices[cluster]
             nodes = op.kube.list_nodes()
@@ -131,6 +142,8 @@ class Ledger:
             "utilization": self.utilization,
             "ticks": self.ticks,
             "virtual_seconds": round(self.virtual_seconds, 6),
+            "member_seconds": round(self.member_seconds, 6),
+            "peak_members": self.peak_members,
         }
 
     def to_json(self) -> str:

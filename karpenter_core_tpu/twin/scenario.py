@@ -112,6 +112,16 @@ class Scenario:
     # PackingLedger warm-starts churn-proportional re-solves (requires a
     # fleet tier — the ledger lives daemon-side)
     incremental: bool = False
+    # elastic tier (fleetscale, ISSUE 17): the TierAutoscaler sizes the
+    # fleet between [fleet_min or 1, fleet_max or fleet] on the virtual
+    # clock, from the scenario's own deterministic backlog signal.
+    # ``fleet`` stays the STARTING size; fleet faults may then name any
+    # member index up to the max bound (out-of-range at fire time skips
+    # deterministically — the member it targeted was never grown or was
+    # already retired)
+    autoscale: bool = False
+    fleet_min: int = 0
+    fleet_max: int = 0
     # SLO bound doubling as the starvation invariant: an expected pod
     # pending longer than this at a stable tick is a violation
     max_pending: float = 600.0
@@ -148,6 +158,9 @@ def encode_scenario(s: Scenario) -> dict:
         "fleet": s.fleet,
         "wire": s.wire,
         "incremental": s.incremental,
+        "autoscale": s.autoscale,
+        "fleet_min": s.fleet_min,
+        "fleet_max": s.fleet_max,
         "max_pending": s.max_pending,
         "rates": dict(sorted(s.rates.items())),
         "waves": _encode_items(s.waves, WorkloadWave),
@@ -198,6 +211,9 @@ def decode_scenario(data: dict) -> Scenario:
         fleet=int(data.get("fleet", 0)),
         wire=data.get("wire", "delta"),
         incremental=bool(data.get("incremental", False)),
+        autoscale=bool(data.get("autoscale", False)),
+        fleet_min=int(data.get("fleet_min", 0)),
+        fleet_max=int(data.get("fleet_max", 0)),
         max_pending=float(data.get("max_pending", 600.0)),
         rates={k: float(v) for k, v in sorted((data.get("rates") or {}).items())},
         waves=_decode_items(data.get("waves"), WorkloadWave),
@@ -228,6 +244,24 @@ def validate_scenario(s: Scenario) -> None:
         # the PackingLedger lives daemon-side; without a solverd tier
         # there is no ledger to warm-start from
         raise ValueError("incremental re-solve requires a fleet tier")
+    if s.fleet_min < 0 or s.fleet_max < 0:
+        raise ValueError("fleet_min/fleet_max must be >= 0")
+    if s.autoscale:
+        if not s.fleet:
+            raise ValueError("autoscale requires a fleet tier (fleet>=1)")
+        mn = s.fleet_min or 1
+        mx = s.fleet_max or max(s.fleet, mn)
+        if mx < mn:
+            raise ValueError(
+                f"fleet_max ({mx}) must be >= fleet_min ({mn})"
+            )
+        if not (mn <= s.fleet <= mx):
+            raise ValueError(
+                f"starting fleet size {s.fleet} outside"
+                f" autoscale bounds [{mn}, {mx}]"
+            )
+    elif s.fleet_min or s.fleet_max:
+        raise ValueError("fleet_min/fleet_max require autoscale")
     def _cluster_in_range(what: str, cluster: int, wildcard: bool) -> None:
         lo = -1 if wildcard else 0  # -1 = every cluster, where allowed
         if not (lo <= cluster < s.clusters):
@@ -257,12 +291,18 @@ def validate_scenario(s: Scenario) -> None:
             raise ValueError(f"unknown fleet fault kind {fault.kind!r}")
         if not s.fleet:
             raise ValueError("fleet faults require a fleet tier (fleet>=1)")
+        # under autoscale the live member set is dynamic, so faults may
+        # target any slot up to the max bound; a slot empty at fire time
+        # skips deterministically (harness)
+        member_bound = (
+            max(s.fleet, s.fleet_max or s.fleet) if s.autoscale else s.fleet
+        )
         if fault.kind in ("murder", "amnesia") and not (
-            0 <= fault.member < s.fleet
+            0 <= fault.member < member_bound
         ):
             raise ValueError(
                 f"fleet fault targets member {fault.member} outside"
-                f" [0, {s.fleet})"
+                f" [0, {member_bound})"
             )
         if fault.kind == "partition":
             _cluster_in_range(

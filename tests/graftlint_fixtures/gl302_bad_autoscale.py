@@ -1,0 +1,35 @@
+"""GL302 bad, autoscaler shape: a control-loop class (streak counters,
+cooldown stamps, an owning _state_lock) whose step path bumps the shared
+hysteresis streaks OUTSIDE the lock — the exact class shape
+solver/autoscale.py ships, with the discipline broken. A poller thread
+and an HTTP handler thread stepping concurrently lose streak updates and
+the tier double-scales."""
+import threading
+
+
+class TierAutoscaler:
+    def __init__(self, tier, min_members, max_members):
+        self.tier = tier
+        self.min_members = min_members
+        self.max_members = max_members
+        self._state_lock = threading.Lock()
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_scale_at = 0.0
+
+    def step(self, now, pressure):
+        if pressure >= 1.0:
+            self._up_streak += 1  # two stepping threads read the same value
+            with self._state_lock:
+                self._down_streak = 0
+        else:
+            with self._state_lock:
+                self._up_streak = 0
+            self._down_streak = self._down_streak + 1  # same lost update
+        with self._state_lock:
+            self._last_scale_at = now
+
+    def start(self, interval):
+        threading.Thread(
+            target=self.step, args=(0.0, 0.0), daemon=True
+        ).start()

@@ -1,0 +1,31 @@
+"""GL302 good, autoscaler shape: every read-modify-write on the control
+loop's shared hysteresis state (streaks, cooldown stamps) holds the owning
+_state_lock — the discipline solver/autoscale.py's TierAutoscaler ships,
+where the whole decide body sits inside one locked region."""
+import threading
+
+
+class TierAutoscaler:
+    def __init__(self, tier, min_members, max_members):
+        self.tier = tier
+        self.min_members = min_members
+        self.max_members = max_members
+        self._state_lock = threading.Lock()
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_scale_at = 0.0
+
+    def step(self, now, pressure):
+        with self._state_lock:
+            if pressure >= 1.0:
+                self._up_streak += 1
+                self._down_streak = 0
+            else:
+                self._up_streak = 0
+                self._down_streak = self._down_streak + 1
+            self._last_scale_at = now
+
+    def start(self, interval):
+        threading.Thread(
+            target=self.step, args=(0.0, 0.0), daemon=True
+        ).start()

@@ -1,0 +1,22 @@
+"""GL304 bad, autoscaler shape: the scale-down actuator POSTs /drain to
+the retiring member while the control loop's _state_lock is held. The
+drain is network I/O with an unbounded tail (the member is flushing its
+queue); holding the decide lock across it wedges every observer — and the
+next step() — behind one slow member. The shipped TierAutoscaler decides
+under the lock and actuates OUTSIDE it."""
+import threading
+from urllib.request import urlopen
+
+
+class TierAutoscaler:
+    def __init__(self, tier):
+        self.tier = tier
+        self._state_lock = threading.Lock()
+        self._down_streak = 0
+
+    def step(self, victim_addr):
+        with self._state_lock:
+            self._down_streak = 0
+            urlopen(  # network I/O while the decide lock is held
+                f"http://{victim_addr}/drain", data=b"{}"
+            ).read()

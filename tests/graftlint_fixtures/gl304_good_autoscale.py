@@ -1,0 +1,22 @@
+"""GL304 good, autoscaler shape: decide under the _state_lock, actuate
+outside it. The lock guards only the hysteresis bookkeeping; the /drain
+POST (unbounded network tail — the member is flushing its queue) happens
+after release, so a slow drain never blocks the next observation."""
+import threading
+from urllib.request import urlopen
+
+
+class TierAutoscaler:
+    def __init__(self, tier):
+        self.tier = tier
+        self._state_lock = threading.Lock()
+        self._down_streak = 0
+
+    def step(self, victim_addr):
+        with self._state_lock:
+            self._down_streak = 0
+            drain = True
+        if drain:
+            urlopen(
+                f"http://{victim_addr}/drain", data=b"{}"
+            ).read()

@@ -1,0 +1,469 @@
+"""fleetscale (ISSUE 17): the TierAutoscaler policy + dynamic membership.
+
+Three contract layers, pinned separately:
+
+* the POLICY — hysteresis streaks with a dead band between the
+  thresholds, per-direction cooldowns, hard min/max bounds, respawn-storm
+  scale-up suppression, victim selection that never drains a spilling or
+  already-draining member, and the brownout ladder that climbs 1->2->3
+  only at max size and descends fully before any scale-down;
+* the ROUTER's dynamic membership — rendezvous hashing over stable
+  member ids, so a resize remaps ONLY the departing/arriving member's
+  keys, a stale index raises the typed ``UnknownMemberError``, and a
+  lineage whose affinity winner remaps clears ``prev_fingerprint``
+  proactively (a planned full solve, not daemon amnesia);
+* the SpawnedTier ADAPTER — statz/loads fold into MemberSignal rows, a
+  dead member reads as draining (never a victim), and sheds bump
+  pressure over budget whatever the percentiles say.
+"""
+import copy
+
+import pytest
+
+from tests.helpers import make_nodepool, make_pod
+
+from karpenter_core_tpu.cloudprovider.fake import fake_instance_types
+from karpenter_core_tpu.metrics import wiring as m
+from karpenter_core_tpu.solver import remote, service
+from karpenter_core_tpu.solver.autoscale import (
+    BROWNOUT_MAX_RUNG,
+    MemberSignal,
+    SpawnedTier,
+    TierAutoscaler,
+    TierSignals,
+)
+from karpenter_core_tpu.solver.fleet import UnknownMemberError
+
+
+class FakeTier:
+    """Policy-test adapter: observable load is set directly, actuations
+    are recorded and applied to the member list."""
+
+    def __init__(self, n=1):
+        self.members = [MemberSignal(member=str(i)) for i in range(n)]
+        self.pressure = 0.0
+        self.storm = False
+        self.rung = 0
+        self.calls = []
+
+    def observe(self):
+        return TierSignals(
+            members=[copy.deepcopy(ms) for ms in self.members],
+            pressure=self.pressure,
+            storm=self.storm,
+        )
+
+    def scale_up(self):
+        self.calls.append(("up", len(self.members) + 1))
+        self.members.append(MemberSignal(member=str(len(self.members))))
+
+    def scale_down(self, index):
+        self.calls.append(("down", index))
+        self.members.pop(index)
+
+    def set_rung(self, rung):
+        self.calls.append(("rung", rung))
+        self.rung = rung
+
+
+def _autoscaler(tier, mn, mx, now, **kw):
+    kw.setdefault("up_stable", 2)
+    kw.setdefault("down_stable", 2)
+    kw.setdefault("up_cooldown_s", 0.0)
+    kw.setdefault("down_cooldown_s", 0.0)
+    kw.setdefault("rung_up_stable", 1)
+    kw.setdefault("rung_down_stable", 1)
+    return TierAutoscaler(tier, mn, mx, time_fn=lambda: now[0], **kw)
+
+
+class TestPolicy:
+    def test_ctor_rejects_bad_bounds(self):
+        with pytest.raises(ValueError):
+            TierAutoscaler(FakeTier(), 0, 2)
+        with pytest.raises(ValueError):
+            TierAutoscaler(FakeTier(), 3, 2)
+        with pytest.raises(ValueError):
+            TierAutoscaler(
+                FakeTier(), 1, 2, up_pressure=1.0, down_pressure=1.0
+            )
+
+    def test_up_requires_a_streak_then_scales_one(self):
+        tier, now = FakeTier(1), [0.0]
+        auto = _autoscaler(tier, 1, 4, now)
+        tier.pressure = 2.0
+        assert auto.step() == []  # streak 1 of 2
+        before = m.SOLVER_FLEET_SCALE.value({"direction": "up"})
+        actions = auto.step()
+        assert [a for a, _ in actions] == ["up"]
+        assert len(tier.members) == 2
+        assert m.SOLVER_FLEET_SCALE.value({"direction": "up"}) == before + 1
+        assert m.SOLVER_FLEET_SIZE.value({}) == 2.0
+
+    def test_dead_band_resets_both_streaks(self):
+        tier, now = FakeTier(1), [0.0]
+        auto = _autoscaler(tier, 1, 4, now)
+        tier.pressure = 2.0
+        auto.step()  # up streak 1
+        tier.pressure = 0.5  # between down (0.3) and up (1.0): the band
+        auto.step()
+        tier.pressure = 2.0
+        assert auto.step() == []  # streak restarted at 1
+        assert [a for a, _ in auto.step()] == ["up"]
+
+    def test_up_cooldown_spaces_consecutive_grows(self):
+        tier, now = FakeTier(1), [0.0]
+        auto = _autoscaler(
+            tier, 1, 4, now, up_stable=1, up_cooldown_s=30.0
+        )
+        tier.pressure = 2.0
+        assert [a for a, _ in auto.step()] == ["up"]
+        now[0] = 10.0
+        assert auto.step() == []  # hot: inside the cooldown
+        now[0] = 31.0
+        assert [a for a, _ in auto.step()] == ["up"]
+
+    def test_down_requires_streak_and_respects_min(self):
+        tier, now = FakeTier(3), [0.0]
+        auto = _autoscaler(tier, 2, 4, now)
+        tier.pressure = 0.1
+        assert auto.step() == []
+        actions = auto.step()
+        assert actions == [("down", 0)]  # all idle: lowest index wins
+        assert len(tier.members) == 2
+        # at min: under-pressure forever never goes below the floor
+        for _ in range(6):
+            now[0] += 1000.0
+            assert auto.step() == []
+        assert len(tier.members) == 2
+
+    def test_storm_suppresses_scale_up_but_not_the_streak(self):
+        tier, now = FakeTier(1), [0.0]
+        auto = _autoscaler(tier, 1, 4, now, up_stable=2)
+        tier.pressure, tier.storm = 2.0, True
+        auto.step()
+        actions = auto.step()  # streak satisfied, storm holds it back
+        assert actions == [("hold", "respawn storm suppresses scale-up")]
+        assert tier.calls == []
+        tier.storm = False
+        assert [a for a, _ in auto.step()] == ["up"]
+
+    def test_victim_skips_draining_and_spilling_members(self):
+        tier, now = FakeTier(4), [0.0]
+        tier.members[0].draining = True
+        tier.members[1].spilling = 1
+        tier.members[2].inflight = 5
+        auto = _autoscaler(tier, 1, 4, now, down_stable=1)
+        tier.pressure = 0.0
+        assert auto.step() == [("down", 3)]  # the only idle retirable
+
+    def test_all_members_busy_holds_instead_of_draining(self):
+        tier, now = FakeTier(2), [0.0]
+        tier.members[0].draining = True
+        tier.members[1].spilling = 2
+        auto = _autoscaler(tier, 1, 4, now, down_stable=1)
+        tier.pressure = 0.0
+        actions = auto.step()
+        assert actions == [
+            ("hold", "no drainable member (all spilling or draining)")
+        ]
+        assert len(tier.members) == 2
+
+    def test_rung_ladder_climbs_and_descends_in_order(self):
+        tier, now = FakeTier(1), [0.0]
+        auto = _autoscaler(tier, 1, 1, now, up_stable=1)
+        tier.pressure = 2.0
+        rungs = []
+        for _ in range(BROWNOUT_MAX_RUNG + 2):
+            now[0] += 1.0
+            for action, arg in auto.step():
+                assert action == "rung_up"
+                rungs.append(arg)
+        assert rungs == [1, 2, 3]  # capped at BROWNOUT_MAX_RUNG
+        tier.pressure = 0.0
+        for _ in range(BROWNOUT_MAX_RUNG + 2):
+            now[0] += 1.0
+            for action, arg in auto.step():
+                assert action == "rung_down"
+                rungs.append(arg)
+        assert rungs == [1, 2, 3, 2, 1, 0]
+        assert [c for c in tier.calls if c[0] == "rung"] == [
+            ("rung", r) for r in rungs
+        ]
+
+    def test_no_rung_below_max_size(self):
+        tier, now = FakeTier(1), [0.0]
+        auto = _autoscaler(
+            tier, 1, 3, now, up_stable=1, up_cooldown_s=10_000.0
+        )
+        tier.pressure = 2.0
+        assert [a for a, _ in auto.step()] == ["up"]
+        for _ in range(5):  # cooling down, still below max: no ladder
+            now[0] += 1.0
+            assert auto.step() == []
+        assert auto.rung == 0
+
+    def test_ladder_descends_fully_before_scale_down(self):
+        tier, now = FakeTier(2), [0.0]
+        auto = _autoscaler(
+            tier, 1, 2, now, up_stable=1, down_stable=1
+        )
+        tier.pressure = 2.0
+        now[0] += 1.0
+        assert auto.step() == [("rung_up", 1)]  # at max: climb
+        tier.pressure = 0.0
+        now[0] += 1.0
+        assert auto.step() == [("rung_down", 0)]  # rung clears first
+        now[0] += 1.0
+        assert [a for a, _ in auto.step()] == ["down"]
+
+    def test_decision_log_and_callback_are_stringly_stable(self):
+        tier, now = FakeTier(1), [0.0]
+        seen = []
+        auto = _autoscaler(
+            tier, 1, 2, now, up_stable=1,
+            on_decision=lambda action, arg: seen.append((action, arg)),
+        )
+        tier.pressure = 2.0
+        now[0] = 1.23456
+        auto.step()
+        assert auto.decisions == [(1.235, "up", "pressure=2.000 n=1->2")]
+        assert seen == [("up", "pressure=2.000 n=1->2")]
+        assert all(
+            isinstance(t, float) and isinstance(a, str) and isinstance(d, str)
+            for t, a, d in auto.decisions
+        )
+
+
+# ---------------------------------------------------------------------------
+# the SpawnedTier adapter: statz/loads -> signals
+# ---------------------------------------------------------------------------
+
+
+class _FakeSup:
+    def __init__(self, members, storm=False):
+        self.members = members
+        self.storm = storm
+
+    def respawn_storm(self):
+        return self.storm
+
+
+class _FakeMember:
+    def __init__(self, addr, member, up=True):
+        self.addr = addr
+        self.member = member
+        self.up = up
+
+    def alive(self):
+        return self.up
+
+
+class _FakeRouter:
+    def __init__(self, loads):
+        self.loads = loads
+
+    def member_loads(self):
+        return self.loads
+
+
+class TestSpawnedTierObserve:
+    def _tier(self, sup, loads, stats):
+        tier = SpawnedTier(sup, [_FakeRouter(loads)], make_client=None)
+        tier._statz = lambda addr: stats.get(addr)
+        return tier
+
+    def test_rejects_nonpositive_budget(self):
+        with pytest.raises(ValueError):
+            SpawnedTier(_FakeSup([]), [], None, wait_budget_s=0.0)
+
+    def test_statz_and_loads_fold_into_signals(self):
+        sup = _FakeSup(
+            [_FakeMember("a:1", "0"), _FakeMember("b:2", "1")]
+        )
+        stats = {
+            "a:1": {
+                "tenants": {"t": {"wait_p99_s": 2.5}},
+                "sheds": {},
+                "depth": 4,
+                "draining": False,
+            },
+            "b:2": {
+                "tenants": {},
+                "sheds": {},
+                "depth": 0,
+                "draining": True,
+            },
+        }
+        sig = self._tier(
+            sup, {"0": (2, 1), "1": (0, 0)}, stats
+        ).observe()
+        assert sig.pressure == pytest.approx(2.5)  # worst p99 / 1s budget
+        assert not sig.storm
+        first = sig.members[0]
+        assert (first.member, first.depth, first.inflight, first.spilling) \
+            == ("0", 4, 2, 1)
+        assert first.wait_p99_s == pytest.approx(2.5)
+        assert sig.members[1].draining  # the gateway said so
+
+    def test_dead_member_reads_as_draining(self):
+        sup = _FakeSup(
+            [_FakeMember("a:1", "0"), _FakeMember("b:2", "1", up=False)],
+            storm=True,
+        )
+        stats = {
+            "a:1": {
+                "tenants": {}, "sheds": {}, "depth": 0, "draining": False,
+            }
+        }
+        sig = self._tier(sup, {}, stats).observe()
+        assert sig.storm
+        assert sig.members[1].draining  # respawn in flight: never a victim
+
+    def test_sheds_bump_pressure_over_budget(self):
+        sup = _FakeSup([_FakeMember("a:1", "0")])
+        stats = {
+            "a:1": {
+                "tenants": {"t": {"wait_p99_s": 0.01}},
+                "sheds": {"t": 3},
+                "depth": 8,
+                "draining": False,
+            }
+        }
+        sig = self._tier(sup, {}, stats).observe()
+        assert sig.pressure >= 1.0  # a shed IS the over-budget signal
+
+
+# ---------------------------------------------------------------------------
+# router dynamic membership: resize remaps only the touched member's keys
+# ---------------------------------------------------------------------------
+
+
+def _fake_members(n):
+    return [
+        remote.SolverClient(f"127.0.0.1:{9000 + i}", member=str(i))
+        for i in range(n)
+    ]
+
+
+def _owners(router, keys):
+    return {k: router._ids[router._pick(k)] for k in keys}
+
+
+class TestRouterDynamicMembership:
+    def test_remove_remaps_only_the_retired_members_keys(self):
+        router = remote.FleetRouter(_fake_members(4))
+        keys = [f"catalog-{i}" for i in range(64)]
+        before = _owners(router, keys)
+        victim = before[keys[0]]
+        router.remove_member(router._ids.index(victim))
+        after = _owners(router, keys)
+        for k in keys:
+            if before[k] == victim:
+                assert after[k] != victim
+            else:
+                assert after[k] == before[k], (
+                    "a surviving member lost an affinity key on resize"
+                )
+
+    def test_add_gives_the_new_member_only_its_own_wins(self):
+        router = remote.FleetRouter(_fake_members(3))
+        keys = [f"catalog-{i}" for i in range(64)]
+        before = _owners(router, keys)
+        idx = router.add_member(
+            remote.SolverClient("127.0.0.1:9100", member="new"),
+            member_id="new",
+        )
+        assert idx == 3 and router._ids[3] == "new"
+        after = _owners(router, keys)
+        for k in keys:
+            assert after[k] in (before[k], "new")
+        # quarantine stays ONE verdict ledger across the grown fleet
+        assert router.members[idx].quarantine is router.quarantine
+
+    def test_member_ids_are_never_reused(self):
+        router = remote.FleetRouter(_fake_members(2))
+        router.remove_member(1)
+        i = router.add_member(remote.SolverClient("127.0.0.1:9101"))
+        j = router.add_member(remote.SolverClient("127.0.0.1:9102"))
+        assert len({router._ids[0], router._ids[i], router._ids[j]}) == 3
+
+    def test_remove_last_member_refused(self):
+        router = remote.FleetRouter(_fake_members(1))
+        with pytest.raises(ValueError):
+            router.remove_member(0)
+
+    def test_stale_index_raises_typed_lookup_error(self):
+        router = remote.FleetRouter(_fake_members(2))
+        with pytest.raises(UnknownMemberError) as ei:
+            router.remove_member(7)
+        assert isinstance(ei.value, LookupError)
+        assert ei.value.index == 7 and ei.value.size == 2
+        assert ei.value.site == "remove_member"
+        with pytest.raises(UnknownMemberError):
+            router.set_member_addr(-1, "127.0.0.1:9999")
+
+    def test_lineage_clears_only_when_the_winner_remaps(self):
+        router = remote.FleetRouter(_fake_members(4))
+        with router._lock:
+            router._lineage_key = "catalog-lineage"
+        router.prev_fingerprint = "fp-alive"
+        winner = router._lineage_winner_locked()
+        assert winner is not None
+        loser = next(mid for mid in router._ids if mid != winner)
+        router.remove_member(router._ids.index(loser))
+        # the winner survived: the predecessor reference is still valid
+        assert router.prev_fingerprint == "fp-alive"
+        router.remove_member(router._ids.index(winner))
+        assert router.prev_fingerprint == ""  # planned full, not amnesia
+
+
+class TestLineageAcrossResize:
+    def test_incremental_chain_survives_a_scale_down(self):
+        """Satellite regression (ISSUE 17): chain a lineage over a fleet,
+        retire its affinity winner, and the next round must be a PLANNED
+        full solve — no predecessor named (the miss counter does not
+        move), then the survivor warms like a fresh lineage."""
+        daemons = [service.SolverDaemon(), service.SolverDaemon()]
+        srvs = [service.serve(0, daemon=d) for d in daemons]
+        try:
+            members = [
+                remote.SolverClient(
+                    f"127.0.0.1:{s.server_address[1]}",
+                    timeout=120,
+                    member=str(i),
+                )
+                for i, s in enumerate(srvs)
+            ]
+            router = remote.FleetRouter(members)
+            pools = [make_nodepool()]
+            its = {"default": fake_instance_types(4)}
+            pods = [make_pod(cpu=1.0, name=f"rs{i}") for i in range(5)]
+
+            def solve_once():
+                return remote.RemoteScheduler(
+                    router, copy.deepcopy(pools), its,
+                    device_scheduler_opts={"incremental": True},
+                ).solve(copy.deepcopy(pods))
+
+            for _ in range(3):
+                assert solve_once().all_pods_scheduled()
+            assert router.prev_fingerprint
+            winner = router._lineage_winner_locked()
+            served = next(
+                i for i, c in enumerate(members) if len(c.segcache) > 0
+            )
+            assert router._ids[served] == winner  # affinity pinned it
+            router.remove_member(served)
+            assert router.prev_fingerprint == ""
+            outcomes = dict(m.SOLVER_INCREMENTAL.values)
+            assert solve_once().all_pods_scheduled()
+            # named no predecessor: neither a miss nor an amnesia event
+            assert dict(m.SOLVER_INCREMENTAL.values) == outcomes
+            assert router.prev_fingerprint  # a NEW lineage began
+            solve_once()  # names the survivor's own entry: full on miss
+            solve_once()
+            assert daemons[1 - served].incremental.last["outcome"] == "warm"
+        finally:
+            for s in srvs:
+                s.shutdown()
+                s.server_close()

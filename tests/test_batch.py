@@ -591,6 +591,82 @@ class TestDaemonBatchedE2E:
         assert gw.batch_stats()["mean_size"] == 1.0
 
 
+class TestDrainRacesCoalescedBatch:
+    def test_drain_flushes_queued_members_to_greedy_cleanly(self):
+        """A scale-down drain (fleetscale, ISSUE 17) racing an in-flight
+        coalesced batch: every ticket queued behind the active grant is
+        flushed with the drain refusal — each client degrades to greedy
+        (every pod still placed), the breaker takes NO charge, the
+        quarantine records NO strike, and the gateway's admission ledgers
+        return to zero once the grant releases."""
+        from karpenter_core_tpu.solver.remote import (
+            STATE_CLOSED,
+            RemoteScheduler,
+            SolverClient,
+        )
+
+        gw = FleetGateway(max_depth=8, max_batch=4)
+        daemon = service.SolverDaemon(gateway=gw)
+        srv = service.serve(0, daemon=daemon)
+        try:
+            addr = f"127.0.0.1:{srv.server_address[1]}"
+            client = SolverClient(addr, timeout=120, member="0")
+            # the in-flight grant the coalescing batch queues behind
+            park = gw.submit("zzz-park", fleet.LANE_SOLVE)
+            gw.await_grant(park)
+            tenants = ("qa", "qb", "qc")
+            results, errs = {}, {}
+
+            def run(tn):
+                pods = [
+                    make_pod(cpu=0.5, name=f"{tn}-{i}") for i in range(4)
+                ]
+                rs = RemoteScheduler(
+                    client,
+                    [make_nodepool(name=tn)],
+                    {tn: fake_instance_types(3)},
+                )
+                try:
+                    results[tn] = rs.solve(pods)
+                except Exception as e:  # surfaced by the caller
+                    errs[tn] = e
+
+            threads = [
+                threading.Thread(target=run, args=(tn,), daemon=True)
+                for tn in tenants
+            ]
+            fallbacks = m.SOLVER_RPC_FALLBACKS.value({"endpoint": "solve"})
+            for t in threads:
+                t.start()
+            for _ in range(800):
+                if gw.preparing() == 0 and gw.depth() == len(tenants) + 1:
+                    break
+                time.sleep(0.005)
+            assert gw.depth() == len(tenants) + 1, "batch never queued"
+            flushed = gw.drain()  # what POST /drain runs
+            gw.release(park, 0.01)
+            for t in threads:
+                t.join(120)
+            assert not errs, errs
+            assert flushed == len(tenants)
+            for tn in tenants:
+                assert results[tn].all_pods_scheduled()
+            # answered refusals: greedy serves, nothing is CHARGED
+            assert m.SOLVER_RPC_FALLBACKS.value(
+                {"endpoint": "solve"}
+            ) == fallbacks + len(tenants)
+            assert client.breaker.state == STATE_CLOSED
+            assert client.breaker.failures == 0
+            assert daemon.quarantine._strike_counts == {}
+            # the flush left no residue in the admission ledgers
+            assert gw.depth() == 0 and gw.preparing() == 0
+            assert gw._active is None and gw._batched_inflight == 0
+            assert gw.draining()
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
 class TestBatchFlagPlumbing:
     def test_operator_flags_parse_and_validate(self):
         from karpenter_core_tpu.operator import Options

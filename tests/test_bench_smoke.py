@@ -139,6 +139,35 @@ def test_fast_bench_emits_well_formed_json():
     assert cfg15["ledger"]["entries"] > 0
     assert isinstance(cfg15["incremental_ok"], bool)
 
+    # the tiny cfg16 proves the elastic solver tier end-to-end
+    # (ISSUE 17): the autoscaler grew and shrank a live tier, the
+    # member-seconds saving against the fixed-at-max control cleared the
+    # floor, resizing cost nothing at the wire (no miss rounds, no
+    # fallbacks, no breaker opened), and the brownout ladder climbed and
+    # descended strictly in order with the verifier untouched. The p99
+    # comparison is scale-sensitive (tiny queues round to zero), so
+    # p99_ok/elastic_ok are only required to be present (and boolean).
+    cfg16 = line["detail"]["cfg16_elastic"]
+    for key in ("autoscaled", "fixed", "member_seconds_saving_pct",
+                "saving_ok", "p99_ok", "resize_cost_ok", "brownout",
+                "elastic_ok"):
+        assert key in cfg16, key
+    assert cfg16["saving_ok"] is True, cfg16
+    assert cfg16["resize_cost_ok"] is True, cfg16
+    auto = cfg16["autoscaled"]
+    assert max(auto["sizes"]) > 1 and min(auto["sizes"]) == 1, auto
+    assert auto["remapped_lineages"] > 0, auto
+    assert auto["miss_rounds"] == 0 and auto["fallbacks"] == 0, auto
+    assert auto["open_breakers"] == 0, auto
+    ladder = cfg16["brownout"]
+    assert ladder["rung_order"] == [1, 2, 3, 2, 1, 0], ladder
+    assert ladder["brownout_order_ok"] is True
+    assert ladder["relax_served_as_ffd"] > 0 and ladder["relax_scheduled"]
+    assert ladder["restored"] is True
+    assert ladder["verifier_rejections"] == 0, ladder
+    assert isinstance(cfg16["p99_ok"], bool)
+    assert isinstance(cfg16["elastic_ok"], bool)
+
     # the tiny cfg11 gangsched smoke (ISSUE 10): preemption fired, every
     # gang stayed atomic, and the eviction set stayed minimal
     gangs = line["detail"]["cfg11_gangs"]

@@ -317,6 +317,122 @@ class TestTwinFleet:
         ) > 0
 
 
+def _elastic_scenario(**overrides) -> Scenario:
+    """The fleetscale (ISSUE 17) closed-loop shape: an early surge that
+    should grow the tier, then a long quiet tail that should shrink it
+    back — over a REAL in-thread solverd tier with the autoscaler riding
+    the twin's virtual clock."""
+    base = dict(
+        seed=11,
+        clusters=2,
+        duration=300.0,
+        tick=30.0,
+        solver="tpu",
+        fleet=1,
+        wire="delta",
+        autoscale=True,
+        fleet_min=1,
+        fleet_max=2,
+        waves=(
+            WorkloadWave(at=0.0, cluster=0, kind="serving", count=12,
+                         min_available=2),
+            WorkloadWave(at=0.0, cluster=1, kind="batch", count=12,
+                         lifetime=120.0),
+            WorkloadWave(at=30.0, cluster=0, kind="batch", count=10,
+                         lifetime=90.0),
+            WorkloadWave(at=240.0, cluster=1, kind="batch", count=6),
+        ),
+    )
+    base.update(overrides)
+    return Scenario(**base)
+
+
+class TestTwinElastic:
+    """Closed-loop elasticity (fleetscale, ISSUE 17): the autoscaler's
+    decisions are part of the deterministic trace, the elastic run must
+    beat a fixed-size control on member-seconds, and faults racing a
+    resize must neither wedge the loop nor break replay."""
+
+    def test_surge_quiet_scales_both_ways_and_replays_byte_identically(self):
+        scenario = _elastic_scenario()
+        a = run_scenario(scenario)
+        assert a.violations == []
+        assert a.counters["result_rejected"] == 0
+        # the loop actually closed: grew for the surge, shrank after
+        decisions = [e for e in a.trace if e[3] == "autoscale"]
+        assert any("up pressure=" in e[4] for e in decisions)
+        assert any(e[4].startswith("down ") for e in decisions)
+        assert a.ledger.peak_members == 2
+        # elasticity is WORTH something: strictly fewer member-seconds
+        # than the fixed-at-max control over the identical workload
+        control = run_scenario(_elastic_scenario(
+            autoscale=False, fleet_min=0, fleet_max=0, fleet=2,
+        ))
+        assert control.violations == []
+        assert a.ledger.member_seconds < control.ledger.member_seconds
+        # identical seed: byte-identical trace AND ledger, decisions and
+        # member-seconds included
+        b = run_scenario(scenario)
+        assert a.trace_json() == b.trace_json()
+        assert a.ledger_json() == b.ledger_json()
+
+    def test_murder_during_elastic_run_stays_clean_and_deterministic(self):
+        # member index 1 only exists if the autoscaler has grown the
+        # tier by t=150; either way the run must replay byte-identically
+        scenario = _elastic_scenario(
+            fleet_faults=(FleetFault(at=150.0, kind="murder", member=1),),
+        )
+        a = run_scenario(scenario)
+        assert a.violations == []
+        assert a.counters["result_rejected"] == 0
+        b = run_scenario(scenario)
+        assert a.trace_json() == b.trace_json()
+        assert a.ledger_json() == b.ledger_json()
+
+    def test_codec_round_trips_the_elastic_fields(self):
+        s = _elastic_scenario()
+        back = scenario_from_json(scenario_to_json(s))
+        assert back == s
+        assert (back.autoscale, back.fleet_min, back.fleet_max) == (
+            True, 1, 2,
+        )
+        # a pre-elastic encoding decodes with elasticity off
+        plain = decode_scenario(
+            {
+                k: v
+                for k, v in encode_scenario(s).items()
+                if k not in ("autoscale", "fleet_min", "fleet_max")
+            }
+        )
+        assert (plain.autoscale, plain.fleet_min, plain.fleet_max) == (
+            False, 0, 0,
+        )
+
+    def test_validation_rejects_inconsistent_elastic_bounds(self):
+        with pytest.raises(ValueError):
+            run_scenario(Scenario(clusters=1, autoscale=True))  # no fleet
+        with pytest.raises(ValueError):
+            run_scenario(_elastic_scenario(fleet_min=3))  # fleet < min
+        with pytest.raises(ValueError):
+            run_scenario(_elastic_scenario(fleet_min=2, fleet_max=1))
+        with pytest.raises(ValueError):
+            run_scenario(_elastic_scenario(fleet_min=-1))
+        with pytest.raises(ValueError):
+            # min/max are autoscaler knobs: rejected when the loop is off
+            run_scenario(_elastic_scenario(autoscale=False))
+        # a fault may target any member the tier could GROW to…
+        _elastic_scenario(
+            fleet_faults=(FleetFault(at=60.0, kind="murder", member=1),),
+        )
+        # …but not beyond the max bound
+        with pytest.raises(ValueError):
+            run_scenario(_elastic_scenario(
+                fleet_faults=(
+                    FleetFault(at=60.0, kind="murder", member=2),
+                ),
+            ))
+
+
 # ---------------------------------------------------------------------------
 # invariant monitor units (stub op: the monitor only reads op.kube)
 # ---------------------------------------------------------------------------

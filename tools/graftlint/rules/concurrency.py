@@ -295,7 +295,7 @@ _IO_PROPAGATION_STOPLIST = {
     "close", "run", "send", "solve", "encode", "decode", "items",
     "values", "keys", "next", "check", "info", "debug", "warning",
     "error", "exception", "log", "observe", "inc", "append", "join",
-    "main", "start", "stop",
+    "main", "start", "step", "stop",
 }
 _IO_MAX_CANDIDATES = 2
 _GRANT_ACQUIRE_TAILS = {"await_grant"}
