@@ -2,7 +2,10 @@
 
 Builds the full controller stack over the in-memory store + kwok provider
 and runs the reconcile loop. Flags/env parse through Options.parse
-(--solver greedy|tpu, --solver-mode inproc|sidecar, --solver-addr,
+(--solver greedy|tpu, --solver-mode inproc|sidecar, --solver-backend
+ffd|relax, --kernel xla|pallas (FFD-scan kernel implementation:
+hand-fused Pallas hot core vs classic XLA lowering — byte-identical
+results, a latency lever), --solver-addr,
 --solver-timeout, --solver-verify true|false (host-side verification of
 every device/sidecar result — on by default), --batch-max-duration,
 --batch-idle-duration, --log-level, --feature-gates Name=true,...), plus

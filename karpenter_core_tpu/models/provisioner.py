@@ -72,6 +72,7 @@ from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
 )
 from karpenter_core_tpu.ops import gangsched
 from karpenter_core_tpu.ops import masks as mops
+from karpenter_core_tpu.ops import pallas_ffd
 from karpenter_core_tpu.ops import relax as relax_ops
 from karpenter_core_tpu.ops import topoplan
 from karpenter_core_tpu.parallel import mesh as pmesh
@@ -311,6 +312,17 @@ class _KernelRequest:
     # problem's vmapped batch (the kernel-seam half of the
     # codec.problem_bucket solver-mode component)
     mode: str = "ffd"
+    # kernel backend that answers the FFD-scan dispatches ("xla" |
+    # "pallas", ISSUE 18): like ``mode``, a pure shape_key component —
+    # a pallas problem's dispatch must never coalesce into an xla
+    # problem's vmapped batch (their fused/unfused kernels are different
+    # jit entries even at identical tensor shapes). Gang, preempt, and
+    # relax dispatches stay on the XLA kernels under either backend (the
+    # fused port covers the FFD scan — ~85% of kernel_s); the field still
+    # rides those requests so a mixed-backend fleet's buckets split
+    # cleanly (the kernel-seam half of the solverd ``|k{kernel}`` bucket
+    # suffix).
+    backend: str = "xla"
     # gang-atomic solve (both None for plain problems — same kernels,
     # same jit entries, byte-identical results as pre-gang)
     # [Jp] int32 gang step index (gangmod.GANG_FREE outside any gang,
@@ -350,6 +362,7 @@ class _KernelRequest:
         return (
             self.kind,
             self.mode,
+            self.backend,
             tuple((tuple(x.shape), str(x.dtype)) for x in leaves),
             self.level_iters,
             self.num_classes,
@@ -385,6 +398,20 @@ def _run_kernel_solo(req: _KernelRequest):
         state, takes, unplaced = gangsched.gang_solve_donated(
             req.init_state, req.steps, req.statics,
             req.gang_of_step, req.gang_min, level_iters=req.level_iters,
+        )
+    elif req.backend == "pallas":
+        init, steps, statics = req.init_state, req.steps, req.statics
+        if req.devices > 1:
+            # the pallas_call boundary is opaque to GSPMD: commit the
+            # planes replicated (the sanctioned parallel.mesh route)
+            # instead of letting XLA all-gather per fused step
+            mesh = pmesh.slot_mesh(req.devices)
+            init, steps, statics = jax.device_put(
+                (init, steps, statics),
+                pmesh.pallas_slot_shardings(mesh, (init, steps, statics)),
+            )
+        state, takes, unplaced = pallas_ffd.pallas_ffd_solve_donated(
+            init, steps, statics, level_iters=req.level_iters,
         )
     else:
         state, takes, unplaced = ffd_solve_donated(
@@ -506,6 +533,19 @@ def _run_kernel_batched(reqs: List[_KernelRequest]):
         state_b, takes_b, unplaced_b = gangsched.gang_solve_batched_donated(
             state, steps, statics, gang_of_step, gang_min,
             level_iters=head.level_iters,
+        )
+    elif head.backend == "pallas":
+        if mesh is not None:
+            # opaque-to-GSPMD pallas boundary: re-commit the stacked
+            # trees replicated (see _run_kernel_solo)
+            state, steps, statics = jax.device_put(
+                (state, steps, statics),
+                pmesh.pallas_slot_shardings(mesh, (state, steps, statics)),
+            )
+        state_b, takes_b, unplaced_b = (
+            pallas_ffd.pallas_ffd_solve_batched_donated(
+                state, steps, statics, level_iters=head.level_iters
+            )
         )
     else:
         state_b, takes_b, unplaced_b = ffd_solve_batched_donated(
@@ -665,6 +705,7 @@ class DeviceScheduler:
         solver_mode: str = "ffd",
         relax_iters: Optional[int] = None,
         relax_budget_s: Optional[float] = None,
+        kernel_backend: str = "xla",
     ):
         # relaxsolve (ISSUE 13): "ffd" is the classic first-fit-decreasing
         # backend, byte-untouched; "relax" layers the convex-relaxation
@@ -675,6 +716,16 @@ class DeviceScheduler:
         if solver_mode not in ("ffd", "relax"):
             raise ValueError(f"unknown solver mode {solver_mode!r}")
         self.solver_mode = solver_mode
+        # kernel backend (ISSUE 18): "xla" is the classic lax.scan whose
+        # per-step stages lower as separate XLA ops; "pallas" routes the
+        # FFD-scan dispatches through the hand-fused per-class kernel
+        # (ops/pallas_ffd.py) — byte-identical results, one fused VMEM-
+        # resident invocation per class step. Orthogonal to solver_mode:
+        # relax mode's FFD baseline/candidate scans ride the selected
+        # backend too; gang/preempt/relax dispatches stay on XLA kernels.
+        if kernel_backend not in ("xla", "pallas"):
+            raise ValueError(f"unknown kernel backend {kernel_backend!r}")
+        self.kernel_backend = kernel_backend
         self.relax_iters = (
             relax_iters
             if relax_iters is not None
@@ -944,6 +995,8 @@ class DeviceScheduler:
             "h2d_dev_bytes": 0, "fetch_dev_bytes": 0,
             # which backend served this solve (bench/ops attribution)
             "solver_mode": self.solver_mode,
+            # ... and which kernel backend answered its scan dispatches
+            "kernel_backend": self.kernel_backend,
         }
         if self.solver_mode == "relax":
             stats["relax"] = {}
@@ -1173,6 +1226,7 @@ class DeviceScheduler:
             ),
             gang_min=prep.gang_min,
             mode=self.solver_mode,
+            backend=self.kernel_backend,
         )
         prep.init_state = None
         t0 = time.perf_counter()
@@ -1256,6 +1310,7 @@ class DeviceScheduler:
                     step_gang=prep.step_gang,
                     unplaced=u_step,
                     ev=prep.ev,
+                    backend=self.kernel_backend,
                 )
                 kernel_share_s += pdt
                 takes_bc = takes_bc + extra_bc
@@ -1453,6 +1508,7 @@ class DeviceScheduler:
                 jnp.asarray(wvec),
             ),
             relax_iters=self.relax_iters, relax_gangs=planes["n_gangs"],
+            backend=self.kernel_backend,
         )
         extra += dt
         rstats["template_moves"] = int(changed)
@@ -1480,6 +1536,7 @@ class DeviceScheduler:
             ),
             gang_min=prep.gang_min,
             mode="relax",
+            backend=self.kernel_backend,
         )
         extra += dt2
         t0 = time.perf_counter()

@@ -102,6 +102,15 @@ class Options:
     # rides every RPC (wire field + header) AND the spawned child's
     # argv as its default for mode-less clients.
     solver_backend: str = "ffd"  # ffd | relax
+    # which KERNEL implementation answers the FFD scan dispatches under
+    # whichever backend is selected above (ISSUE 18): xla = the classic
+    # per-op lowering of ops/ffd.py, pallas = the hand-fused per-class
+    # kernel (ops/pallas_ffd.py, VMEM-resident slot state; interpreted
+    # off-TPU so the choice is valid everywhere). Byte-identical results
+    # either way — this is a latency lever, not a semantics switch.
+    # In-proc it threads into DeviceScheduler(kernel_backend=); in
+    # sidecar mode it rides the spawned child's argv (solverd --kernel).
+    solver_kernel: str = "xla"  # xla | pallas
     solver_addr: str = ""
     solver_timeout: float = 30.0  # per-RPC deadline, seconds
     # host-side verification of every device/sidecar solve result
@@ -186,6 +195,9 @@ class Options:
         "solver_mode": ("--solver-mode", "KARPENTER_SOLVER_MODE", str),
         "solver_backend": (
             "--solver-backend", "KARPENTER_SOLVER_BACKEND", str,
+        ),
+        "solver_kernel": (
+            "--kernel", "KARPENTER_SOLVER_KERNEL", str,
         ),
         "solver_addr": ("--solver-addr", "KARPENTER_SOLVER_ADDR", str),
         "solver_timeout": (
@@ -401,6 +413,12 @@ class Options:
             raise ValueError(
                 f"unknown solver backend {opts.solver_backend!r}"
             )
+        if opts.solver_kernel not in ("xla", "pallas"):
+            # reject loudly at the flag surface: a typo'd kernel name
+            # must not silently fall back to xla and fake a speedup
+            raise ValueError(
+                f"unknown kernel {opts.solver_kernel!r} (xla | pallas)"
+            )
         if opts.solver_mode == "sidecar" and opts.solver != "tpu":
             # the sidecar hosts the DEVICE solver; accepting this combo
             # would silently run greedy in-proc while logging sidecar mode
@@ -531,6 +549,14 @@ class Operator:
                         if self.options.solver_backend != "ffd"
                         else None
                     ),
+                    # the child's FFD-scan kernel implementation; only a
+                    # non-default choice rides the argv, so a respawned
+                    # child keeps the operator's selection
+                    kernel=(
+                        self.options.solver_kernel
+                        if self.options.solver_kernel != "xla"
+                        else None
+                    ),
                 )
                 if (
                     self.options.solver_fleet > 1
@@ -618,6 +644,13 @@ class Operator:
             device_opts.setdefault(
                 "solver_mode", self.options.solver_backend
             )
+            # the FFD-scan kernel selector (--kernel) reaches the in-proc
+            # DeviceScheduler the same way; in sidecar mode the spawned
+            # child's argv carries it instead (the child owns the chips)
+            if self.solver_client is None:
+                device_opts.setdefault(
+                    "kernel_backend", self.options.solver_kernel
+                )
         if self.options.solver == "tpu" and self.solver_client is None:
             device_opts.setdefault("devices", self.options.solver_devices)
         self.provisioner = Provisioner(

@@ -59,8 +59,12 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-BIG = jnp.float32(3.4e38)
+# a numpy scalar, NOT a jnp array: jnp constants close over device buffers,
+# which the Pallas twin (ops/pallas_ffd.py) cannot capture inside a kernel
+# body — as a literal it lowers identically in both backends
+BIG = np.float32(3.4e38)
 BIGI = 1 << 30
 RANK_NONE = 1 << 30
 

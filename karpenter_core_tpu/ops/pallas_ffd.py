@@ -1,0 +1,243 @@
+"""Hand-fused Pallas twin of the FFD hot core (``--kernel=pallas``).
+
+``kernel_s`` is ~85% of the primary solve p50 because the ``lax.scan``
+over class steps in ops/ffd.py lowers each step's stages — feasibility
+masking (``_class_slot_compatible`` / ``_offering_ok`` /
+``_label_admissible``), the ``_k_max``/host-cap evaluation, the
+exclusive-prefix first-fit scan, and the slot-state update — as separate
+XLA ops, re-materializing the [N,K,V] requirement planes and the [N,T]
+itmask through HBM between them. This module fuses the whole per-class
+inner loop into ONE ``pl.pallas_call`` per class step: every plane is a
+whole-array VMEM block, the slot-state inputs alias the slot-state
+outputs (``input_output_aliases``) so the carry stays resident in VMEM
+across the fused stages instead of round-tripping per op, and the scan
+over classes drives the fused kernel exactly like the XLA path drives
+``ffd_step``.
+
+Byte parity is by CONSTRUCTION, not by re-derivation: the kernel body
+reassembles the SlotState/ClassStep/FFDStatics trees from the refs and
+calls the one true ``ops.ffd.ffd_step`` — the same integer-exact float32
+arithmetic, the same water-fill, the same prefix scan. The only
+transforms at the kernel boundary are losslessly invertible plumbing for
+the Mosaic calling convention: bool planes ride as int8 (restored with
+``!= 0``) and 0-d scalars ride as (1, 1) blocks (restored by reshape).
+The parity battery (tests/test_pallas.py) pins the result wire
+byte-identical to the XLA path across every fuzz seed, topology, gang,
+relax, batched, and multi-device problem.
+
+CPU story: the backend is probed lazily at first call (never at import —
+importing must not initialize the XLA runtime, the ops/ffd contract) and
+non-TPU backends run the kernel under ``interpret=True``, so tier-1
+exercises the exact fused dataflow — including the aliasing — on the
+virtual CPU mesh. Multi-device callers commit their planes REPLICATED
+(parallel/mesh.pallas_slot_shardings) before dispatch: the pallas_call
+boundary is opaque to the GSPMD partitioner, so the pallas path trades
+the sharded-slot-axis throughput of the XLA path for fusion; results are
+byte-identical either way, and cross-device throughput is the XLA
+backend's job (bench cfg8) while single-core latency is this one's
+(bench cfg17).
+
+graftlint: the four jit entries below are registered in
+SLOTSTATE_JIT_ENTRIES (GL501/GL503 slot-state placement/gather rules)
+and the module sits on the GL604 padding-inertness beat — pad slots
+(kind=0) stay inert through the fused step because ffd_step's own
+masking runs unchanged inside the kernel.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from karpenter_core_tpu.ops import ffd as ffd_ops
+from karpenter_core_tpu.ops.ffd import (
+    LEVEL_ITERS,
+    ClassStep,
+    FFDStatics,
+    SlotState,
+)
+
+_N_STATE = len(SlotState._fields)
+
+
+def _interpret() -> bool:
+    """Run the kernel interpreted off-TPU (first-call probe, never at
+    import)."""
+    return jax.default_backend() != "tpu"
+
+
+def _to_kernel(x, batched: bool):
+    """Mosaic-friendly leaf layout: bool -> int8, scalars -> (1, 1)
+    blocks ((B, 1) under a leading problem axis). Lossless — the kernel
+    body and the wrapper invert it exactly."""
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.int8)
+    if x.ndim == (1 if batched else 0):
+        x = x.reshape((x.shape[0], 1) if batched else (1, 1))
+    return x
+
+
+def _from_kernel(v, aval):
+    """Invert _to_kernel against the original (pre-layout) aval."""
+    v = v.reshape(aval.shape)
+    if aval.dtype == jnp.bool_:
+        return v != 0
+    if v.dtype != aval.dtype:
+        v = v.astype(aval.dtype)
+    return v
+
+
+def _fused_step(state: SlotState, c: ClassStep, statics: FFDStatics,
+                level_iters: int, batched: bool = False):
+    """One fused per-class step: a single pallas_call evaluating mask ->
+    k_max/caps -> prefix-fit/water-fill -> state update with the slot
+    planes held in VMEM. Returns (state', (take_all, unplaced)) with
+    ffd_step's exact signature so the scan drivers are interchangeable."""
+    operands = (state, c, statics)
+    leaves, treedef = jax.tree.flatten(operands)
+    avals = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in leaves]
+    kernel_in = [_to_kernel(x, batched) for x in leaves]
+    n_in = len(kernel_in)
+
+    if batched:
+        B, N = state.kind.shape
+        take_aval = jax.ShapeDtypeStruct((B, N), jnp.int32)
+        unplaced_shape = (B, 1)
+    else:
+        N = state.kind.shape[0]
+        take_aval = jax.ShapeDtypeStruct((N,), jnp.int32)
+        unplaced_shape = (1, 1)
+    out_shape = [
+        jax.ShapeDtypeStruct(x.shape, x.dtype)
+        for x in kernel_in[:_N_STATE]
+    ] + [take_aval, jax.ShapeDtypeStruct(unplaced_shape, jnp.int32)]
+
+    def kernel(*refs):
+        ins, outs = refs[:n_in], refs[n_in:]
+        vals = [
+            _from_kernel(r[...], av) for r, av in zip(ins, avals)
+        ]
+        st, cc, stat = jax.tree.unflatten(treedef, vals)
+        if batched:
+            st2, (take, unplaced) = jax.vmap(
+                lambda s, c_, x: ffd_ops.ffd_step(s, c_, x, level_iters)
+            )(st, cc, stat)
+        else:
+            st2, (take, unplaced) = ffd_ops.ffd_step(
+                st, cc, stat, level_iters
+            )
+        out_vals = list(st2) + [take, unplaced]
+        for r, v in zip(outs, out_vals):
+            r[...] = _to_kernel(v, batched)
+
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        # slot-state carry aliases in place: the planes the scan threads
+        # through every class step never leave VMEM between stages
+        input_output_aliases={i: i for i in range(_N_STATE)},
+        interpret=_interpret(),
+    )(*kernel_in)
+
+    state2 = SlotState(
+        *(_from_kernel(v, av) for v, av in zip(outs[:_N_STATE], avals))
+    )
+    take_all = outs[_N_STATE]
+    unplaced = outs[_N_STATE + 1].reshape(
+        (state.kind.shape[0],) if batched else ()
+    )
+    return state2, (take_all, unplaced)
+
+
+def _pallas_ffd_solve_impl(state: SlotState, classes: ClassStep,
+                           statics: FFDStatics,
+                           level_iters: int = LEVEL_ITERS):
+    final, (takes, unplaced) = jax.lax.scan(
+        lambda st, c: _fused_step(st, c, statics, level_iters),
+        state, classes,
+    )
+    return final, takes, unplaced
+
+
+# Fused-scan twin of ops/ffd.ffd_solve; same signature, same returns
+# (final state, takes [J, N], unplaced [J]).
+# graftlint: disable=GL103 -- deliberately non-donating, mirroring
+# ffd_solve: parity tests re-drive the same init SlotState against both
+# backends; the provisioning hot path uses pallas_ffd_solve_donated
+pallas_ffd_solve = partial(jax.jit, static_argnames=("level_iters",))(
+    _pallas_ffd_solve_impl
+)
+
+# Donating twin, mirroring ffd_solve_donated byte for byte: the SlotState
+# argument's buffers back the aliased kernel carry directly, so the HBM
+# the init state arrived in is the HBM the final state leaves in. CPU
+# (and any interpreted backend) aliases the non-donating entry so the
+# virtual test mesh doesn't warn per compile; the probe is lazy (first
+# call), never at import.
+_donated_impl = None
+
+
+def pallas_ffd_solve_donated(state: SlotState, classes: ClassStep,
+                             statics: FFDStatics,
+                             level_iters: int = LEVEL_ITERS):
+    global _donated_impl
+    if _donated_impl is None:
+        if jax.default_backend() == "tpu":
+            _donated_impl = partial(
+                jax.jit, static_argnames=("level_iters",),
+                donate_argnums=(0,),
+            )(_pallas_ffd_solve_impl)
+        else:
+            _donated_impl = pallas_ffd_solve
+    return _donated_impl(state, classes, statics, level_iters=level_iters)
+
+
+def _pallas_ffd_solve_batched_impl(state: SlotState, classes: ClassStep,
+                                   statics: FFDStatics,
+                                   level_iters: int = LEVEL_ITERS):
+    # The problem axis rides INSIDE the fused kernel (vmap of ffd_step
+    # over the leading axis of every block) rather than as a vmap over
+    # pallas_call — one kernel invocation per class step regardless of
+    # batch size, the same invocation count as the solo path. The scan
+    # axis must lead for lax.scan, so the [B, J, ...] class leaves
+    # transpose to [J, B, ...] and the outputs transpose back.
+    classes_t = jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), classes)
+    final, (takes, unplaced) = jax.lax.scan(
+        lambda st, c: _fused_step(st, c, statics, level_iters,
+                                  batched=True),
+        state, classes_t,
+    )
+    return (
+        final,
+        jnp.swapaxes(takes, 0, 1),  # [J, B, N] -> [B, J, N]
+        jnp.swapaxes(unplaced, 0, 1),  # [J, B] -> [B, J]
+    )
+
+
+# Fused-scan twin of ffd_solve_batched (stacked [B, ...] problems).
+# graftlint: disable=GL103 -- deliberately non-donating, mirroring
+# ffd_solve_batched: the batched parity tests re-drive the same stacked
+# state; production batches use the donating twin below
+pallas_ffd_solve_batched = partial(
+    jax.jit, static_argnames=("level_iters",)
+)(_pallas_ffd_solve_batched_impl)
+
+_batched_donated_impl = None
+
+
+def pallas_ffd_solve_batched_donated(state: SlotState, classes: ClassStep,
+                                     statics: FFDStatics,
+                                     level_iters: int = LEVEL_ITERS):
+    global _batched_donated_impl
+    if _batched_donated_impl is None:
+        if jax.default_backend() == "tpu":
+            _batched_donated_impl = partial(
+                jax.jit, static_argnames=("level_iters",),
+                donate_argnums=(0,),
+            )(_pallas_ffd_solve_batched_impl)
+        else:
+            _batched_donated_impl = pallas_ffd_solve_batched
+    return _batched_donated_impl(state, classes, statics,
+                                 level_iters=level_iters)

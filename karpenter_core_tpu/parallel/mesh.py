@@ -174,6 +174,26 @@ def relax_plane_shardings(mesh: Mesh, tree):
     return jax.tree.map(lambda _: repl, tree)
 
 
+def pallas_slot_shardings(mesh: Mesh, tree):
+    """Shardings for trees bound for the Pallas fused kernels
+    (ops/pallas_ffd.py) on a multi-device mesh: EVERY leaf replicates.
+
+    The pallas_call boundary is opaque to the GSPMD partitioner — it
+    cannot split the fused per-class step over the slot axis the way it
+    splits the XLA ops — so a pallas dispatch consumes whole planes on
+    every device. Committing them replicated up front (rather than
+    letting XLA insert an all-gather per dispatch against slot-sharded
+    inputs) makes that cost explicit and deterministic, and keeps the
+    placement on a sanctioned parallel.mesh route so graftlint
+    GL501/GL503 resolve the pallas jit entries' slot-state placement
+    exactly like every other kernel family's. Results stay
+    byte-identical to the sharded XLA path; multi-device THROUGHPUT is
+    the XLA backend's job (bench cfg8), single-core fusion is this
+    one's (bench cfg17)."""
+    repl = replicated(mesh)
+    return jax.tree.map(lambda _: repl, tree)
+
+
 def _batched_specs(mesh: Mesh, tree, table: dict, n_slots: int, axis: str):
     """Shardings for a problem-batched NamedTuple [B, ...]: the batch axis
     replicates (each device holds every problem's shard — the vmap then

@@ -236,6 +236,7 @@ class SolverDaemon:
         chaos=None,
         exit_fn=None,
         default_mode: str = "ffd",
+        kernel: str = "xla",
         segment_store: segments.SegmentStore = None,
         incremental=None,
     ):
@@ -280,6 +281,17 @@ class SolverDaemon:
         if default_mode not in codec.SOLVER_MODES:
             raise ValueError(f"unknown solver mode {default_mode!r}")
         self.default_mode = default_mode
+        # which kernel implementation answers the FFD scan dispatches
+        # (ISSUE 18, solverd --kernel riding the supervisor spawn argv):
+        # xla = classic per-op lowering, pallas = the hand-fused per-class
+        # kernel (ops/pallas_ffd.py). Daemon-wide — results are
+        # byte-identical either way, so unlike solver mode it needs no
+        # per-request wire field; it still suffixes the coalescer bucket
+        # (below) so a mixed-kernel fleet's members never share a
+        # problem_bucket string with different device programs behind it.
+        if kernel not in ("xla", "pallas"):
+            raise ValueError(f"unknown kernel {kernel!r} (xla | pallas)")
+        self.kernel = kernel
         # shard every solve/sweep over the first N local devices (0 = all;
         # requests clamp to what exists, so a multi-device config degrades
         # to the single-device path on a 1-chip box). Resolved lazily per
@@ -492,7 +504,10 @@ class SolverDaemon:
             # daemon's device count; the fingerprint keeps two requests
             # for the SAME problem off one grant (a cached DeviceScheduler
             # is single-solve stateful)
-            ticket.bucket = f"{problem['bucket']}|m{eff_mode}|d{self.devices}"
+            ticket.bucket = (
+                f"{problem['bucket']}|m{eff_mode}|d{self.devices}"
+                f"|k{self.kernel}"
+            )
             ticket.fingerprint = problem["fingerprint"]
             ticket.payload = (body, problem, digest)
         except BaseException:
@@ -538,6 +553,7 @@ class SolverDaemon:
                 solver_mode=(
                     problem.get("solver_mode") or self.default_mode
                 ),
+                kernel_backend=self.kernel,
                 # the CLIENT verifies (solver/remote.py): it must not
                 # trust the wire anyway, so a sidecar-side check would
                 # double the overhead yet still miss wire corruption —
@@ -938,6 +954,10 @@ class SolverDaemon:
             # admission capacity — a metric-labeled state, never a
             # verification change
             "brownout_rung": self.brownout_rung,
+            # which FFD-scan kernel this daemon answers with (ISSUE 18,
+            # --kernel): results are byte-identical across kernels, so
+            # this is a performance-dashboard fact, not a routing one
+            "kernel": self.kernel,
             # continuous-batching stats: how much device serialization the
             # coalescer is currently buying back (mean problems per grant,
             # lifetime coalesced count, the configured window/size bounds)
@@ -976,6 +996,7 @@ class SolverDaemon:
             DeviceScheduler(
                 [pool], {"prewarm": catalog}, max_slots=256,
                 devices=self.devices,
+                kernel_backend=self.kernel,
                 # same sidecar contract as the solve path: the CLIENT is
                 # the trust anchor, and a synthetic warm-up solve must
                 # never bump the fleet's rejection metric from inside boot
@@ -1258,6 +1279,14 @@ def main() -> int:
         " X-Solver-Mode header",
     )
     ap.add_argument(
+        "--kernel", choices=("xla", "pallas"), default="xla",
+        help="FFD-scan kernel implementation: xla = classic per-op"
+        " lowering of ops/ffd.py, pallas = the hand-fused per-class"
+        " kernel (ops/pallas_ffd.py, slot state resident in VMEM across"
+        " the fused stages; interpreted off-TPU). Byte-identical results"
+        " either way — a latency lever, not a semantics switch",
+    )
+    ap.add_argument(
         "--segment-cache-mib", type=int,
         default=segments.DEFAULT_STORE_BYTES >> 20,
         help="delta-wire segment store byte bound, in MiB (canonical"
@@ -1343,6 +1372,7 @@ def main() -> int:
         devices=args.devices,
         watchdog_seconds=args.watchdog_seconds,
         default_mode=args.solver_mode,
+        kernel=args.kernel,
         segment_store=segments.SegmentStore(
             max_bytes=args.segment_cache_mib << 20,
             ttl=args.segment_ttl,

@@ -64,6 +64,7 @@ def default_command(
     watchdog_seconds: Optional[float] = None,
     quarantine_journal: Optional[str] = None,
     solve_mode: Optional[str] = None,
+    kernel: Optional[str] = None,
 ) -> List[str]:
     cmd = [
         sys.executable,
@@ -112,6 +113,11 @@ def default_command(
     # the operator's --solver-backend choice to mode-less requests
     if solve_mode:
         cmd.extend(["--solver-mode", solve_mode])
+    # the FFD-scan kernel implementation (ISSUE 18, --kernel=xla|pallas):
+    # only a non-default rides the argv, so a respawned sidecar keeps
+    # answering scans with the operator's fused-kernel choice
+    if kernel:
+        cmd.extend(["--kernel", kernel])
     return cmd
 
 
@@ -132,6 +138,7 @@ class SolverSupervisor:
         watchdog_seconds: Optional[float] = None,
         quarantine_journal: Optional[str] = None,
         solve_mode: Optional[str] = None,
+        kernel: Optional[str] = None,
         backoff_initial: float = 1.0,
         backoff_max: float = 30.0,
         stable_window: float = 60.0,
@@ -154,6 +161,7 @@ class SolverSupervisor:
             watchdog_seconds=watchdog_seconds,
             quarantine_journal=quarantine_journal,
             solve_mode=solve_mode,
+            kernel=kernel,
         )
         self.backoff_initial = backoff_initial
         self.backoff_max = backoff_max

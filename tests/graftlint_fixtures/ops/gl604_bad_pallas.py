@@ -1,0 +1,23 @@
+"""BAD: a fused-kernel wrapper (the ops/pallas_ffd.py shape) pads the
+score plane to the block multiple and lets the inert padded rows vote in
+the argmin that picks the fused step's winning slot — pad-provenance
+content reaches a reduction inside the traced wrapper with no masking
+step."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _scale_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+@jax.jit
+def fused_pick(scores):
+    padded = jnp.pad(scores, (0, 8))
+    fused = pl.pallas_call(
+        _scale_kernel,
+        out_shape=jax.ShapeDtypeStruct(padded.shape, padded.dtype),
+        interpret=True,
+    )(padded)
+    return fused, jnp.argmin(padded)

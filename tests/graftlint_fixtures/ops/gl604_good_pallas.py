@@ -1,0 +1,25 @@
+"""GOOD: the same fused-kernel wrapper masks the padded plane (jnp.where
+with a validity predicate and a neutral fill) before the winner
+reduction — padded rows cannot vote, exactly the ffd_step masking the
+pallas port carries through the kernel body unchanged."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _scale_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+@jax.jit
+def fused_pick(scores):
+    padded = jnp.pad(scores, (0, 8))
+    fused = pl.pallas_call(
+        _scale_kernel,
+        out_shape=jax.ShapeDtypeStruct(padded.shape, padded.dtype),
+        interpret=True,
+    )(padded)
+    masked = jnp.where(
+        jnp.arange(padded.shape[0]) < scores.shape[0], padded, 1e30
+    )
+    return fused, jnp.argmin(masked)

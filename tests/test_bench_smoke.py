@@ -60,6 +60,9 @@ def test_fast_bench_emits_well_formed_json():
     assert phases["used_slots"] >= primary["nodes"] > 0
     # every config's phases block is backend-attributable (ISSUE 13)
     assert phases["solver_mode"] == "ffd"
+    # ... and kernel-attributable (ISSUE 18): the default is the classic
+    # XLA lowering, untouched by the pallas landing
+    assert phases["kernel_backend"] == "xla"
     # the tiny cfg12 proves the relaxsolve backend end-to-end: both
     # modes solved, deltas recorded, and the acceptance gate holds even
     # at smoke scale (the two-pool construction makes the win structural)
@@ -167,6 +170,34 @@ def test_fast_bench_emits_well_formed_json():
     assert ladder["verifier_rejections"] == 0, ladder
     assert isinstance(cfg16["p99_ok"], bool)
     assert isinstance(cfg16["elastic_ok"], bool)
+
+    # the tiny cfg17 proves the pallas kernel seam end-to-end (ISSUE
+    # 18): both backends solved both shapes, the result wire matched
+    # byte-for-byte, and the used-slot fetch window moved identical
+    # device bytes under either kernel (the aggregate_takes windowing is
+    # host-side and backend-agnostic). This smoke runs on the CPU
+    # backend, so pallas ran in interpret mode: the latency verdicts
+    # must be null (not a vacuous pass OR fail) with the speedup_note
+    # explaining why — the cfg8 precedent.
+    cfg17 = line["detail"]["cfg17_pallas"]
+    for key in ("backend", "primary", "topology", "parity_ok",
+                "primary_p50_target_ok", "topology_halved_ok"):
+        assert key in cfg17, key
+    assert cfg17["parity_ok"] is True, cfg17
+    for shape_name in ("primary", "topology"):
+        shape = cfg17[shape_name]
+        assert shape["wire_parity_ok"] is True, (shape_name, shape)
+        assert shape["fetch_dev_bytes_parity_ok"] is True, (
+            shape_name, shape)
+        assert shape["nodes_delta_pallas_vs_xla"] == 0, (
+            shape_name, shape)
+        # each half attributes its numbers to its kernel backend
+        assert shape["xla"]["phases"]["kernel_backend"] == "xla"
+        assert shape["pallas"]["phases"]["kernel_backend"] == "pallas"
+    assert cfg17["backend"] == "cpu"
+    assert cfg17["primary_p50_target_ok"] is None
+    assert cfg17["topology_halved_ok"] is None
+    assert "interpret mode" in cfg17["speedup_note"]
 
     # the tiny cfg11 gangsched smoke (ISSUE 10): preemption fired, every
     # gang stayed atomic, and the eviction set stayed minimal

@@ -1,0 +1,360 @@
+"""pallas (ISSUE 18): the hand-fused FFD hot-core kernel behind
+``--kernel=xla|pallas``.
+
+The correctness contract is BYTE PARITY: the Pallas backend
+(ops/pallas_ffd.py, one fused kernel invocation per class step, slot
+state resident in VMEM) must produce the byte-identical result wire of
+the classic XLA backend on every problem family — the PR 9 battery
+pattern, applied to the kernel seam:
+
+* every fuzz seed (the full mixed-constraint scenario generator), with
+  the ResultVerifier rejection counter pinned unmoved — verification
+  runs inside the pallas solves, so a parity break would first surface
+  as a silent fleet-wide greedy degrade;
+* topology, gang/preemption, and relax-mode problems — the gang,
+  preempt, and relax dispatches stay on the XLA kernels under either
+  backend, so these pin that the fused FFD scan composes with them
+  without perturbing a placement;
+* batched: a mixed-backend ``solve_batch`` must never coalesce xla and
+  pallas problems into one vmapped dispatch (``_KernelRequest.shape_key``
+  backend component), while each member still matches its solo twin;
+* multi-device: the forced 8-device virtual mesh, where the pallas path
+  commits its planes replicated (parallel/mesh.pallas_slot_shardings —
+  the pallas_call boundary is opaque to GSPMD) yet must reproduce the
+  slot-sharded XLA wire byte-for-byte;
+* incremental warm-replay: a pallas daemon's warm replay is
+  byte-identical to its own fresh solve AND to an xla daemon's answer.
+
+Plus the flag surface (operator --kernel / KARPENTER_SOLVER_KERNEL /
+solverd --kernel / supervisor argv): unknown values reject loudly at
+every layer, the xla default stays untouched.
+"""
+import copy
+
+import pytest
+
+from tests.helpers import make_nodepool, make_pod
+from tests.test_fuzz_parity import fuzz_scenario
+from tests.test_gangsched import (
+    SYSTEM_CLUSTER_CRITICAL,
+    full_node,
+    gang_pod,
+    small_catalog,
+)
+from tests.test_incremental import _encode, _fp, _strip
+from tests.test_relaxsolve import two_pool_world
+
+from karpenter_core_tpu.cloudprovider.kwok import build_catalog
+from karpenter_core_tpu.metrics import wiring as m
+from karpenter_core_tpu.models.provisioner import (
+    DeviceScheduler,
+    solve_batch,
+)
+from karpenter_core_tpu.solver import codec, service
+
+
+def _wire(results):
+    # solve_seconds is timing, not packing: pin it so wire comparison is
+    # exact over the decision content
+    return codec.encode_solve_results(results, 0.0)
+
+
+def _rejections():
+    return dict(m.SOLVER_RESULT_REJECTED.values)
+
+
+def _solve_both(pools, its, pods, existing=(), max_slots=128, devices=1,
+                solver_mode="ffd"):
+    """The same problem under both kernel backends (verification ON, the
+    production default); returns (wire_xla, wire_pallas, sched_pallas)."""
+    x = DeviceScheduler(
+        copy.deepcopy(pools), its,
+        existing_nodes=copy.deepcopy(list(existing)),
+        max_slots=max_slots, devices=devices, solver_mode=solver_mode,
+    )
+    rx = x.solve(copy.deepcopy(pods))
+    p = DeviceScheduler(
+        copy.deepcopy(pools), its,
+        existing_nodes=copy.deepcopy(list(existing)),
+        max_slots=max_slots, devices=devices, solver_mode=solver_mode,
+        kernel_backend="pallas",
+    )
+    rp = p.solve(copy.deepcopy(pods))
+    return _wire(rx), _wire(rp), p
+
+
+# ---------------------------------------------------------------------------
+# the headline: byte-identical wire across the full fuzz battery
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(14))
+def test_fuzz_seed_wire_parity(seed):
+    pods, existing, pools, its = fuzz_scenario(seed)
+    before = _rejections()
+    wx, wp, sched = _solve_both(pools, its, pods, existing)
+    assert wp == wx, f"pallas wire diverged from xla on seed {seed}"
+    # the trust anchor never moved: both backends' results verified clean
+    assert _rejections() == before, (
+        "verifier rejection counter moved during the parity battery"
+    )
+    # the phase stats carry which backend answered the scan dispatches
+    assert sched.last_phase_stats["kernel_backend"] == "pallas"
+
+
+def test_topology_wire_parity():
+    """Zone + hostname spread exercises the device-topology fetch planes
+    (valmask/defines/zcount ride the post-scan window) on both backends."""
+    pools = [make_nodepool()]
+    its = {"default": build_catalog()[:16]}
+    pods = []
+    for i in range(24):
+        if i % 3 == 0:
+            pods.append(make_pod(cpu=0.25, name=f"t{i}",
+                                 spread_hostname=True, labels={"app": "t"}))
+        elif i % 3 == 1:
+            pods.append(make_pod(cpu=0.5, name=f"t{i}", spread_zone=True))
+        else:
+            pods.append(make_pod(cpu=0.25 * (1 + i % 4), name=f"t{i}"))
+    wx, wp, _ = _solve_both(pools, its, pods, max_slots=64)
+    assert wp == wx
+
+
+def test_gang_preempt_wire_parity():
+    """Gang atomicity + the preemption pass (both stay on XLA kernels)
+    over a pallas-answered FFD scan: the composed wire must not move."""
+    pools = [make_nodepool()]
+    its = {"default": small_catalog()}
+    # fresh nodes top out at 2 cpu: the critical pod can only land through
+    # preemption on the existing node's evictable population, while the
+    # gang places atomically on fresh nodes — both passes in one solve
+    existing = [full_node()]
+    crit = make_pod(cpu=8.0, memory_gib=1.0, name="critical")
+    crit.priority = SYSTEM_CLUSTER_CRITICAL
+    pods = [crit] + [
+        gang_pod(f"g{i}", "job-g", cpu=1.0) for i in range(4)
+    ] + [make_pod(cpu=1.0, name=f"f{i}") for i in range(4)]
+    before = _rejections()
+    wx, wp, _ = _solve_both(pools, its, pods, existing, max_slots=64)
+    assert wp == wx
+    assert _rejections() == before
+
+
+def test_relax_wire_parity():
+    """relax mode's FFD baseline and candidate scans ride the selected
+    kernel backend (the relax_choose assignment dispatch stays XLA);
+    the adopted winner must be identical under both."""
+    pools, its = two_pool_world()
+    pods = [make_pod(cpu=1.0, memory_gib=1.0, name=f"p{i}")
+            for i in range(48)]
+    wx, wp, sched = _solve_both(pools, its, pods, max_slots=256,
+                                solver_mode="relax")
+    assert wp == wx
+    assert sched.last_phase_stats["solver_mode"] == "relax"
+    assert sched.last_phase_stats["kernel_backend"] == "pallas"
+
+
+# ---------------------------------------------------------------------------
+# batched: mixed-backend fleets never share a vmapped dispatch
+# ---------------------------------------------------------------------------
+
+
+def _batch_problem(name, n_pods=20, cpu_step=0.25):
+    pool = make_nodepool(name=name)
+    pods = [
+        make_pod(cpu=cpu_step * (1 + i % 4), memory_gib=0.5 * (1 + i % 3),
+                 name=f"{name}-{i}")
+        for i in range(n_pods)
+    ]
+    return pool, pods
+
+
+def test_mixed_backend_batch_never_coalesces():
+    """Two xla + two pallas problems of identical compile shapes: the
+    shape_key backend component must split them into TWO vmapped
+    dispatches (never one of four), and every member's wire must match
+    its solo twin under its own backend."""
+    specs = [("bxa", "xla"), ("bxb", "xla"), ("bpa", "pallas"),
+             ("bpb", "pallas")]
+    probs = {n: _batch_problem(n) for n, _k in specs}
+    solo = {}
+    for n, kernel in specs:
+        pool, pods = probs[n]
+        sched = DeviceScheduler(
+            [pool], {n: list(build_catalog()[:16])}, max_slots=64,
+            kernel_backend=kernel,
+        )
+        solo[n] = _wire(sched.solve(copy.deepcopy(pods)))
+
+    entries = [
+        (
+            DeviceScheduler(
+                [probs[n][0]], {n: list(build_catalog()[:16])},
+                max_slots=64, kernel_backend=kernel,
+            ),
+            copy.deepcopy(probs[n][1]),
+        )
+        for n, kernel in specs
+    ]
+    outcomes, stats = solve_batch(entries)
+    # one batched dispatch per backend group — the backends split even at
+    # byte-identical tensor shapes
+    assert stats["batched_dispatches"] == 2, stats
+    assert stats["batched_problems"] == 4, stats
+    for (n, _k), (status, res) in zip(specs, outcomes):
+        assert status == "ok", res
+        assert _wire(res) == solo[n]
+    # and the backends agree with EACH OTHER: same-shaped problems under
+    # different names, so the xla pair's wires equal the pallas pair's
+    # modulo the problem name embedded in the claims — checked upstream
+    # by every solo test; here the split itself is the contract
+
+
+# ---------------------------------------------------------------------------
+# multi-device: replicated pallas planes vs the slot-sharded xla mesh
+# ---------------------------------------------------------------------------
+
+
+def test_multidevice_wire_parity():
+    """On the conftest-forced 8-device virtual mesh the pallas path
+    commits its planes replicated (pallas_slot_shardings) while xla
+    shards the slot axis — the wires must still match each other AND the
+    single-device answer (the slot-axis-invariance property)."""
+    pools = [make_nodepool()]
+    its = {"default": build_catalog()[:16]}
+    pods = [
+        make_pod(cpu=0.25 * (1 + i % 4), memory_gib=0.5 * (1 + i % 3),
+                 name=f"m{i}")
+        for i in range(26)
+    ]
+    wx1, wp1, _ = _solve_both(pools, its, pods, max_slots=64, devices=1)
+    wx8, wp8, _ = _solve_both(pools, its, pods, max_slots=64, devices=8)
+    assert wp1 == wx1
+    assert wp8 == wx8
+    assert wp8 == wx1
+
+
+# ---------------------------------------------------------------------------
+# incremental warm-replay: the ledger is backend-blind because the wire is
+# ---------------------------------------------------------------------------
+
+
+def test_incremental_warm_replay_parity():
+    pods, existing, pools, its = fuzz_scenario(3)
+    body = _encode(pools, its, existing, [], pods, max_slots=128)
+    inc = _encode(
+        pools, its, existing, [], pods, max_slots=128,
+        prev_fingerprint=_fp(body),
+    )
+    dx = service.SolverDaemon()
+    outx_full, _ = dx.solve(inc)
+    outx_warm, _ = dx.solve(inc)
+    assert dx.incremental.last["outcome"] == "warm"
+
+    dp = service.SolverDaemon(kernel="pallas")
+    outp_full, _ = dp.solve(inc)
+    assert dp.incremental.last["outcome"] == "full"  # own ledger, cold
+    outp_warm, _ = dp.solve(inc)
+    assert dp.incremental.last["outcome"] == "warm"
+    # warm == fresh within a backend, and both backends agree on the wire
+    assert _strip(outp_warm) == _strip(outp_full)
+    assert _strip(outp_full) == _strip(outx_full)
+    assert _strip(outx_warm) == _strip(outx_full)
+
+
+# ---------------------------------------------------------------------------
+# the flag surface: reject loudly everywhere, xla default untouched
+# ---------------------------------------------------------------------------
+
+
+class TestKernelFlagSurface:
+    def test_scheduler_rejects_unknown_kernel(self):
+        with pytest.raises(ValueError, match="kernel"):
+            DeviceScheduler(
+                [make_nodepool()], {"default": build_catalog()[:4]},
+                kernel_backend="mosaic",
+            )
+
+    def test_scheduler_default_is_xla(self):
+        sched = DeviceScheduler(
+            [make_nodepool()], {"default": build_catalog()[:4]}
+        )
+        assert sched.kernel_backend == "xla"
+
+    def test_daemon_rejects_unknown_kernel(self):
+        with pytest.raises(ValueError, match="kernel"):
+            service.SolverDaemon(kernel="cuda")
+
+    def test_daemon_health_reports_kernel(self):
+        assert service.SolverDaemon().health()["kernel"] == "xla"
+        assert (
+            service.SolverDaemon(kernel="pallas").health()["kernel"]
+            == "pallas"
+        )
+
+    def test_options_parse_kernel_flag_and_env(self):
+        from karpenter_core_tpu.operator import Options
+
+        assert Options.parse([], env={}).solver_kernel == "xla"
+        assert (
+            Options.parse(["--kernel", "pallas"], env={}).solver_kernel
+            == "pallas"
+        )
+        assert (
+            Options.parse(
+                [], env={"KARPENTER_SOLVER_KERNEL": "pallas"}
+            ).solver_kernel
+            == "pallas"
+        )
+        # explicit flag beats the env var (the resolution order contract)
+        assert (
+            Options.parse(
+                ["--kernel", "xla"],
+                env={"KARPENTER_SOLVER_KERNEL": "pallas"},
+            ).solver_kernel
+            == "xla"
+        )
+
+    def test_options_parse_rejects_unknown_kernel(self):
+        from karpenter_core_tpu.operator import Options
+
+        with pytest.raises(ValueError, match="kernel"):
+            Options.parse(["--kernel", "mlir"], env={})
+        with pytest.raises(ValueError, match="kernel"):
+            Options.parse([], env={"KARPENTER_SOLVER_KERNEL": "triton"})
+
+    def test_supervisor_argv_carries_non_default_kernel(self):
+        from karpenter_core_tpu.solver.supervisor import default_command
+
+        cmd = default_command(0, kernel="pallas")
+        i = cmd.index("--kernel")
+        assert cmd[i + 1] == "pallas"
+        # the default never rides the argv: a respawned child re-reads
+        # the daemon default instead of a frozen flag
+        assert "--kernel" not in default_command(0)
+        assert "--kernel" not in default_command(0, kernel=None)
+
+    def test_shape_key_splits_on_backend(self):
+        """Two requests identical in every tensor shape but the backend
+        field must never share a vmapped dispatch."""
+        pods, existing, pools, its = fuzz_scenario(0)
+        x = DeviceScheduler(copy.deepcopy(pools), its,
+                            existing_nodes=copy.deepcopy(existing),
+                            max_slots=128)
+        p = DeviceScheduler(copy.deepcopy(pools), its,
+                            existing_nodes=copy.deepcopy(existing),
+                            max_slots=128, kernel_backend="pallas")
+        gx = x._solve_gen(copy.deepcopy(pods))
+        gp = p._solve_gen(copy.deepcopy(pods))
+        rx = next(gx)
+        rp = next(gp)
+        try:
+            assert rx.backend == "xla" and rp.backend == "pallas"
+            kx, kp = rx.shape_key(), rp.shape_key()
+            assert kx != kp
+            # and ONLY the backend component differs — the tensors bucket
+            # identically, so coalescing would have merged them but for it
+            assert [a for a, b in zip(kx, kp) if a != b] == ["xla"]
+        finally:
+            gx.close()
+            gp.close()

@@ -66,6 +66,9 @@ _MESH_SHARDERS = {
     "batched_slot_shardings", "batched_step_shardings",
     "gang_plane_shardings", "batched_gang_plane_shardings",
     "relax_plane_shardings",
+    # the pallas fused kernels' placement route (ISSUE 18): whole-plane
+    # replication ahead of the GSPMD-opaque pallas_call boundary
+    "pallas_slot_shardings",
 }
 _MESH_REPLICATORS = {"replicated"}
 

@@ -54,12 +54,21 @@ from tools.graftlint.engine import ParsedFile, Rule, dotted_name, register
 # relaxsolve scorer (ISSUE 13, ops/relax.relax_score) consumes a FINISHED
 # solve's SlotState too — its state must come out of a routed dispatch,
 # never a bare host build (the relax assignment planes themselves carry no
-# slot axis and route through parallel.mesh.relax_plane_shardings).
+# slot axis and route through parallel.mesh.relax_plane_shardings). The
+# pallas_* entries (ISSUE 18, ops/pallas_ffd.py) are the hand-fused twins
+# of the four ffd_solve* kernels: same SlotState contract, but the
+# pallas_call boundary is opaque to GSPMD, so multi-device dispatches
+# route through parallel.mesh.pallas_slot_shardings (replicated planes)
+# rather than the slot-axis specs.
 SLOTSTATE_JIT_ENTRIES = {
     "ffd_solve",
     "ffd_solve_donated",
     "ffd_solve_batched",
     "ffd_solve_batched_donated",
+    "pallas_ffd_solve",
+    "pallas_ffd_solve_donated",
+    "pallas_ffd_solve_batched",
+    "pallas_ffd_solve_batched_donated",
     "_prefix_scan",
     "gang_solve",
     "gang_solve_donated",
