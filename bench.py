@@ -2942,7 +2942,7 @@ def _lint_report():
     family_names = {
         "GL1xx": "jaxpurity", "GL2xx": "determinism", "GL3xx": "concurrency",
         "GL4xx": "parity", "GL5xx": "shardcheck", "GL6xx": "rangecheck",
-        "GL000": "suppression-hygiene",
+        "GL7xx": "lockgraph", "GL000": "suppression-hygiene",
     }
     family_seconds: dict = {}
     for rid, dt in result.rule_seconds.items():

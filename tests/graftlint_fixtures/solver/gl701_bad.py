@@ -1,0 +1,38 @@
+"""GL701 bad: the gateway/coalescer ABBA seam. The coalescer admits a
+ticket under ITS lock and kicks the gateway (which takes the gateway
+lock inside ``grant``), while the gateway retunes under ITS lock and
+flushes the coalescer (which takes the coalescer lock inside ``flush``)
+— two threads, opposite orders, classic deadlock. The cycle only exists
+interprocedurally: no single function nests both ``with`` blocks."""
+import threading
+
+
+class TicketCoalescer:
+    def __init__(self, gateway=None):
+        self._lock = threading.RLock()
+        self.waiters = {}
+        self.gateway = gateway if gateway is not None else FleetGatewayStub()
+
+    def admit(self, key, ticket):
+        with self._lock:
+            self.waiters[key] = ticket
+            self.gateway.grant(key)  # TicketCoalescer._lock -> gateway lock
+
+    def flush(self, key):
+        with self._lock:
+            self.waiters.pop(key, None)
+
+
+class FleetGatewayStub:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.granted = {}
+        self.coalescer = TicketCoalescer()
+
+    def grant(self, key):
+        with self._lock:
+            self.granted[key] = True
+
+    def retune(self, key):
+        with self._lock:
+            self.coalescer.flush(key)  # gateway lock -> TicketCoalescer._lock

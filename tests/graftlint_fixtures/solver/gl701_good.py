@@ -1,0 +1,39 @@
+"""GL701 good: the same gateway/coalescer seam with the cross-object
+calls hoisted OUT of the critical sections — each lock is released
+before the peer's lock is taken, so the acquired-while-held graph has no
+edges between the two and stays acyclic."""
+import threading
+
+
+class TicketCoalescer:
+    def __init__(self, gateway=None):
+        self._lock = threading.RLock()
+        self.waiters = {}
+        self.gateway = gateway if gateway is not None else FleetGatewayStub()
+
+    def admit(self, key, ticket):
+        with self._lock:
+            self.waiters[key] = ticket
+        # lock released: the gateway kick happens order-free
+        self.gateway.grant(key)
+
+    def flush(self, key):
+        with self._lock:
+            self.waiters.pop(key, None)
+
+
+class FleetGatewayStub:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.granted = {}
+        self.coalescer = TicketCoalescer()
+
+    def grant(self, key):
+        with self._lock:
+            self.granted[key] = True
+
+    def retune(self, key):
+        with self._lock:
+            stale = [k for k in self.granted if self.granted[k]]
+        for k in stale:
+            self.coalescer.flush(k)

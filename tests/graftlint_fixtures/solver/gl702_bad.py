@@ -1,0 +1,35 @@
+"""GL702 bad: the PR 5 daemon-cache shape. Every other write to the
+solve counter holds ``_state_lock`` (the strict-majority inference), but
+the handler-thread hot path bumps it bare — two handler threads read the
+same old value and the lost update undercounts solves, exactly the class
+of bug the PR 5 truthiness fix was adjacent to. The cache writes go
+through a ``_record`` helper whose lock the old per-file lexical check
+could not see; the held-set propagation can."""
+import threading
+
+
+class SolverDaemonStub:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self.solves = 0
+        self.plan_cache = {}
+
+    def handle(self, key, plan):
+        self._record(key, plan)
+        self.solves += 1  # bare RMW on a handler thread: lost update
+
+    def _record(self, key, plan):
+        with self._state_lock:
+            self.plan_cache[key] = plan
+
+    def reset(self):
+        with self._state_lock:
+            self.solves = 0
+            self.plan_cache = {}
+
+    def flush_stats(self):
+        with self._state_lock:
+            self.solves = 0
+
+    def serve(self):
+        threading.Thread(target=self.handle, daemon=True).start()

@@ -1,8 +1,9 @@
-"""GL302 bad, autoscaler shape: a control-loop class (streak counters,
-cooldown stamps, an owning _state_lock) whose step path bumps the shared
-hysteresis streaks OUTSIDE the lock — the exact class shape
-solver/autoscale.py ships, with the discipline broken. A poller thread
-and an HTTP handler thread stepping concurrently lose streak updates and
+"""GL702 bad, autoscaler shape (migrated from the retired GL302): a
+control-loop class whose step path bumps the shared hysteresis streaks
+OUTSIDE the owning ``_state_lock`` — the exact class shape
+solver/autoscale.py ships, with the discipline broken. The majority of
+each streak's write sites hold the lock (that IS the inferred guard);
+the two bare read-modify-writes on the poller thread lose updates and
 the tier double-scales."""
 import threading
 
@@ -28,6 +29,11 @@ class TierAutoscaler:
             self._down_streak = self._down_streak + 1  # same lost update
         with self._state_lock:
             self._last_scale_at = now
+
+    def reset(self):
+        with self._state_lock:
+            self._up_streak = 0
+            self._down_streak = 0
 
     def start(self, interval):
         threading.Thread(

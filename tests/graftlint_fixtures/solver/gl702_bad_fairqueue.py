@@ -1,0 +1,44 @@
+"""GL702 bad, fair-queue shape (migrated from the retired GL302): a
+gateway class (per-tenant queues, a virtual clock, an admission counter)
+whose handler-thread entry points bump shared counters OUTSIDE the
+owning lock — the exact class shape solver/fleet.py ships, with the
+discipline broken. The locked majority of each counter's write sites
+pins the inferred guard; the bare sites are the findings."""
+import threading
+from collections import deque
+
+
+class FairQueueGateway:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._vclock = 0.0
+        self._queued = {}
+
+    def submit(self, tenant):
+        with self._lock:
+            self._queued.setdefault(tenant, deque()).append(object())
+        self._pending += 1  # two handler threads read the same old value
+
+    def release(self, tenant, seconds):
+        with self._lock:
+            self._queued[tenant].popleft()
+            self._pending -= 1
+        self._vclock = self._vclock + seconds  # same lost-update shape
+
+    def reset_epoch(self):
+        with self._lock:
+            self._pending = 0
+            self._vclock = 0.0
+
+    def credit(self, seconds):
+        with self._lock:
+            self._vclock = self._vclock + seconds
+
+    def serve(self, tenant):
+        threading.Thread(
+            target=self.submit, args=(tenant,), daemon=True
+        ).start()
+        threading.Thread(
+            target=self.release, args=(tenant, 0.0), daemon=True
+        ).start()

@@ -1,0 +1,33 @@
+"""GL702 good: the daemon-cache shape with one discipline — every write
+to the counter and the cache holds ``_state_lock``, including the hot
+path (whose lock arrives through the ``_record`` helper: the
+interprocedural held set proves it, where the old lexical check saw a
+bare call)."""
+import threading
+
+
+class SolverDaemonStub:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self.solves = 0
+        self.plan_cache = {}
+
+    def handle(self, key, plan):
+        self._record(key, plan)
+
+    def _record(self, key, plan):
+        with self._state_lock:
+            self.plan_cache[key] = plan
+            self.solves += 1
+
+    def reset(self):
+        with self._state_lock:
+            self.solves = 0
+            self.plan_cache = {}
+
+    def flush_stats(self):
+        with self._state_lock:
+            self.solves = 0
+
+    def serve(self):
+        threading.Thread(target=self.handle, daemon=True).start()

@@ -1,7 +1,8 @@
-"""GL302 good, autoscaler shape: every read-modify-write on the control
-loop's shared hysteresis state (streaks, cooldown stamps) holds the owning
-_state_lock — the discipline solver/autoscale.py's TierAutoscaler ships,
-where the whole decide body sits inside one locked region."""
+"""GL702 good, autoscaler shape: every read-modify-write on the control
+loop's shared hysteresis state (streaks, cooldown stamps) holds the
+owning ``_state_lock`` — the discipline solver/autoscale.py's
+TierAutoscaler ships, where the whole decide body sits inside one locked
+region."""
 import threading
 
 
@@ -24,6 +25,11 @@ class TierAutoscaler:
                 self._up_streak = 0
                 self._down_streak = self._down_streak + 1
             self._last_scale_at = now
+
+    def reset(self):
+        with self._state_lock:
+            self._up_streak = 0
+            self._down_streak = 0
 
     def start(self, interval):
         threading.Thread(

@@ -1,6 +1,7 @@
-"""GL302 good, fair-queue shape: every read-modify-write on the gateway's
-shared state (admission counter, virtual clock, tenant queues) holds the
-owning lock — the discipline solver/fleet.py's FleetGateway ships."""
+"""GL702 good, fair-queue shape: every read-modify-write on the
+gateway's shared state (admission counter, virtual clock, tenant queues)
+holds the owning lock — the discipline solver/fleet.py's FleetGateway
+ships."""
 import threading
 from collections import deque
 
@@ -20,10 +21,22 @@ class FairQueueGateway:
     def release(self, tenant, seconds):
         with self._lock:
             self._queued[tenant].popleft()
-            self._vclock = self._vclock + seconds
             self._pending -= 1
+            self._vclock = self._vclock + seconds
+
+    def reset_epoch(self):
+        with self._lock:
+            self._pending = 0
+            self._vclock = 0.0
+
+    def credit(self, seconds):
+        with self._lock:
+            self._vclock = self._vclock + seconds
 
     def serve(self, tenant):
         threading.Thread(
             target=self.submit, args=(tenant,), daemon=True
+        ).start()
+        threading.Thread(
+            target=self.release, args=(tenant, 0.0), daemon=True
         ).start()

@@ -1,0 +1,38 @@
+"""GL703 bad: the live guarded container escapes the lock. The member
+registry's dict is guarded by ``_lock`` at every write site, but the
+export path hands the LIVE dict to a publisher thread and the handoff
+path aliases it onto a ticket another thread drains — the receiver
+iterates/mutates it with no lock while the owner keeps writing
+(RuntimeError: dictionary changed size during iteration, or worse,
+silently torn reads)."""
+import threading
+
+
+class Ticket:
+    def __init__(self):
+        self.view = None
+        self.done = threading.Event()
+
+
+class MemberRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.members = {}
+
+    def add(self, name, meta):
+        with self._lock:
+            self.members[name] = meta
+
+    def drop(self, name):
+        with self._lock:
+            self.members.pop(name, None)
+
+    def export(self, publish):
+        threading.Thread(
+            target=publish, args=(self.members,), daemon=True
+        ).start()  # the live dict crosses the thread boundary
+
+    def hand_off(self, ticket):
+        with self._lock:
+            ticket.view = self.members  # aliases the guarded dict
+        ticket.done.set()

@@ -1,0 +1,37 @@
+"""GL703 good: only SNAPSHOTS cross the thread boundary. The export and
+handoff paths copy the guarded dict under the lock and pass the copy —
+the receiver owns its snapshot outright and the registry's live dict
+never aliases outside the guard."""
+import threading
+
+
+class Ticket:
+    def __init__(self):
+        self.view = None
+        self.done = threading.Event()
+
+
+class MemberRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.members = {}
+
+    def add(self, name, meta):
+        with self._lock:
+            self.members[name] = meta
+
+    def drop(self, name):
+        with self._lock:
+            self.members.pop(name, None)
+
+    def export(self, publish):
+        with self._lock:
+            snapshot = dict(self.members)
+        threading.Thread(
+            target=publish, args=(snapshot,), daemon=True
+        ).start()
+
+    def hand_off(self, ticket):
+        with self._lock:
+            ticket.view = dict(self.members)
+        ticket.done.set()

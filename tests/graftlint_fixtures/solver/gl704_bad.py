@@ -1,0 +1,33 @@
+"""GL704 bad: three broken wait shapes on one queue. (1) ``wait`` under
+an ``if`` instead of a ``while`` — a spurious wakeup or a stolen notify
+returns with the queue still empty and ``pop`` raises; (2) ``notify_all``
+outside the owning lock — the waiter can read the predicate, decide to
+sleep, and miss the notify in the gap; (3) a timed ``Event.wait`` whose
+result is discarded — a timeout is indistinguishable from the flag being
+set, so the caller proceeds on failure."""
+import threading
+
+
+class WorkQueue:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = threading.Event()
+        self.items = []
+
+    def put(self, item):
+        with self._cv:
+            self.items.append(item)
+            self._cv.notify()
+
+    def get(self):
+        with self._cv:
+            if not self.items:
+                self._cv.wait()  # spurious wakeup -> pop on empty
+            return self.items.pop(0)
+
+    def kick(self):
+        self._cv.notify_all()  # no lock: the notify can be lost
+
+    def poll(self):
+        self._ready.wait(timeout=1.0)  # timeout looks like success
+        return self.items

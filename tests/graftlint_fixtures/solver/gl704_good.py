@@ -1,0 +1,31 @@
+"""GL704 good: the same queue with the wait discipline intact — the
+predicate re-check loop around ``wait``, the notify inside the owning
+lock, and the timed wait's result branched on."""
+import threading
+
+
+class WorkQueue:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = threading.Event()
+        self.items = []
+
+    def put(self, item):
+        with self._cv:
+            self.items.append(item)
+            self._cv.notify()
+
+    def get(self):
+        with self._cv:
+            while not self.items:
+                self._cv.wait()
+            return self.items.pop(0)
+
+    def kick(self):
+        with self._cv:
+            self._cv.notify_all()
+
+    def poll(self):
+        if not self._ready.wait(timeout=1.0):
+            raise TimeoutError("queue never became ready")
+        return self.items

@@ -1,0 +1,20 @@
+"""GL705 bad: blocking work inside the critical section — a pacing sleep
+and a journal write both sit lexically under the lock, so every thread
+queued on it waits out the sleep plus the disk tail (disk-full, NFS
+stall) before touching the rows."""
+import threading
+import time
+
+
+class StrikeJournal:
+    def __init__(self, path):
+        self.path = path
+        self._lock = threading.Lock()
+        self.rows = []
+
+    def record(self, row):
+        with self._lock:
+            self.rows.append(row)
+            time.sleep(0.05)  # pacing delay charged to every waiter
+            with open(self.path, "w") as f:
+                f.write("\n".join(self.rows))

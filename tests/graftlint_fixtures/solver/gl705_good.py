@@ -1,0 +1,20 @@
+"""GL705 good: the critical section only touches memory — the rows are
+snapshotted under the lock, then the pacing sleep and the journal write
+run with the lock released, so waiters pay memory-speed costs only."""
+import threading
+import time
+
+
+class StrikeJournal:
+    def __init__(self, path):
+        self.path = path
+        self._lock = threading.Lock()
+        self.rows = []
+
+    def record(self, row):
+        with self._lock:
+            self.rows.append(row)
+            snapshot = list(self.rows)
+        time.sleep(0.05)
+        with open(self.path, "w") as f:
+            f.write("\n".join(snapshot))

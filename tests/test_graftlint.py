@@ -37,7 +37,7 @@ def _fixture_pairs():
             FIXTURES.rglob(f"{rule.lower()}_good*.py")
         )
         assert good_matches, f"no good fixture for {rule}"
-        # a rule may ship several bad/good pairs (e.g. the GL302 base pair
+        # a rule may ship several bad/good pairs (e.g. the GL702 base pair
         # plus the fair-queue-shaped pair): prefer the good twin with the
         # matching suffix so every good fixture is actually exercised
         twin = bad.with_name(bad.name.replace("_bad", "_good"))
@@ -68,20 +68,26 @@ def test_tree_is_clean():
 
 
 def test_rule_inventory():
-    """At least 18 rules across the six invariant families."""
+    """At least 24 rules across the seven invariant families."""
     run([str(FIXTURES / "gl000_good.py")])  # force registration
-    ids = set(RULES)
-    assert len(ids) >= 18, f"only {len(ids)} rules registered: {sorted(ids)}"
+    # GL000 runs engine-side (suppression hygiene), outside the registry —
+    # the CLI's rule count includes it, and so does this pin
+    ids = set(RULES) | {"GL000"}
+    assert len(ids) >= 24, f"only {len(ids)} rules registered: {sorted(ids)}"
     families = {rid[:3] for rid in ids if rid != "GL000"}
-    assert {"GL1", "GL2", "GL3", "GL4", "GL5", "GL6"} <= families, (
+    assert {"GL1", "GL2", "GL3", "GL4", "GL5", "GL6", "GL7"} <= families, (
         "expected jax-purity (GL1xx), determinism (GL2xx), concurrency"
-        " (GL3xx), parity (GL4xx), shardcheck (GL5xx) and rangecheck"
-        f" (GL6xx) families, got {sorted(families)}"
+        " (GL3xx), parity (GL4xx), shardcheck (GL5xx), rangecheck"
+        f" (GL6xx) and lockgraph (GL7xx) families, got {sorted(families)}"
     )
     assert "GL104" not in ids, "GL104 was retired into GL503 (shardcheck)"
+    assert "GL302" not in ids, "GL302 was retired into GL702 (lockgraph)"
+    assert "GL303" not in ids, "GL303 was retired into GL702 (lockgraph)"
     assert {"GL403", "GL501", "GL502", "GL503", "GL504"} <= ids
     # ISSUE 11: the rangecheck family + the I/O-under-grant lint
     assert {"GL304", "GL601", "GL602", "GL603", "GL604"} <= ids
+    # ISSUE 19: the lockgraph family
+    assert {"GL701", "GL702", "GL703", "GL704", "GL705"} <= ids
 
 
 def test_baseline_is_frozen_empty():
@@ -708,6 +714,37 @@ def test_retro_detection_gl304_journal_io_under_grant():
             for f, _ in result.new}
     assert "the exclusive device grant" in held
     assert "_state_lock" in held
+
+
+def test_retro_detection_gl701_gateway_coalescer_abba():
+    """Acceptance pin (ISSUE 19): the two-lock ABBA shape from the
+    gateway/coalescer seam — each object calls into the other under its
+    own lock — fires GL701 with the full cycle in the message."""
+    result = run(
+        [str(FIXTURES / "solver" / "gl701_bad.py")],
+        use_baseline=False,
+        rule_ids=["GL701"],
+    )
+    assert result.new, "the retro ABBA fixture must fire GL701"
+    msg = result.new[0][0].message
+    assert "lock-order cycle" in msg
+    assert "TicketCoalescer._lock" in msg
+    assert "FleetGatewayStub._lock" in msg
+
+
+def test_retro_detection_gl702_daemon_cache_counter():
+    """Acceptance pin (ISSUE 19): the PR 5 truthiness-adjacent
+    daemon-cache shape — a handler-thread counter bump outside the
+    ``_state_lock`` every other write site holds — fires GL702."""
+    result = run(
+        [str(FIXTURES / "solver" / "gl702_bad.py")],
+        use_baseline=False,
+        rule_ids=["GL702"],
+    )
+    assert result.new, "the retro daemon-cache fixture must fire GL702"
+    msg = result.new[0][0].message
+    assert "self.solves" in msg and "_state_lock" in msg
+    assert "spawned thread" in msg
 
 
 def test_rangecheck_clean_on_tree_paths():

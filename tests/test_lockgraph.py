@@ -1,0 +1,512 @@
+"""Unit tests for the third dataflow domain (LockDataflow) and the GL7xx
+lockgraph family mechanics: held-set propagation through helpers,
+cross-object cycle detection, guard-inference majority/tie behavior,
+thread reachability over Thread/HTTP-handler entries, suppression, and
+the project verdict-cache bust on a rule-hash change.
+
+The fixture-pair battery in test_graftlint.py proves each rule fires/
+stays quiet end to end; these tests pin the DOMAIN's answers directly,
+so a refactor cannot keep the rules green by making every query
+vacuously empty.
+"""
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+from tools.graftlint import dataflow, run
+from tools.graftlint.engine import ParsedFile
+
+FIXTURES = Path(__file__).parent / "graftlint_fixtures"
+
+
+def _parse(sources: dict) -> list:
+    """ParsedFiles from {relpath: source} (dedented, synthetic paths)."""
+    return [
+        ParsedFile(Path("/synthetic") / rel, rel, textwrap.dedent(src))
+        for rel, src in sources.items()
+    ]
+
+
+def _locks(sources: dict) -> dataflow.LockDataflow:
+    return dataflow.LockDataflow(_parse(sources))
+
+
+# -- held-set propagation ----------------------------------------------------
+
+
+def test_held_set_propagates_through_locked_helper():
+    """The PackingLedger shape: the public method takes the lock and
+    delegates to a ``_locked`` helper — the helper's write site must
+    carry the caller's lock in its may-held set."""
+    df = _locks({"solver/ledger.py": """\
+        import threading
+
+
+        class Ledger:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.rows = []
+
+            def remember(self, row):
+                with self._lock:
+                    self._append_locked(row)
+
+            def _append_locked(self, row):
+                self.rows.append(row)
+        """})
+    sites = df.write_sites[("Ledger", "rows")]
+    assert len(sites) == 1
+    assert sites[0].held == frozenset({"Ledger._lock"})
+    assert df.inferred_guards["Ledger"]["rows"] == "Ledger._lock"
+
+
+def test_held_set_propagates_two_frames_deep():
+    df = _locks({"solver/deep.py": """\
+        import threading
+
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._put_locked(k, v)
+
+            def _put_locked(self, k, v):
+                self._really_put(k, v)
+
+            def _really_put(self, k, v):
+                self.items[k] = v
+        """})
+    sites = df.write_sites[("Store", "items")]
+    assert sites[0].held == frozenset({"Store._lock"})
+
+
+def test_entry_held_union_over_call_sites():
+    """May-held joins by UNION: a helper called both with and without
+    the lock carries the lock in its (over-approximate) entry set — so
+    GL702 stays silent on it (sound polarity), never noisy."""
+    df = _locks({"solver/union.py": """\
+        import threading
+
+
+        class Mixed:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def locked_path(self):
+                with self._lock:
+                    self._bump()
+
+            def bare_path(self):
+                self._bump()
+
+            def _bump(self):
+                self.n += 1
+        """})
+    sites = df.write_sites[("Mixed", "n")]
+    assert sites[0].held == frozenset({"Mixed._lock"})
+
+
+# -- the order graph and cycles ----------------------------------------------
+
+
+def test_cross_object_cycle_detected():
+    """The gateway/coalescer ABBA seam: the cycle closes only through
+    constructor-typed cross-object calls, never inside one function."""
+    pf_path = FIXTURES / "solver" / "gl701_bad.py"
+    pf = ParsedFile(pf_path, "solver/gl701_bad.py", pf_path.read_text())
+    df = dataflow.LockDataflow([pf])
+    assert df.cycles() == [
+        ["FleetGatewayStub._lock", "TicketCoalescer._lock"]
+    ]
+    vias = {
+        via
+        for (src, dst), wits in df.order_edges.items()
+        for (_rel, _line, via) in wits
+    }
+    assert "nested" in vias
+
+
+def test_hoisted_calls_leave_graph_acyclic():
+    pf_path = FIXTURES / "solver" / "gl701_good.py"
+    pf = ParsedFile(pf_path, "solver/gl701_good.py", pf_path.read_text())
+    df = dataflow.LockDataflow([pf])
+    assert df.cycles() == []
+
+
+def test_nonreentrant_self_reacquire_is_self_deadlock():
+    df = _locks({"solver/reacquire.py": """\
+        import threading
+
+
+        class Wedge:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    self.n += 1
+        """})
+    assert any(
+        lid == "Wedge._lock" and "re-acquired" in reason
+        for lid, _rel, _line, reason in df.self_deadlocks
+    )
+
+
+def test_rlock_self_reacquire_is_fine():
+    """The SegmentStore/_locked-helper idiom: RLock re-entry is the
+    designed discipline, not a deadlock."""
+    df = _locks({"solver/reentrant.py": """\
+        import threading
+
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self.n = 0
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    self.n += 1
+        """})
+    assert df.self_deadlocks == []
+    assert df.cycles() == []
+
+
+def test_join_while_holding_needed_lock_is_self_deadlock():
+    """stop() joins the poll thread while holding the lock the poll
+    body needs — the join can never return."""
+    df = _locks({"solver/joiner.py": """\
+        import threading
+
+
+        class Poller:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.ticks = 0
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True
+                )
+
+            def _loop(self):
+                with self._lock:
+                    self.ticks += 1
+
+            def stop(self):
+                with self._lock:
+                    self._thread.join()
+        """})
+    assert any(
+        lid == "Poller._lock" and "joins a thread" in reason
+        for lid, _rel, _line, reason in df.self_deadlocks
+    )
+
+
+def test_wait_for_event_whose_setter_needs_held_lock():
+    df = _locks({"solver/waiter.py": """\
+        import threading
+
+
+        class Handoff:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._done = threading.Event()
+                self.result = None
+
+            def consume(self):
+                with self._lock:
+                    self._done.wait()
+
+            def produce(self, value):
+                with self._lock:
+                    self.result = value
+                    self._done.set()
+        """})
+    assert any(
+        lid == "Handoff._lock" and "waker needs" in reason
+        for lid, _rel, _line, reason in df.self_deadlocks
+    )
+
+
+# -- guard inference ---------------------------------------------------------
+
+
+def test_guard_inference_majority_and_tie():
+    df = _locks({"solver/guards.py": """\
+        import threading
+
+
+        class Majority:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.hits = 0
+
+            def a(self):
+                with self._lock:
+                    self.hits += 1
+
+            def b(self):
+                with self._lock:
+                    self.hits = 0
+
+            def c(self):
+                self.hits -= 1
+
+
+        class Tie:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.hits = 0
+
+            def a(self):
+                with self._lock:
+                    self.hits += 1
+
+            def b(self):
+                self.hits = 0
+        """})
+    # 2-of-3 locked: the lock IS the inferred guard
+    assert df.inferred_guards["Majority"]["hits"] == "Majority._lock"
+    # 1-of-2: no strict majority, no inference — GL702 stays silent
+    assert "hits" not in df.inferred_guards.get("Tie", {})
+
+
+def test_guard_inference_two_lock_tie_infers_nothing():
+    df = _locks({"solver/twolocks.py": """\
+        import threading
+
+
+        class Split:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self.n = 0
+
+            def via_a(self):
+                with self._a:
+                    self.n += 1
+
+            def via_b(self):
+                with self._b:
+                    self.n += 1
+        """})
+    assert "n" not in df.inferred_guards.get("Split", {})
+
+
+def test_same_lock_attr_name_does_not_merge_across_classes():
+    """Both classes name their lock ``_lock``; identity is (class, attr)
+    so neither an order edge nor a guard crosses between them."""
+    df = _locks({"solver/two_classes.py": """\
+        import threading
+
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def add(self, x):
+                with self._lock:
+                    self.items.append(x)
+
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def add(self, x):
+                with self._lock:
+                    self.items.append(x)
+        """})
+    assert df.inferred_guards["A"]["items"] == "A._lock"
+    assert df.inferred_guards["B"]["items"] == "B._lock"
+    assert df.order_edges == {}
+
+
+# -- thread reachability -----------------------------------------------------
+
+
+def test_thread_target_and_callees_reachable():
+    files = _parse({"solver/reach.py": """\
+        import threading
+
+
+        class Daemon:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def serve(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                self._tick_once()
+
+            def _tick_once(self):
+                self.n += 1
+
+            def offline_report(self):
+                return self.n
+        """})
+    df = dataflow.LockDataflow(files)
+    pf = files[0]
+    by_name = {
+        fn.name: fn
+        for fn in pf.walk(__import__("ast").FunctionDef)
+    }
+    assert df.thread_reachable(pf, by_name["_loop"])
+    assert df.thread_reachable(pf, by_name["_tick_once"])
+    assert not df.thread_reachable(pf, by_name["offline_report"])
+    assert not df.thread_reachable(pf, by_name["serve"])
+
+
+def test_http_handler_entry_reaches_daemon_via_loose_tail():
+    """The solverd seam: the handler reaches the daemon through
+    ``self.server.daemon.solve_once()`` — an attribute chain precise
+    resolution cannot type, caught by the stoplisted name-tail
+    fallback."""
+    files = _parse({"solver/httpd.py": """\
+        from http.server import BaseHTTPRequestHandler
+
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.server.daemon.solve_once()
+
+
+        class Daemon:
+            def __init__(self):
+                self.n = 0
+
+            def solve_once(self):
+                self.n += 1
+        """})
+    df = dataflow.LockDataflow(files)
+    pf = files[0]
+    import ast as _ast
+
+    by_name = {fn.name: fn for fn in pf.walk(_ast.FunctionDef)}
+    assert df.thread_reachable(pf, by_name["do_POST"])
+    assert df.thread_reachable(pf, by_name["solve_once"])
+
+
+# -- rule mechanics ----------------------------------------------------------
+
+
+def test_gl702_suppression_with_justification(tmp_path):
+    d = tmp_path / "graftlint_fixtures"
+    d.mkdir()
+    src = (FIXTURES / "solver" / "gl702_bad.py").read_text()
+    src = src.replace(
+        "self.solves += 1  # bare RMW on a handler thread: lost update",
+        "# graftlint: disable=GL702 -- deliberate lock-free fast path:\n"
+        "        # the counter is advisory and drift is acceptable here\n"
+        "        self.solves += 1",
+    )
+    f = d / "gl702_suppressed.py"
+    f.write_text(src)
+    result = run([str(f)], use_baseline=False, rule_ids=["GL702"])
+    assert not result.new
+    assert len(result.suppressed) == 1
+
+
+def test_gl704_subprocess_timed_wait_not_flagged(tmp_path):
+    """``proc.wait(timeout=...)`` is a subprocess wait, not an Event —
+    GL704's timed-wait check keys on known Event/Condition attrs and
+    must stay silent (the supervisor leans on this shape)."""
+    d = tmp_path / "graftlint_fixtures"
+    d.mkdir()
+    (d / "procwait.py").write_text(textwrap.dedent("""\
+        import subprocess
+
+
+        class Super:
+            def __init__(self):
+                self.proc = subprocess.Popen(["sleep", "1"])
+
+            def reap(self):
+                self.proc.wait(timeout=10)
+        """))
+    result = run([str(d)], use_baseline=False, rule_ids=["GL704"])
+    assert not result.new
+
+
+def test_gl701_message_names_the_cycle():
+    result = run(
+        [str(FIXTURES / "solver" / "gl701_bad.py")],
+        use_baseline=False,
+        rule_ids=["GL701"],
+    )
+    assert result.new
+    for f, _src in result.new:
+        assert " -> " in f.message
+
+
+def test_solver_tier_clean_under_lockgraph():
+    """The tentpole sweep, pinned: the whole solver tier satisfies
+    GL701–GL705 (the one deliberate exception carries its inline
+    justification and lands in suppressed, not new)."""
+    result = run(
+        ["karpenter_core_tpu/solver", "karpenter_core_tpu/utils"],
+        use_baseline=False,
+        rule_ids=["GL701", "GL702", "GL703", "GL704", "GL705"],
+    )
+    assert result.ok, "\n".join(f.render() for f, _ in result.new)
+
+
+def test_lock_domain_queries_survive_reparse():
+    """The domain is content-hash cached across run() calls while every
+    run hands the rules freshly parsed nodes — warm-run queries must
+    answer identically (fids are (relpath, line, name), never id())."""
+    path = str(FIXTURES / "solver" / "gl705_bad.py")
+    cold = run([path], use_baseline=False, rule_ids=["GL705"])
+    warm = run([path], use_baseline=False, rule_ids=["GL705"])
+    assert [(f, s) for f, s in warm.new] == [(f, s) for f, s in cold.new]
+    assert len(cold.new) == 2
+
+
+def test_project_verdict_cache_busts_on_rule_hash_change(tmp_path):
+    """GL7xx findings ride the project verdict cache: a warm run
+    reproduces them without re-running, and a rule-implementation change
+    (hash flip) re-computes rather than serving stale verdicts."""
+    import tools.graftlint.engine as engine
+
+    cache = tmp_path / "cache.json"
+    target = str(FIXTURES / "solver")
+    cold = run([target], use_baseline=False, cache_path=cache)
+    assert any(f.rule.startswith("GL7") for f, _ in cold.new)
+    data = json.loads(cache.read_text())
+    assert "__project__" in data
+
+    warm = run([target], use_baseline=False, cache_path=cache)
+    assert warm.cache_hits == warm.files
+    assert [(f, s) for f, s in warm.new] == [(f, s) for f, s in cold.new]
+
+    old = engine._rules_hash
+    engine._RULES_HASH = None
+    try:
+        engine._rules_hash = lambda: "lockgraph-changed"
+        busted = run([target], use_baseline=False, cache_path=cache)
+        assert busted.cache_hits == 0
+        assert [(f, s) for f, s in busted.new] == [
+            (f, s) for f, s in cold.new
+        ]
+    finally:
+        engine._rules_hash = old
+        engine._RULES_HASH = None

@@ -1222,3 +1222,799 @@ def get_ranges(files: List[ParsedFile]) -> RangeDataflow:
             _RANGE_CACHE.clear()
         _RANGE_CACHE[key] = df
     return df
+
+
+# ===========================================================================
+# Third abstract domain (ISSUE 19): lock identity, may-held sets, thread
+# reachability — the engine under the GL7xx lockgraph family.
+#
+# The provenance lattice answers "WHERE has this array been?", the range
+# domain "WHAT can this integer BE?". The solver tier's concurrency
+# contract needs a third question answered per program point: "WHICH
+# locks may be held HERE, and which thread can get here?" — the inputs to
+# a lock-order graph (deadlock cycles), to guard inference (which lock
+# owns which mutable attribute), and to thread-escape checks.
+#
+# Identity and join discipline:
+#
+# * a LOCK is identified by (owning class, attribute) — "FleetGateway.
+#   _lock" — for ``self._x = threading.Lock()`` attributes, and by
+#   (module relpath, name) for module-level locks. ``self.X`` only ever
+#   resolves against the ENCLOSING class: merging every class's ``_lock``
+#   into one node would invent edges between unrelated objects.
+# * HELD SETS are may-held and join by UNION over call sites. That is the
+#   sound polarity for every consumer: GL701 edges only ADD (a spurious
+#   may-edge needs a full spurious cycle before it reports), and GL702
+#   flags only when the inferred guard is ABSENT from the may-held set —
+#   absent-from-an-over-approximation means definitely never held.
+# * held-set propagation resolves calls PRECISELY only: ``self.meth()``
+#   to the enclosing class (plus textual bases), ``self.attr.meth()``
+#   through constructor-typed attributes (``self.gateway =
+#   FleetGateway()``), and bare names to same-file module defs. Name-tail
+#   fallback is deliberately excluded here — resolving ``t.start()`` into
+#   every ``start`` def would flood entry sets with phantom locks.
+# * THREAD REACHABILITY starts from Thread(target=...) functions and
+#   ``do_*`` methods of HTTP handler classes and closes over the call
+#   graph; here the loose name-tail fallback (stoplisted, candidate-
+#   capped) IS used, because the HTTP handler reaches the daemon through
+#   ``self.server.daemon.solve()`` — an attribute chain precise
+#   resolution cannot type.
+# * GUARD INFERENCE is per (class, attribute): the lock held at a STRICT
+#   MAJORITY of the attribute's write sites. A tie — or no lock reaching
+#   half — infers nothing, and every consumer of a missing inference
+#   stays silent.
+# ===========================================================================
+
+_LOCK_CTOR_KINDS = {
+    "threading.Lock": "Lock", "Lock": "Lock",
+    "threading.RLock": "RLock", "RLock": "RLock",
+    "threading.Condition": "Condition", "Condition": "Condition",
+}
+_EVENT_CTORS = {"threading.Event", "Event"}
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+
+# mutable-container constructors: an attribute initialized to one of
+# these is a SHARED MUTABLE VALUE (GL703's escape subjects); scalars are
+# rebound, never mutated in place
+_MUTABLE_CTORS = {
+    "dict", "list", "set", "OrderedDict", "collections.OrderedDict",
+    "deque", "collections.deque", "defaultdict", "collections.defaultdict",
+}
+
+# in-place mutator method names (the write-site forms beyond = and +=)
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "pop", "remove", "clear", "update",
+    "setdefault", "popitem", "add", "discard", "move_to_end",
+}
+
+_REACH_MAX_CANDIDATES = 4
+# ubiquitous call tails reachability must not resolve through: name-tail
+# resolution would connect ``cache.get`` to every ``get`` def and mark
+# half the project thread-reachable
+_REACH_STOPLIST = frozenset({
+    "get", "put", "set", "add", "pop", "remove", "clear", "update",
+    "append", "extend", "items", "values", "keys", "close", "encode",
+    "decode", "info", "debug", "warning", "error", "exception", "log",
+    "inc", "observe", "wait", "join", "acquire", "release", "next",
+    "copy", "sort", "split", "strip", "read", "write", "open", "format",
+    "render", "render_line", "stats", "len", "min", "max",
+})
+
+
+def _module_stem(relpath: str) -> str:
+    base = relpath.rsplit("/", 1)[-1]
+    return base[:-3] if base.endswith(".py") else base
+
+
+class LockSite:
+    """One attribute write site with its may-held lock set."""
+
+    __slots__ = ("pf", "node", "fn", "held", "kind")
+
+    def __init__(self, pf, node, fn, held, kind):
+        self.pf = pf
+        self.node = node
+        self.fn = fn  # enclosing FunctionDef (None at class/module level)
+        self.held = held  # frozenset of lock ids
+        self.kind = kind  # "assign" | "augassign" | "mutate" | "del"
+
+
+class LockDataflow:
+    """Lock/thread queries over one scanned file set. Use :func:`get_locks`.
+
+    Public surface the GL7xx rules (and the runtime witness test) consume:
+
+    - ``order_edges``: {(held_id, acquired_id): [(relpath, line, via)]} —
+      the directed acquired-while-held graph, ``via`` in
+      {"nested", "wait", "join"};
+    - ``self_deadlocks``: [(lock_id, relpath, line, reason)] — one-edge
+      deadlocks (non-reentrant re-acquire, waiting on an event whose
+      setter needs a lock the waiter holds, joining a thread that
+      acquires one);
+    - ``cycles()``: the strongly-connected components of the order graph
+      with ≥ 2 locks;
+    - ``inferred_guards``: {class: {attr: lock_id}};
+    - ``write_sites``: {(class, attr): [LockSite]};
+    - ``held_at(pf, node)``: may-held lock ids at one AST node;
+    - ``thread_reachable(pf, fn)``: whether a def can run on a spawned
+      thread (Thread targets, HTTP ``do_*`` handlers, and everything the
+      call graph reaches from them);
+    - ``lock_kinds``: {lock_id: "Lock" | "RLock" | "Condition"};
+    - ``class_locks`` / ``event_attrs`` / ``cond_attrs``: the per-class
+      attribute registries.
+    """
+
+    def __init__(self, files: List[ParsedFile]):
+        self.files = files
+        # class name -> set of lock attr names / lock_id -> ctor kind
+        self.class_locks: Dict[str, Set[str]] = {}
+        self.lock_kinds: Dict[str, str] = {}
+        # (class, attr) -> "Event" | "Condition"; plus a name-keyed union
+        # for receiver objects precise typing cannot reach (ticket.event)
+        self.event_attrs: Dict[tuple, str] = {}
+        self.cond_attrs: Dict[tuple, str] = {}
+        self._event_names: Dict[str, str] = {}
+        # (class, attr) -> class name of the constructor-assigned value
+        self.attr_types: Dict[tuple, str] = {}
+        # (class, attr) -> attr holds a mutable container (GL703 subjects)
+        self.mutable_attrs: Set[tuple] = set()
+        # (class, attr) -> thread-target def ids (self._thread = Thread(
+        # target=self._loop)) for join-edge resolution
+        self._thread_attr_targets: Dict[tuple, List[int]] = {}
+        # per-relpath module-level lock names -> lock id
+        self._module_locks: Dict[str, Dict[str, str]] = {}
+
+        # def indexes: stable fid -> (pf, fn, owning class name or None).
+        # fids are (relpath, lineno, name) — NOT id(fn) — because this
+        # index is content-hash cached across run() calls while every run
+        # hands the rules freshly parsed nodes; an id()-keyed lookup would
+        # silently miss on the warm run and every query would lie
+        self.fn_index: Dict[tuple, tuple] = {}
+        self._methods: Dict[tuple, List[int]] = {}
+        self._module_defs: Dict[tuple, List[int]] = {}
+        self._defs_by_tail: Dict[str, List[int]] = {}
+        self._class_bases: Dict[str, List[str]] = {}
+        self._class_defs: Dict[str, List[tuple]] = {}
+
+        self._index(files)
+        # per-fn lexical lock spans: fid -> [(lock_id, lo, hi, node)]
+        self._spans: Dict[int, list] = {
+            fid: self._lock_spans(*self.fn_index[fid][:2])
+            for fid in self.fn_index
+        }
+        self._entry_held: Dict[int, Set[str]] = {
+            fid: set() for fid in self.fn_index
+        }
+        self._acquires: Dict[int, Set[str]] = {}
+        self._propagate()
+        self._reachable: Set[int] = set()
+        self._mark_thread_reachable()
+
+        self.order_edges: Dict[tuple, list] = {}
+        self.self_deadlocks: list = []
+        self._build_order_graph()
+
+        self.write_sites: Dict[tuple, List[LockSite]] = {}
+        self.inferred_guards: Dict[str, Dict[str, str]] = {}
+        self._collect_writes()
+        self._infer_guards()
+
+    # -- indexing ----------------------------------------------------------
+
+    def _index(self, files: List[ParsedFile]) -> None:
+        pending_threads: list = []
+        for pf in files:
+            mod_locks: Dict[str, str] = {}
+            for st in pf.tree.body:
+                if not isinstance(st, ast.Assign):
+                    continue
+                if not isinstance(st.value, ast.Call):
+                    continue
+                kind = _LOCK_CTOR_KINDS.get(dotted_name(st.value.func))
+                if kind is None:
+                    continue
+                for tgt in st.targets:
+                    if isinstance(tgt, ast.Name):
+                        lid = f"{_module_stem(pf.relpath)}.{tgt.id}"
+                        mod_locks[tgt.id] = lid
+                        self.lock_kinds[lid] = kind
+            self._module_locks[pf.relpath] = mod_locks
+
+            for cls in pf.walk(ast.ClassDef):
+                self._class_defs.setdefault(cls.name, []).append((pf, cls))
+                bases = [dotted_name(b) for b in cls.bases]
+                self._class_bases.setdefault(cls.name, []).extend(
+                    b for b in bases if b
+                )
+                for node in ast.walk(cls):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    # mutable literals: self.x = {} / [] / {…} / comps
+                    if isinstance(node.value, (
+                        ast.Dict, ast.List, ast.Set, ast.ListComp,
+                        ast.DictComp, ast.SetComp,
+                    )):
+                        for tgt in node.targets:
+                            attr = _self_attr_of(tgt)
+                            if attr is not None:
+                                self.mutable_attrs.add((cls.name, attr))
+                        continue
+                    for value in _ctor_candidates(node.value):
+                        ctor = dotted_name(value.func)
+                        tail = ctor.rsplit(".", 1)[-1] if ctor else ""
+                        for tgt in node.targets:
+                            attr = _self_attr_of(tgt)
+                            if attr is None:
+                                continue
+                            kind = _LOCK_CTOR_KINDS.get(ctor)
+                            if kind is not None:
+                                self.class_locks.setdefault(
+                                    cls.name, set()
+                                ).add(attr)
+                                self.lock_kinds[f"{cls.name}.{attr}"] = kind
+                                if kind == "Condition":
+                                    self.cond_attrs[(cls.name, attr)] = kind
+                                    self._event_names.setdefault(
+                                        attr, "Condition"
+                                    )
+                                continue
+                            if ctor in _EVENT_CTORS:
+                                self.event_attrs[(cls.name, attr)] = "Event"
+                                self._event_names.setdefault(attr, "Event")
+                                continue
+                            if ctor in _THREAD_CTORS:
+                                # resolved after the def index exists —
+                                # _methods is still empty on this pass
+                                pending_threads.append(
+                                    (cls.name, attr, pf, value)
+                                )
+                                continue
+                            if (ctor in _MUTABLE_CTORS
+                                    or tail in _MUTABLE_CTORS):
+                                self.mutable_attrs.add((cls.name, attr))
+                            if tail[:1].isupper():
+                                # constructor-assigned type, for cross-
+                                # object method resolution
+                                self.attr_types[(cls.name, attr)] = tail
+
+            for fn in pf.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+                cls = pf.enclosing_class(fn)
+                cname = cls.name if cls is not None else None
+                fid = _fn_key(pf, fn)
+                self.fn_index[fid] = (pf, fn, cname)
+                self._defs_by_tail.setdefault(fn.name, []).append(fid)
+                if cname is not None:
+                    self._methods.setdefault(
+                        (cname, fn.name), []
+                    ).append(fid)
+                if pf.enclosing_function(fn) is None and cls is None:
+                    self._module_defs.setdefault(
+                        (pf.relpath, fn.name), []
+                    ).append(fid)
+
+        for cname, attr, pf, call in pending_threads:
+            tgt_ids = self._thread_target_ids(pf, cname, call)
+            if tgt_ids:
+                self._thread_attr_targets[(cname, attr)] = tgt_ids
+
+    def _thread_target_ids(self, pf, cname, call: ast.Call) -> List[int]:
+        """Resolve ``threading.Thread(target=X)``'s X to def ids."""
+        target = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = kw.value
+        if target is None:
+            return []
+        attr = _self_attr_of(target)
+        if attr is not None and cname is not None:
+            return list(self._methods.get((cname, attr), ()))
+        if isinstance(target, ast.Name):
+            out = list(self._module_defs.get((pf.relpath, target.id), ()))
+            if out:
+                return out
+            # a local def in the enclosing function (the autoscaler's
+            # ``loop`` closure): resolved lazily by name within the file
+            return [
+                fid for fid, (fpf, fn, _c) in self.fn_index.items()
+                if fpf.relpath == pf.relpath and fn.name == target.id
+            ]
+        return []
+
+    # -- lexical lock spans ------------------------------------------------
+
+    def _lock_id_of_expr(
+        self, pf, cname: Optional[str], expr: ast.AST
+    ) -> Optional[str]:
+        """Lock id of a context/receiver expression, or None. ``self.X``
+        resolves only against the enclosing class; a bare name against
+        the module's lock table."""
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        attr = _self_attr_of(expr)
+        if attr is not None:
+            if cname and attr in self.class_locks.get(cname, ()):
+                return f"{cname}.{attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            return self._module_locks.get(pf.relpath, {}).get(expr.id)
+        return None
+
+    def _lock_spans(self, pf, fn) -> list:
+        """[(lock_id, lo_line_exclusive, hi_line_inclusive, acquire_node)]
+        for one def: ``with`` blocks plus explicit acquire()/release()
+        call pairs (an unmatched acquire holds to the end of the def)."""
+        cls = pf.enclosing_class(fn)
+        cname = cls.name if cls is not None else None
+        spans = []
+        acquires: Dict[str, list] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith, ast.Call)):
+                # honor nested-def boundaries: a closure's spans are its own
+                if pf.enclosing_function(node) is not fn:
+                    continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lid = self._lock_id_of_expr(pf, cname, item.context_expr)
+                    if lid is not None:
+                        spans.append((
+                            lid, node.lineno,
+                            getattr(node, "end_lineno", node.lineno),
+                            item.context_expr,
+                        ))
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                if node.func.attr == "acquire":
+                    lid = self._lock_id_of_expr(pf, cname, node.func.value)
+                    if lid is not None:
+                        acquires.setdefault(lid, []).append(node)
+                elif node.func.attr == "release":
+                    lid = self._lock_id_of_expr(pf, cname, node.func.value)
+                    if lid is not None:
+                        for pending in acquires.get(lid, ()):
+                            spans.append((
+                                lid, pending.lineno, node.lineno, pending
+                            ))
+                        acquires[lid] = []
+        end = getattr(fn, "end_lineno", fn.lineno)
+        for lid, pendings in acquires.items():
+            for pending in pendings:
+                spans.append((lid, pending.lineno, end, pending))
+        return spans
+
+    def _lexical_held(self, fid: int, lineno: int) -> Set[str]:
+        return {
+            lid for lid, lo, hi, _n in self._spans.get(fid, ())
+            if lo < lineno <= hi
+        }
+
+    # -- call resolution ---------------------------------------------------
+
+    def _bases_chain(self, cname: str, depth: int = 3) -> List[str]:
+        out, frontier = [cname], [cname]
+        for _ in range(depth):
+            nxt = []
+            for c in frontier:
+                for b in self._class_bases.get(c, ()):
+                    tail = b.rsplit(".", 1)[-1]
+                    if tail not in out:
+                        out.append(tail)
+                        nxt.append(tail)
+            frontier = nxt
+        return out
+
+    def _resolve_precise(
+        self, pf, cname: Optional[str], call: ast.Call
+    ) -> List[int]:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name) and base.id == "self" and cname:
+                out: List[int] = []
+                for c in self._bases_chain(cname):
+                    out.extend(self._methods.get((c, func.attr), ()))
+                return out
+            # self.attr.meth(): constructor-typed attribute
+            battr = _self_attr_of(base)
+            if battr is not None and cname is not None:
+                tname = self.attr_types.get((cname, battr))
+                if tname is not None:
+                    return list(self._methods.get((tname, func.attr), ()))
+            return []
+        if isinstance(func, ast.Name):
+            return list(self._module_defs.get((pf.relpath, func.id), ()))
+        return []
+
+    def _resolve_loose(
+        self, pf, cname: Optional[str], call: ast.Call
+    ) -> List[int]:
+        out = self._resolve_precise(pf, cname, call)
+        if out:
+            return out
+        name = dotted_name(call.func)
+        tail = name.rsplit(".", 1)[-1] if name else ""
+        if not tail or tail in _REACH_STOPLIST:
+            return []
+        cands = self._defs_by_tail.get(tail, ())
+        if 0 < len(cands) <= _REACH_MAX_CANDIDATES:
+            return list(cands)
+        return []
+
+    # -- fixpoints ---------------------------------------------------------
+
+    def _propagate(self) -> None:
+        """Two union fixpoints over the precise call graph: may-held sets
+        pushed INTO callees, transitive acquire sets pulled FROM them."""
+        call_edges: Dict[int, list] = {}
+        for fid, (pf, fn, cname) in self.fn_index.items():
+            edges = []
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    callees = self._resolve_precise(pf, cname, node)
+                    if callees:
+                        edges.append((node.lineno, callees))
+            call_edges[fid] = edges
+            self._acquires[fid] = {
+                lid for lid, _lo, _hi, _n in self._spans[fid]
+            }
+        while True:
+            grew = False
+            for fid, edges in call_edges.items():
+                base = self._entry_held[fid]
+                for lineno, callees in edges:
+                    held = base | self._lexical_held(fid, lineno)
+                    for cid in callees:
+                        if cid == fid:
+                            continue
+                        tgt = self._entry_held[cid]
+                        if not held <= tgt:
+                            tgt |= held
+                            grew = True
+                        acq = self._acquires[cid]
+                        if not acq <= self._acquires[fid]:
+                            self._acquires[fid] |= acq
+                            grew = True
+            if not grew:
+                return
+
+    def _mark_thread_reachable(self) -> None:
+        entries: List[int] = []
+        for fid, (pf, fn, cname) in self.fn_index.items():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and dotted_name(
+                    node.func
+                ) in _THREAD_CTORS:
+                    entries.extend(
+                        self._thread_target_ids(pf, cname, node)
+                    )
+            # HTTP handler entries: do_* methods of *RequestHandler classes
+            if cname is not None and fn.name.startswith("do_"):
+                bases = self._class_bases.get(cname, ())
+                if any(b.rsplit(".", 1)[-1].endswith("RequestHandler")
+                       for b in bases):
+                    entries.append(fid)
+        frontier = [fid for fid in entries if fid in self.fn_index]
+        self._reachable = set(frontier)
+        while frontier:
+            fid = frontier.pop()
+            pf, fn, cname = self.fn_index[fid]
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                for cid in self._resolve_loose(pf, cname, node):
+                    if cid not in self._reachable:
+                        self._reachable.add(cid)
+                        frontier.append(cid)
+
+    # -- the lock-order graph ----------------------------------------------
+
+    def _add_edge(self, src, dst, pf, lineno, via) -> None:
+        self.order_edges.setdefault((src, dst), []).append(
+            (pf.relpath, lineno, via)
+        )
+
+    def _event_setter_held(self) -> Dict[str, Set[str]]:
+        """attr name -> union of may-held sets at every ``X.<attr>.set()``
+        / ``X.<attr>.notify*()`` site (the locks a WAITER's waker needs)."""
+        out: Dict[str, Set[str]] = {}
+        for fid, (pf, fn, cname) in self.fn_index.items():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if not isinstance(func, ast.Attribute):
+                    continue
+                if func.attr not in ("set", "notify", "notify_all"):
+                    continue
+                if not isinstance(func.value, ast.Attribute):
+                    continue
+                ename = func.value.attr
+                if ename not in self._event_names:
+                    continue
+                if func.attr == "set" and node.args:
+                    continue  # dict.set(...)-style false friend
+                held = self._entry_held[fid] | self._lexical_held(
+                    fid, node.lineno
+                )
+                out.setdefault(ename, set()).update(held)
+        return out
+
+    def _build_order_graph(self) -> None:
+        setter_held = self._event_setter_held()
+        for fid, (pf, fn, cname) in self.fn_index.items():
+            entry = self._entry_held[fid]
+            # nested acquisitions: with-items and acquire() calls
+            for lid, lo, _hi, node in self._spans[fid]:
+                outer = entry | {
+                    olid for olid, olo, ohi, onode in self._spans[fid]
+                    if onode is not node and olo <= lo <= ohi
+                    and not (olo == lo and onode.col_offset
+                             > getattr(node, "col_offset", 1 << 30))
+                }
+                for held in sorted(outer):
+                    if held == lid:
+                        if self.lock_kinds.get(lid) == "Lock":
+                            self.self_deadlocks.append((
+                                lid, pf.relpath, lo,
+                                f"non-reentrant Lock {lid} re-acquired"
+                                " while already held",
+                            ))
+                        continue
+                    self._add_edge(held, lid, pf, lo, "nested")
+            # wait edges: blocking on an event/condition while holding
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if not isinstance(func, ast.Attribute):
+                    continue
+                held = entry | self._lexical_held(fid, node.lineno)
+                if func.attr in ("wait", "wait_for") and isinstance(
+                    func.value, ast.Attribute
+                ):
+                    ename = func.value.attr
+                    if ename not in self._event_names:
+                        continue
+                    if self._event_names[ename] == "Condition":
+                        # Condition.wait releases its own lock while blocked
+                        own = _self_attr_of(func.value)
+                        if own is not None and cname is not None:
+                            held = held - {f"{cname}.{own}"}
+                    for src in sorted(held):
+                        for dst in sorted(setter_held.get(ename, ())):
+                            if src == dst:
+                                self.self_deadlocks.append((
+                                    src, pf.relpath, node.lineno,
+                                    f"waits on .{ename} while holding"
+                                    f" {src}, which the waker needs",
+                                ))
+                            else:
+                                self._add_edge(
+                                    src, dst, pf, node.lineno, "wait"
+                                )
+                elif func.attr == "join" and held:
+                    for tid in self._join_target_ids(pf, fn, cname, func):
+                        needed = self._acquires.get(tid, set())
+                        for src in sorted(held):
+                            for dst in sorted(needed):
+                                if src == dst:
+                                    self.self_deadlocks.append((
+                                        src, pf.relpath, node.lineno,
+                                        f"joins a thread that acquires"
+                                        f" {src} while holding it",
+                                    ))
+                                else:
+                                    self._add_edge(
+                                        src, dst, pf, node.lineno, "join"
+                                    )
+
+    def _join_target_ids(self, pf, fn, cname, func: ast.Attribute):
+        """Thread-target def ids behind ``<recv>.join()``."""
+        attr = _self_attr_of(func.value)
+        if attr is not None and cname is not None:
+            return self._thread_attr_targets.get((cname, attr), ())
+        if isinstance(func.value, ast.Name):
+            # a local ``t = threading.Thread(target=...)`` in the same def
+            out: List[int] = []
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not isinstance(node.value, ast.Call):
+                    continue
+                if dotted_name(node.value.func) not in _THREAD_CTORS:
+                    continue
+                if any(isinstance(t, ast.Name) and t.id == func.value.id
+                       for t in node.targets):
+                    out.extend(
+                        self._thread_target_ids(pf, cname, node.value)
+                    )
+            return out
+        return ()
+
+    def cycles(self) -> List[List[str]]:
+        """Strongly-connected components of the order graph with ≥ 2
+        locks — each is a deadlock-capable cycle. Iterative Tarjan (the
+        graph is tiny, but recursion depth must not depend on it)."""
+        graph: Dict[str, List[str]] = {}
+        for (src, dst) in self.order_edges:
+            graph.setdefault(src, []).append(dst)
+            graph.setdefault(dst, [])
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        out: List[List[str]] = []
+        counter = [0]
+        for root in sorted(graph):
+            if root in index:
+                continue
+            work = [(root, iter(graph[root]))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                v, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(graph[w])))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[v] = min(low[v], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    pv = work[-1][0]
+                    low[pv] = min(low[pv], low[v])
+                if low[v] == index[v]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == v:
+                            break
+                    if len(scc) > 1:
+                        out.append(sorted(scc))
+        return out
+
+    # -- guard inference ---------------------------------------------------
+
+    def held_at(self, pf, node: ast.AST) -> frozenset:
+        """May-held lock ids at one AST node (entry set of the enclosing
+        def ∪ the lexical spans covering the node's line)."""
+        fn = pf.enclosing_function(node)
+        fid = _fn_key(pf, fn) if fn is not None else None
+        if fid not in self.fn_index:
+            # module/class level: lexical module locks only
+            return frozenset()
+        return frozenset(
+            self._entry_held[fid] | self._lexical_held(fid, node.lineno)
+        )
+
+    def thread_reachable(self, pf, fn) -> bool:
+        return fn is not None and _fn_key(pf, fn) in self._reachable
+
+    def _collect_writes(self) -> None:
+        for pf in self.files:
+            for cls in pf.walk(ast.ClassDef):
+                if cls.name in self.class_locks:
+                    self._collect_class_writes(pf, cls)
+
+    def _collect_class_writes(self, pf, cls: ast.ClassDef) -> None:
+        locks = self.class_locks.get(cls.name, set())
+        skip = locks | {
+            a for (c, a) in self.event_attrs if c == cls.name
+        } | {a for (c, a) in self.cond_attrs if c == cls.name}
+        for node in ast.walk(cls):
+            attr = None
+            kind = None
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for tgt in targets:
+                    a = _self_attr_of(tgt)
+                    if a is None and isinstance(tgt, ast.Subscript):
+                        a = _self_attr_of(tgt.value)
+                    if a is not None:
+                        attr = a
+                        kind = (
+                            "augassign"
+                            if isinstance(node, ast.AugAssign)
+                            else "assign"
+                        )
+            elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ) and node.func.attr in _MUTATING_METHODS:
+                a = _self_attr_of(node.func.value)
+                if a is not None:
+                    attr = a
+                    kind = "mutate"
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        a = _self_attr_of(tgt.value)
+                        if a is not None:
+                            attr = a
+                            kind = "del"
+            if attr is None or attr in skip:
+                continue
+            fn = pf.enclosing_function(node)
+            if fn is None or getattr(fn, "name", "") == "__init__":
+                continue  # construction happens-before publication
+            if pf.enclosing_class(fn) is not cls:
+                continue  # a nested class owns its own discipline
+            held = self.held_at(pf, node)
+            self.write_sites.setdefault((cls.name, attr), []).append(
+                LockSite(pf, node, fn, held, kind)
+            )
+
+    def _infer_guards(self) -> None:
+        for (cname, attr), sites in self.write_sites.items():
+            counts: Dict[str, int] = {}
+            for s in sites:
+                for lid in s.held:
+                    counts[lid] = counts.get(lid, 0) + 1
+            if not counts:
+                continue
+            ranked = sorted(
+                counts.items(), key=lambda kv: (-kv[1], kv[0])
+            )
+            top_id, top_n = ranked[0]
+            if len(ranked) > 1 and ranked[1][1] == top_n:
+                continue  # tie between locks: no inference
+            if top_n * 2 <= len(sites):
+                continue  # no strict majority: no inference
+            self.inferred_guards.setdefault(cname, {})[attr] = top_id
+
+
+def _fn_key(pf, fn) -> tuple:
+    """The cache-stable identity of a def: survives a reparse (same
+    content, new AST objects), unlike ``id(fn)``."""
+    return (pf.relpath, fn.lineno, fn.name)
+
+
+def _self_attr_of(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _ctor_candidates(value: ast.AST):
+    """The Call nodes an assigned value may come from: the value itself,
+    or either arm of the ``x if x is not None else Default()`` idiom the
+    daemon uses for injectable collaborators."""
+    if isinstance(value, ast.Call):
+        yield value
+    elif isinstance(value, ast.IfExp):
+        for arm in (value.body, value.orelse):
+            if isinstance(arm, ast.Call):
+                yield arm
+
+
+_LOCK_CACHE: Dict[str, LockDataflow] = {}
+
+
+def get_locks(files: List[ParsedFile]) -> LockDataflow:
+    """The (content-hash cached) lock-domain index for one scanned set."""
+    key = _content_key(files)
+    df = _LOCK_CACHE.get(key)
+    if df is None:
+        df = LockDataflow(files)
+        if len(_LOCK_CACHE) > 8:
+            _LOCK_CACHE.clear()
+        _LOCK_CACHE[key] = df
+    return df

@@ -8,6 +8,7 @@ from tools.graftlint.rules import (  # noqa: F401
     concurrency,
     determinism,
     jaxpurity,
+    lockgraph,
     parity,
     rangecheck,
     sharding,

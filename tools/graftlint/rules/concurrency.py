@@ -9,10 +9,6 @@ in modules that actually create threads (``threading.Thread`` /
 ``ThreadingHTTPServer``), so single-threaded host code stays noise-free.
 
 GL301 thread-daemon-explicit — every threading.Thread must pass daemon=
-GL302 unlocked-rmw           — read-modify-write on self attributes
-                               outside the owning lock
-GL303 mixed-lock-discipline  — attribute written both under a lock and
-                               bare in the same class
 GL304 blocking-io-under-grant — file/network I/O statically reachable
                                while the FleetGateway device grant or the
                                SolverDaemon ``_state_lock`` is held (the
@@ -20,6 +16,14 @@ GL304 blocking-io-under-grant — file/network I/O statically reachable
                                journal I/O off the exclusive device
                                window, disk-full begin() wedging the
                                gateway)
+
+GL302 (unlocked-rmw) and GL303 (mixed-lock-discipline) retired: subsumed
+by GL702 in the lockgraph family (tools/graftlint/rules/lockgraph.py),
+which infers each attribute's guard from the majority of its write sites'
+interprocedurally-propagated held-lock sets instead of per-file lexical
+``with`` nesting — so a ``_locked`` helper called three frames under the
+lock no longer reads as bare, and a bare write only flags when a spawned
+thread can actually reach it.
 """
 from __future__ import annotations
 
@@ -139,127 +143,6 @@ def _mentions_self_attr(expr: ast.AST, attr: str) -> bool:
         if _self_attr(n) == attr:
             return True
     return False
-
-
-@register
-class UnlockedReadModifyWrite(Rule):
-    id = "GL302"
-    name = "unlocked-rmw"
-    rationale = (
-        "self.x += 1 (or self.x = f(self.x)) outside the owning lock in a"
-        " threaded module is a lost update — two handler threads read the"
-        " same old value"
-    )
-
-    def check(self, pf: ParsedFile):
-        if not _creates_threads(pf):
-            return
-        for cls in pf.walk(ast.ClassDef):
-            locks = _lock_attrs(cls)
-            if not locks:
-                continue
-            for node in ast.walk(cls):
-                target_attr = None
-                if isinstance(node, ast.AugAssign):
-                    target_attr = _self_attr(node.target)
-                elif isinstance(node, ast.Assign) and len(node.targets) == 1:
-                    attr = _self_attr(node.targets[0])
-                    if attr is not None and _mentions_self_attr(node.value, attr):
-                        target_attr = attr
-                if target_attr is None:
-                    continue
-                if _method_of(pf, node) == "__init__":
-                    continue  # construction happens-before publication
-                if _locks_held(pf, node, locks):
-                    # any owning lock counts here; GL303 catches the
-                    # same attribute guarded by DIFFERENT locks
-                    continue
-                yield self.finding(
-                    pf, node,
-                    f"read-modify-write of self.{target_attr} outside"
-                    f" lock(s) {sorted(locks)} in threaded class"
-                    f" {cls.name!r} — lost-update race",
-                )
-
-
-@register
-class MixedLockDiscipline(Rule):
-    id = "GL303"
-    name = "mixed-lock-discipline"
-    rationale = (
-        "an attribute written under the lock in one method and bare (or"
-        " under a DIFFERENT lock) in another has no consistent owner —"
-        " every reader must assume the weaker discipline"
-    )
-
-    def check(self, pf: ParsedFile):
-        if not _creates_threads(pf):
-            return
-        for cls in pf.walk(ast.ClassDef):
-            locks = _lock_attrs(cls)
-            if not locks:
-                continue
-            # attr -> guard signature (frozenset of held locks) -> sites
-            writes: Dict[str, Dict[frozenset, List[ast.AST]]] = {}
-            for node in ast.walk(cls):
-                attr = None
-                if isinstance(node, (ast.Assign, ast.AugAssign)):
-                    targets = (
-                        node.targets
-                        if isinstance(node, ast.Assign)
-                        else [node.target]
-                    )
-                    for tgt in targets:
-                        a = _self_attr(tgt)
-                        if a is None and isinstance(
-                            tgt, ast.Subscript
-                        ):
-                            a = _self_attr(tgt.value)
-                        if a is not None:
-                            attr = a
-                elif isinstance(node, ast.Call) and isinstance(
-                    node.func, ast.Attribute
-                ) and node.func.attr in _MUTATOR_METHODS:
-                    attr = _self_attr(node.func.value)
-                elif isinstance(node, ast.Delete):
-                    for tgt in node.targets:
-                        if isinstance(tgt, ast.Subscript):
-                            a = _self_attr(tgt.value)
-                            if a is not None:
-                                attr = a
-                if attr is None or attr in locks:
-                    continue
-                if _method_of(pf, node) == "__init__":
-                    continue
-                guard = _locks_held(pf, node, locks)
-                writes.setdefault(attr, {}).setdefault(guard, []).append(node)
-            for attr in sorted(writes):
-                guards = writes[attr]
-                if len(guards) < 2:
-                    continue
-                # flag every site not under the dominant guard (most
-                # sites; ties prefer a locked guard over bare)
-                dominant = max(
-                    guards, key=lambda g: (len(guards[g]), len(g))
-                )
-                for guard in sorted(guards, key=sorted):
-                    if guard == dominant:
-                        continue
-                    have = (
-                        f"lock(s) {sorted(guard)}" if guard else "no lock"
-                    )
-                    want = (
-                        f"lock(s) {sorted(dominant)}"
-                        if dominant
-                        else "no lock"
-                    )
-                    for node in guards[guard]:
-                        yield self.finding(
-                            pf, node,
-                            f"self.{attr} is written under {want}"
-                            f" elsewhere in {cls.name!r} but under"
-                            f" {have} here — pick one discipline",
-                        )
 
 
 # ---------------------------------------------------------------------------

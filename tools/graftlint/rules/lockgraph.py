@@ -1,0 +1,386 @@
+"""Family 7 — the interprocedural lock graph over the solver tier.
+
+The GL3xx heuristics see one file and one lexical ``with`` block at a
+time. The solver tier's actual discipline is interprocedural: FleetGateway
+``_locked`` helpers re-enter an RLock three frames below the handler
+thread, the daemon's ``_state_lock`` must never nest under the gateway
+lock (service.set_brownout documents the ordering by hand), and the
+coalescer hands Ticket objects across threads through Event fields. These
+rules ride the third dataflow domain (dataflow.LockDataflow): lock
+identity keyed by (class, attribute), may-held sets propagated through
+the call graph to a fixpoint, thread reachability closed over Thread
+targets and HTTP ``do_*`` handlers, and per-attribute guard inference by
+strict write-site majority.
+
+GL701 lock-order-cycle      — cycles in the acquired-while-held graph
+                              (including cross-object cycles and
+                              wait/join-mediated edges), plus one-edge
+                              deadlocks: re-acquiring a non-reentrant
+                              Lock, waiting on an event whose setter
+                              needs a held lock, joining a thread that
+                              acquires one
+GL702 unguarded-access      — a write/RMW of a guard-inferred attribute
+                              from a thread-reachable method whose
+                              may-held set misses the guard (subsumes
+                              and retires GL302/GL303)
+GL703 thread-escape         — a guarded mutable container escaping to
+                              another thread (Thread args, handoff-field
+                              stores) as the live object, not a snapshot
+GL704 wait-discipline       — Condition.wait outside a predicate re-check
+                              loop, notify outside the owning lock,
+                              timed wait results discarded
+
+Every rule flags on positive evidence only: a lock the may-held
+over-approximation cannot prove absent, a guard inference that ties, or
+a receiver the resolver cannot type all degrade to silence.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.graftlint import dataflow
+from tools.graftlint.engine import ParsedFile, Rule, dotted_name, register
+from tools.graftlint.rules.concurrency import _direct_io_call
+
+
+def _fmt_cycle(cycle: List[str]) -> str:
+    return " -> ".join(cycle + [cycle[0]])
+
+
+# a guarded value wrapped in one of these is a SNAPSHOT, not the live
+# aliased object — the sanctioned way to hand state across threads
+_SNAPSHOT_CALLS = {
+    "dict", "list", "tuple", "set", "frozenset", "sorted",
+    "copy.copy", "copy.deepcopy",
+}
+
+
+def _snapshotted(pf: ParsedFile, sub: ast.AST, expr: ast.AST) -> bool:
+    """True when ``sub`` sits inside a snapshot-constructor call within
+    ``expr`` (``args=(dict(self.members),)`` hands off a copy)."""
+    for p in pf.parents(sub):
+        if isinstance(p, ast.Call) and dotted_name(p.func) in _SNAPSHOT_CALLS:
+            return True
+        if p is expr:
+            break
+    return False
+
+
+@register
+class LockOrderCycle(Rule):
+    id = "GL701"
+    name = "lock-order-cycle"
+    rationale = (
+        "two threads acquiring the same locks in opposite orders deadlock"
+        " the tier; the order graph (acquired-while-held, plus wait/join"
+        " edges: blocking on a thread that needs a lock you hold) must"
+        " stay acyclic — one cycle wedges every handler thread behind it"
+    )
+    scope = "project"
+
+    def check_project(self, files: List[ParsedFile]):
+        df = dataflow.get_locks(files)
+        by_relpath = {pf.relpath: pf for pf in files}
+
+        for lid, relpath, line, reason in df.self_deadlocks:
+            pf = by_relpath.get(relpath)
+            if pf is None:
+                continue
+            yield self.finding(
+                pf, _at(line), f"deadlock: {reason}"
+            )
+
+        in_cycle = {lock for cyc in df.cycles() for lock in cyc}
+        cycle_of = {}
+        for cyc in df.cycles():
+            for lock in cyc:
+                cycle_of[lock] = cyc
+        seen = set()
+        for (src, dst), witnesses in sorted(df.order_edges.items()):
+            if src not in in_cycle or dst not in cycle_of.get(src, ()):
+                continue
+            relpath, line, via = witnesses[0]
+            pf = by_relpath.get(relpath)
+            if pf is None:
+                continue
+            key = (src, dst)
+            if key in seen:
+                continue
+            seen.add(key)
+            cyc = cycle_of[src]
+            yield self.finding(
+                pf, _at(line),
+                f"lock-order cycle {_fmt_cycle(cyc)}: {dst} is acquired"
+                f" ({via}) while {src} is held here, and the reverse"
+                " order exists elsewhere — pick one global order",
+            )
+
+
+@register
+class UnguardedAccess(Rule):
+    id = "GL702"
+    name = "unguarded-access"
+    rationale = (
+        "an attribute written under its inferred guard at most sites but"
+        " bare on a thread-reachable path is a lost update / torn read:"
+        " the majority discipline IS the contract, and the odd site out"
+        " breaks it exactly where another thread can interleave"
+        " (subsumes the retired GL302/GL303)"
+    )
+    scope = "project"
+
+    def check_project(self, files: List[ParsedFile]):
+        df = dataflow.get_locks(files)
+        for (cname, attr), sites in sorted(df.write_sites.items()):
+            guard = df.inferred_guards.get(cname, {}).get(attr)
+            if guard is None:
+                continue
+            for site in sites:
+                if guard in site.held:
+                    continue
+                if not df.thread_reachable(site.pf, site.fn):
+                    continue
+                verb = {
+                    "assign": "write to", "augassign": "read-modify-write of",
+                    "mutate": "in-place mutation of", "del": "deletion from",
+                }.get(site.kind, "write to")
+                yield self.finding(
+                    site.pf, site.node,
+                    f"{verb} self.{attr} without {guard} — the other"
+                    f" write sites in {cname!r} hold it (inferred guard),"
+                    " and this method runs on a spawned thread",
+                )
+
+
+@register
+class ThreadEscape(Rule):
+    id = "GL703"
+    name = "thread-escape"
+    rationale = (
+        "handing the LIVE guarded container to another thread (Thread"
+        " args, a handoff field on a ticket/callback object) aliases it"
+        " outside the guard: the receiver mutates or iterates it with no"
+        " lock while the owner keeps writing — pass a snapshot"
+        " (dict(...)/list(...)) or the owning object itself"
+    )
+    scope = "project"
+
+    def check_project(self, files: List[ParsedFile]):
+        df = dataflow.get_locks(files)
+        for pf in files:
+            for cls in pf.walk(ast.ClassDef):
+                guards = df.inferred_guards.get(cls.name, {})
+                guarded_mutables = {
+                    attr for attr in guards
+                    if (cls.name, attr) in df.mutable_attrs
+                }
+                if not guarded_mutables:
+                    continue
+                for node in ast.walk(cls):
+                    esc = self._escape(df, pf, cls, node, guarded_mutables)
+                    if esc is not None:
+                        attr, how = esc
+                        yield self.finding(
+                            pf, node,
+                            f"guarded mutable self.{attr} (guard"
+                            f" {guards[attr]}) escapes to another thread"
+                            f" {how} as the live object — hand off a"
+                            " snapshot or the owning object instead",
+                        )
+
+    def _escape(self, df, pf, cls, node, guarded) -> Optional[tuple]:
+        # Thread(target=..., args=(..., self.attr, ...))
+        if isinstance(node, ast.Call) and dotted_name(node.func) in (
+            "threading.Thread", "Thread"
+        ):
+            exprs = []
+            for kw in node.keywords:
+                if kw.arg in ("args", "kwargs"):
+                    exprs.append(kw.value)
+            for expr in exprs:
+                for sub in ast.walk(expr):
+                    attr = dataflow._self_attr_of(sub)
+                    if attr in guarded and not _snapshotted(pf, sub, expr):
+                        return attr, "via Thread args"
+            return None
+        # handoff-field store: other.field = self.attr (the live ref)
+        if isinstance(node, ast.Assign):
+            attr = None
+            sub = node.value
+            a = dataflow._self_attr_of(sub)
+            if a in guarded:
+                attr = a
+            if attr is None:
+                return None
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and dataflow._self_attr_of(tgt) is None
+                    and not (
+                        isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    )
+                ):
+                    fn = pf.enclosing_function(node)
+                    if fn is not None and getattr(fn, "name", "") == "__init__":
+                        return None
+                    return attr, (
+                        "via a handoff-field store"
+                        f" ({ast.unparse(tgt) if hasattr(ast, 'unparse') else 'field'})"
+                    )
+        return None
+
+
+@register
+class WaitDiscipline(Rule):
+    id = "GL704"
+    name = "wait-discipline"
+    rationale = (
+        "Condition.wait returns on spurious wakeups and stolen notifies —"
+        " only a predicate re-check loop makes it correct; notify outside"
+        " the owning lock races the waiter's predicate read; a discarded"
+        " wait(timeout=...) result silently treats a timeout as success"
+    )
+    scope = "project"
+
+    def check_project(self, files: List[ParsedFile]):
+        df = dataflow.get_locks(files)
+        for pf in files:
+            for node in pf.walk(ast.Call):
+                func = node.func
+                if not isinstance(func, ast.Attribute):
+                    continue
+                if func.attr in ("wait", "wait_for"):
+                    yield from self._check_wait(df, pf, node, func)
+                elif func.attr in ("notify", "notify_all"):
+                    yield from self._check_notify(df, pf, node, func)
+
+    def _cond_attr(self, df, pf, func) -> Optional[tuple]:
+        """(class, attr) when the receiver is a Condition attribute of
+        the enclosing class."""
+        attr = dataflow._self_attr_of(func.value)
+        if attr is None:
+            return None
+        cls = pf.enclosing_class(func)
+        if cls is None:
+            return None
+        if (cls.name, attr) in df.cond_attrs:
+            return cls.name, attr
+        return None
+
+    def _event_kind(self, df, pf, func) -> Optional[str]:
+        """'Event'/'Condition' when the receiver is a known event-like
+        attribute — the enclosing class's registry first, the project-wide
+        name registry for receivers precise typing cannot reach."""
+        if isinstance(func.value, ast.Attribute):
+            attr = func.value.attr
+            cls = pf.enclosing_class(func)
+            if cls is not None:
+                if (cls.name, attr) in df.event_attrs:
+                    return "Event"
+                if (cls.name, attr) in df.cond_attrs:
+                    return "Condition"
+            if dataflow._self_attr_of(func.value) is None:
+                return df._event_names.get(attr)
+        return None
+
+    def _check_wait(self, df, pf, node, func):
+        kind = self._event_kind(df, pf, func)
+        if kind is None:
+            return
+        # timed wait result discarded: a timeout is indistinguishable
+        # from a set/notify, so the caller just proceeds on failure
+        if (node.args or node.keywords) and func.attr == "wait":
+            parent = next(pf.parents(node), None)
+            if isinstance(parent, ast.Expr):
+                yield self.finding(
+                    pf, node,
+                    f"result of .wait(timeout=...) on a {kind} is"
+                    " discarded — a timeout looks identical to success;"
+                    " branch on the return value",
+                )
+        # Condition.wait needs an enclosing predicate re-check loop
+        # (wait_for carries its own predicate)
+        if kind == "Condition" and func.attr == "wait":
+            fn = pf.enclosing_function(node)
+            in_loop = any(
+                isinstance(p, (ast.While, ast.For))
+                for p in pf.parents(node)
+                if fn is None or pf.enclosing_function(p) is fn or p is fn
+            )
+            if not in_loop:
+                yield self.finding(
+                    pf, node,
+                    "Condition.wait outside a predicate re-check loop —"
+                    " spurious wakeups and stolen notifies make a bare"
+                    " wait return with the predicate still false; use"
+                    " `while not pred: cv.wait()` or cv.wait_for(pred)",
+                )
+
+    def _check_notify(self, df, pf, node, func):
+        cond = self._cond_attr(df, pf, func)
+        if cond is None:
+            return
+        cname, attr = cond
+        lid = f"{cname}.{attr}"
+        if lid not in df.held_at(pf, node):
+            yield self.finding(
+                pf, node,
+                f".{func.attr}() on Condition self.{attr} outside its"
+                " own lock — the notify races the waiter's predicate"
+                " write and can be lost; notify inside `with"
+                f" self.{attr}:`",
+            )
+
+
+@register
+class BlockingUnderLock(Rule):
+    id = "GL705"
+    name = "blocking-under-lock"
+    rationale = (
+        "a sleep or direct file/network call lexically inside a lock span"
+        " holds every other thread on that lock for the full blocking"
+        " tail (disk stall, DNS hang, the sleep itself) — do the blocking"
+        " work outside the critical section (GL304's discipline,"
+        " generalized from the device grant to every inferred lock)"
+    )
+    scope = "project"
+
+    def check_project(self, files: List[ParsedFile]):
+        df = dataflow.get_locks(files)
+        for pf in files:
+            for node in pf.walk(ast.Call):
+                name = dotted_name(node.func)
+                tail = name.rsplit(".", 1)[-1] if name else ""
+                is_sleep = name in ("time.sleep", "sleep")
+                if not is_sleep and not _direct_io_call(name, tail):
+                    continue
+                fn = pf.enclosing_function(node)
+                if fn is None:
+                    continue
+                fid = dataflow._fn_key(pf, fn)
+                if fid not in df.fn_index:
+                    continue
+                # lexical spans only: may-held entry sets would flag
+                # helpers that ALSO run outside the lock — positive
+                # evidence needs the span in this very function
+                held = sorted(df._lexical_held(fid, node.lineno))
+                if not held:
+                    continue
+                what = "time.sleep" if is_sleep else (name or tail)
+                yield self.finding(
+                    pf, node,
+                    f"blocking call {what!r} inside the critical section"
+                    f" of {held[0]} — every thread queued on the lock"
+                    " waits out the blocking tail; move it outside the"
+                    " with block",
+                )
+
+
+def _at(line: int):
+    """A minimal node-shaped anchor for findings built from witness
+    (relpath, line) pairs rather than live AST nodes."""
+    class _Anchor:
+        lineno = line
+    return _Anchor()
