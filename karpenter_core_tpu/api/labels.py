@@ -29,6 +29,15 @@ NODE_INITIALIZED_LABEL_KEY = f"{GROUP}/initialized"
 NODE_REGISTERED_LABEL_KEY = f"{GROUP}/registered"
 CAPACITY_TYPE_LABEL_KEY = f"{GROUP}/capacity-type"
 
+# Network-topology hierarchy (ISSUE 20). Two optional levels below the
+# kubernetes zone: a rack (one ICI/ToR domain) and a superpod (a group of
+# racks behind one spine block). Offerings and existing nodes carry them;
+# the solver lowers the hierarchy into a per-domain-pair hop matrix
+# (ops/topoplan) and a rank-aware fill order (ops/ffd). Absent labels mean
+# "topology unknown" and the subsystem stays fully disengaged.
+LABEL_TOPOLOGY_RACK = f"topology.{GROUP}/rack"
+LABEL_TOPOLOGY_SUPERPOD = f"topology.{GROUP}/superpod"
+
 # Annotations
 DO_NOT_DISRUPT_ANNOTATION_KEY = f"{GROUP}/do-not-disrupt"
 NODEPOOL_HASH_ANNOTATION_KEY = f"{GROUP}/nodepool-hash"
@@ -65,6 +74,8 @@ WELL_KNOWN_LABELS = frozenset(
         LABEL_OS,
         CAPACITY_TYPE_LABEL_KEY,
         LABEL_WINDOWS_BUILD,
+        LABEL_TOPOLOGY_RACK,
+        LABEL_TOPOLOGY_SUPERPOD,
     }
 )
 

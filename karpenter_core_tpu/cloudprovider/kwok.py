@@ -148,6 +148,7 @@ class KwokCloudProvider(CloudProvider):
         kube,
         instance_types: Optional[List[InstanceType]] = None,
         unavailable_offerings=None,
+        rack_size: int = 0,
     ):
         from karpenter_core_tpu.cloudprovider.unavailableofferings import (
             UnavailableOfferings,
@@ -161,6 +162,14 @@ class KwokCloudProvider(CloudProvider):
         self.instance_types = instance_types or build_catalog()
         self._by_name = {it.name: it for it in self.instance_types}
         self._counter = itertools.count(1)
+        # rack topology stamping (topoaware, ISSUE 20), OFF by default so
+        # existing catalogs stay rack-less and the topo layer disengaged:
+        # rack_size >= 1 assigns each created node a deterministic rack
+        # (racks of rack_size nodes per zone, filled in creation order)
+        # and superpod (two racks per superpod) label — the synthetic
+        # stand-in for a real provider's physical-placement attribution
+        self.rack_size = rack_size
+        self._zone_seq: dict = {}
         self.allow_insufficient_capacity = False
         # ground-truth capacity stockouts: OfferingKeys create cannot fill.
         # Tests / the chaos harness's ICE storms write this set; create
@@ -239,6 +248,16 @@ class KwokCloudProvider(CloudProvider):
                 apilabels.CAPACITY_TYPE_LABEL_KEY: offering.capacity_type,
             }
         )
+        if self.rack_size > 0:
+            n = self._zone_seq.get(offering.zone, 0)
+            self._zone_seq[offering.zone] = n + 1
+            rack = n // self.rack_size
+            labels[apilabels.LABEL_TOPOLOGY_RACK] = (
+                f"{offering.zone}-r{rack}"
+            )
+            labels[apilabels.LABEL_TOPOLOGY_SUPERPOD] = (
+                f"{offering.zone}-s{rack // 2}"
+            )
         node_claim.metadata.labels = labels
         node_claim.conditions.set_true(
             COND_LAUNCHED, "Launched", now=self.clock.now()

@@ -268,6 +268,10 @@ class _Prepared:
     # min node price the scored fallback ranks candidates with.
     init_args: tuple = None
     tmpl_price_d: object = None
+    # topoaware (ISSUE 20): per-gang anchor domain ids into the fp entry's
+    # RackPlan — None whenever the catalog carries no rack labels (the
+    # subsystem's fully-disengaged parity default)
+    topo_anchors: dict = None
 
 
 # ---------------------------------------------------------------------------
@@ -1073,7 +1077,21 @@ class DeviceScheduler:
             # divergence) — it MUST run before verification, which treats a
             # partially materialized gang as a hard violation
             gangmod.enforce_atomicity(results, all_pods)
+            # topoaware backstops (ISSUE 20), same seam and same ordering
+            # contract: distance stripping before eviction pruning (a
+            # stripped gang's evictions must prune with it) and before
+            # verification, which re-derives the bound independently and
+            # treats an exceeded hard max-hops as a hard violation
+            node_labels = {
+                n.name: getattr(n, "labels", None) or {}
+                for n in self.existing_nodes
+            }
+            gangmod.enforce_distance(results, all_pods, node_labels)
             gangmod.prune_evictions(results)
+            # rank-ordered slot assignment runs LAST: a pure within-class
+            # permutation of an already-final packing (rank-adjacent pods
+            # land network-adjacent; the verifier checks adjacency)
+            gangmod.rank_order_pods(results, all_pods, node_labels)
             whole = sum(
                 1
                 for mpods in gangmod.gang_members(all_pods).values()
@@ -1496,17 +1514,34 @@ class DeviceScheduler:
                 if si is not None:
                     wvec[ci] = si
             rstats["warm_classes"] = int((wvec >= 0).sum())
+        # topoaware soft term (ISSUE 20): the per-(gang class, template)
+        # hop-distance plane rides as a trailing optional; absent (None)
+        # for label-free problems the tuple is one leaf shorter, so the
+        # shape key never buckets topo and non-topo relax dispatches
+        # together and the non-topo trace stays byte-identical
+        relax_tuple = (
+            planes["viable"], planes["k_cs"], planes["k_node"],
+            planes["podcost"], planes["counts"], planes["gang_id"],
+            prep.new_template, prep.kstar,
+            jnp.asarray(wvec),
+        )
+        topo_np = prep._batch.get("topo_cost_of_class")
+        if topo_np is not None:
+            tc_d = prep._batch.get("topo_cost_d")
+            if tc_d is None:
+                Cp = int(prep.new_template.shape[0])
+                Sp = int(prep.tmpl_price_d.shape[0])
+                tc_d = self._dev(
+                    _pad(topo_np, {0: Cp, 1: Sp}, 0.0)
+                )
+                prep._batch["topo_cost_d"] = tc_d
+            relax_tuple = relax_tuple + (tc_d,)
         nt, ks, changed, dt = yield _KernelRequest(
             init_state=None, steps=None, statics=None,
             level_iters=prep.level_iters, step_class=None,
             num_classes=prep.n_classes_padded, devices=self.devices,
             n_slots=prep.n_slots, kind="relax", mode="relax",
-            relax=(
-                planes["viable"], planes["k_cs"], planes["k_node"],
-                planes["podcost"], planes["counts"], planes["gang_id"],
-                prep.new_template, prep.kstar,
-                jnp.asarray(wvec),
-            ),
+            relax=relax_tuple,
             relax_iters=self.relax_iters, relax_gangs=planes["n_gangs"],
             backend=self.kernel_backend,
         )
@@ -2698,6 +2733,7 @@ class DeviceScheduler:
                         gang_of_class[ci] = gi
                 prep.gangs = gangs
                 prep.gang_min = self._dev(gmin)
+                self._prepare_topoaware(prep, entry, gangs, gang_of_class, N)
         prep._batch["tier_of_class"] = tier_of_class
         prep._batch["gang_of_class"] = gang_of_class
         # evictable-capacity planes for the preemption pass: positive-tier
@@ -2715,6 +2751,69 @@ class DeviceScheduler:
                 cached = self._build_ev_planes(entry, N)
                 ev_cache[N] = cached
             prep.ev, prep.ev_uids, prep.ev_freed = cached
+
+    def _prepare_topoaware(
+        self, prep: _Prepared, entry: dict, gangs, gang_of_class, N: int
+    ) -> None:
+        """Per-gang-class hop planes (topoaware, ISSUE 20): anchor every
+        kernel gang on the rack domain with the most demand-debited
+        headroom (ops/topoplan.gang_anchors) and hand its member classes
+        the anchor's [N] hop-distance row as their FFD fill-level plane
+        (ClassStep.topo_rank, attached by _class_steps) plus a
+        per-template hop cost row for the relax objective. Engages only
+        when the catalog actually carries rack labels — plan_racks
+        returns None otherwise, ClassStep.topo_rank stays at its None
+        default, and the kernel traces the exact pre-topo program (the
+        off-by-default parity contract). The RackPlan caches on the fp
+        entry per slot count: node and template labels are fp-invariant,
+        only the slot axis varies."""
+        rp_cache = entry.setdefault("rack_plans", {})
+        if N not in rp_cache:
+            rp_cache[N] = topoplan.plan_racks(
+                [
+                    dict(getattr(n, "labels", None) or {})
+                    for n in self.existing_nodes
+                ],
+                # single-valued template requirements attribute a fresh
+                # claim to a rack exactly like the verifier will
+                [gangmod.claim_topo_labels(t) for t in self.templates],
+                N,
+            )
+        rplan = rp_cache[N]
+        if rplan is None:
+            return
+        anchors = topoplan.gang_anchors(
+            rplan,
+            [g.name for g in gangs],
+            [g.min_count for g in gangs],
+        )
+        C = int(gang_of_class.shape[0])
+        S = entry["S"]
+        Sn = max(S, 1)
+        topo_rank = np.zeros((C, N), dtype=np.int32)
+        topo_cost = np.zeros((C, Sn), dtype=np.float32)
+        for g in gangs:
+            anchor = anchors[g.name]
+            row = topoplan.hop_from_anchor(
+                rplan, anchor, gangmod.MAX_HOP_DISTANCE
+            )
+            # template hop cost from the same anchor; a template without a
+            # single-valued rack sits at the ceiling (uniform rows cannot
+            # flip a per-class argmin, so label-free catalogs stay inert)
+            th = np.full((Sn,), gangmod.MAX_HOP_DISTANCE, dtype=np.float32)
+            for si in range(S):
+                d = int(rplan.tmpl_domain[si])
+                if d >= 0:
+                    th[si] = min(
+                        int(rplan.hop[anchor, d]),
+                        gangmod.MAX_HOP_DISTANCE,
+                    )
+            for ci in g.class_indices:
+                topo_rank[ci] = row
+                topo_cost[ci] = th
+        prep._batch["topo_rank_of_class"] = topo_rank
+        prep._batch["topo_cost_of_class"] = topo_cost
+        prep.topo_anchors = anchors
 
     def _build_ev_planes(self, entry: dict, N: int):
         """ops/gangsched.EvPlanes over the existing nodes' evictable bound
@@ -2852,6 +2951,21 @@ class DeviceScheduler:
         defines = _pad(cm.defines[cis], {0: Jp, 1: Kp}, False)
         mask = np.where(defines[:, :, None], mask, True)  # neutral pads
         smask = _pad(prep.smask[cis], {0: Jp, 1: Kp, 2: Vp}, True)
+        # topoaware fill levels (ISSUE 20): [Jp, N] gang-anchor hop rows,
+        # a second slot-axis scanned input beside exist_taint_ok — present
+        # only when _prepare_topoaware engaged (rack labels + kernel
+        # gangs); otherwise ClassStep.topo_rank keeps its None default and
+        # the scan traces the pre-topo program (parity)
+        topo_np = prep._batch.get("topo_rank_of_class")
+        topo_kw = (
+            {}
+            if topo_np is None
+            else {
+                "topo_rank": self._dev_slots(
+                    _pad(topo_np[cis], {0: Jp}, 0), dim=1
+                )
+            }
+        )
         step = ClassStep(
             mask=self._dev(mask),
             defines=self._dev(defines),
@@ -2893,6 +3007,7 @@ class DeviceScheduler:
                 stepvec([s.wf_key for s in steps], np.int32, -1)
             ),
             zone_rest=self._dev(_pad(zone_rest, {0: Jp, 1: Vp}, False)),
+            **topo_kw,
         )
         prep._batch["class_steps"] = step
         prep._batch["step_class"] = ci_j

@@ -68,6 +68,13 @@ BIG = np.float32(3.4e38)
 BIGI = 1 << 30
 RANK_NONE = 1 << 30
 
+# topoaware (ISSUE 20): distinct network-distance levels a slot can sit at
+# relative to a gang's anchor domain — solver/gangs.MAX_HOP_DISTANCE + 1
+# (same rack 0 / same superpod 1 / same zone 2 / farther-or-unknown 3).
+# The existing-node fill groups slots by level: all level-0 capacity fills
+# before any level-1 capacity, preserving slot order within a level.
+TOPO_LEVELS = 4
+
 
 class SlotState(NamedTuple):
     # adding a field? classify its slot-axis placement in
@@ -122,6 +129,13 @@ class ClassStep(NamedTuple):
     wf_group: jax.Array  # [] int32 — label-group index for water-fill (-1)
     wf_key: jax.Array  # [] int32 — vocab key id of that group
     zone_rest: jax.Array  # [V] bool — this + later sub-step domains
+    # topoaware (ISSUE 20): per-slot network-distance level of each
+    # existing slot from this class's gang anchor, in [0, TOPO_LEVELS).
+    # None (the default, a leafless pytree) traces the classic first-fit
+    # cumsum — byte parity for every pre-PR construction site by identical
+    # HLO; an all-zeros plane reduces to the same fill arithmetically.
+    # Only kind==1 slots consult it (fresh claims keep the water-fill).
+    topo_rank: jax.Array = None  # [N] int32
 
 
 class FFDStatics(NamedTuple):
@@ -492,7 +506,27 @@ def ffd_step(state: SlotState, c: ClassStep, statics: FFDStatics,
     # in-flight claims emptiest-first (place_pod: existing loop, then
     # claims.sort(key=len(pods))) --------------------------------------
     k_exist_eff = jnp.where(state.kind == 1, k_eff, 0)
-    before = jnp.cumsum(k_exist_eff) - k_exist_eff  # exclusive prefix
+    if c.topo_rank is None:
+        before = jnp.cumsum(k_exist_eff) - k_exist_eff  # exclusive prefix
+    else:
+        # level-grouped first-fit (topoaware, ISSUE 20): all capacity at
+        # network level 0 fills before any at level 1, slot order within a
+        # level. Integer-exact: an all-zero plane puts every slot in level
+        # 0, where below=0 and the within-level cumsum IS the classic
+        # exclusive prefix — bit-identical fills, the parity the
+        # off-by-default contract rides on.
+        lvl = jnp.clip(c.topo_rank, 0, TOPO_LEVELS - 1)  # [N]
+        onehot = (
+            lvl[:, None]
+            == jnp.arange(TOPO_LEVELS, dtype=lvl.dtype)[None, :]
+        )  # [N, L]
+        k_lvl = jnp.where(onehot, k_exist_eff[:, None], 0)  # [N, L]
+        lvl_tot = jnp.sum(k_lvl, axis=0)  # [L]
+        below = jnp.cumsum(lvl_tot) - lvl_tot  # exclusive over levels
+        within = jnp.cumsum(k_lvl, axis=0) - k_lvl  # exclusive in level
+        before = below[lvl] + jnp.sum(
+            jnp.where(onehot, within, 0), axis=1
+        )
     take_exist = jnp.clip(m - before, 0, k_exist_eff)  # [N]
     rem_claims = m - jnp.sum(take_exist)
     k_claim_eff = jnp.where(state.kind == 2, k_eff, 0)

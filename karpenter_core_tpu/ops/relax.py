@@ -72,6 +72,12 @@ _ETA = jnp.float32(0.5)
 # objective (both normalized to [0, 1]): $-cost leads, node pressure
 # breaks $-ties toward denser packings
 _NODE_WEIGHT = jnp.float32(0.5)
+# mix weight of the cross-domain-hop term (topoaware, ISSUE 20): a soft
+# preference only — below the node term so topology nearness breaks
+# $-and-node ties toward network-adjacent templates but never pays an
+# extra node for it (the hard max-hops bound is enforced post-hoc by
+# solver/gangs.enforce_distance and re-derived by the verifier)
+_TOPO_WEIGHT = jnp.float32(0.25)
 
 
 @jax.jit
@@ -204,8 +210,9 @@ def _relax_choose_impl(
     base_template,  # [C] int32 — fresh_viability's first-wins choice
     base_kstar,  # [C] int32
     warm_template,  # [C] int32 — prior solve's template choice, -1 = none
-    iters: int,
-    num_gangs: int,
+    topo_cost=None,  # [C, S] float32 — gang-anchor hop distance, or None
+    iters: int = DEFAULT_ITERS,
+    num_gangs: int = 0,
 ):
     vf = viable.astype(jnp.float32)
     nv = jnp.sum(vf, axis=1, keepdims=True)
@@ -241,6 +248,15 @@ def _relax_choose_impl(
     )
     nodeshare = nodeshare / jnp.maximum(jnp.max(nodeshare), 1e-6)
     g = cost + _NODE_WEIGHT * nodeshare
+    if topo_cost is not None:
+        # topoaware (ISSUE 20): per-(gang class, template) hop distance
+        # from the gang's anchor domain, normalized like the other terms.
+        # None (the plane is absent unless the provisioner's topoaware
+        # prep engaged) traces the exact pre-topo program — the
+        # off-by-default parity contract at this layer.
+        tc = jnp.where(viable, topo_cost, 0.0)
+        tc = tc / jnp.maximum(jnp.max(tc), 1e-6)
+        g = g + _TOPO_WEIGHT * tc
 
     def body(_, x):
         y = x - _ETA * (g + _MU * x)
@@ -274,14 +290,15 @@ relax_choose = partial(
 
 def _relax_choose_batched_impl(
     viable, k_cs, k_node, podcost, counts, gang_id, base_template,
-    base_kstar, warm_template, iters: int, num_gangs: int,
+    base_kstar, warm_template, topo_cost=None, iters: int = DEFAULT_ITERS,
+    num_gangs: int = 0,
 ):
     return jax.vmap(
-        lambda v, k, kn, p, c, gi, bt, bk, wt: _relax_choose_impl(
-            v, k, kn, p, c, gi, bt, bk, wt, iters, num_gangs
+        lambda v, k, kn, p, c, gi, bt, bk, wt, tc: _relax_choose_impl(
+            v, k, kn, p, c, gi, bt, bk, wt, tc, iters, num_gangs
         )
     )(viable, k_cs, k_node, podcost, counts, gang_id, base_template,
-      base_kstar, warm_template)
+      base_kstar, warm_template, topo_cost)
 
 
 # vmapped twin for the PR 9 coalescer: stacked relax problems in one

@@ -55,6 +55,10 @@ TYPE_CODE = {TYPE_SPREAD: 0, TYPE_ANTI_AFFINITY: 1, TYPE_AFFINITY: 2}
 NO_MIN_DOMAINS = -1
 RANK_NONE = 1 << 30
 
+# topoaware (ISSUE 20): sentinel domain id for slots/templates with no
+# rack attribution — the kernel treats them as the farthest level
+TOPO_UNKNOWN = -1
+
 
 def _trivial_node_filter(group: TopologyGroup) -> bool:
     return all(len(alt) == 0 for alt in group.node_filter.alternatives)
@@ -471,6 +475,176 @@ def finalize_arrays(plan: TopoPlan, frozen, topo: Topology) -> None:
             )
             rest[vid] = False
     plan.steps = steps
+
+
+# -- the network-topology catalog (topoaware, ISSUE 20) ----------------------
+# Rack/ICI-adjacency lowering: the `topology.karpenter.sh/rack` (+ optional
+# `…/superpod`) label hierarchy on existing nodes and nodeclaim templates
+# becomes (a) a small per-domain-pair hop matrix and (b) per-slot /
+# per-template domain ids. models/provisioner._prepare_gangsched picks one
+# ANCHOR domain per gang and gathers hop-from-anchor rows as the kernel's
+# per-step topo_rank planes (ops/ffd level-grouped fill); ops/relax gets the
+# same matrix as a class×template cost plane. The hop METRIC itself is
+# solver/gangs.hop_distance — one definition across kernel, verifier, twin
+# and bench.
+
+
+@dataclass
+class RackPlan:
+    """The lowered rack catalog for one solve's slot axis."""
+
+    # sorted distinct (zone, superpod, rack) triples over attributable
+    # existing nodes and templates ("" where a level's label is absent)
+    domains: List[Tuple[str, str, str]]
+    hop: np.ndarray  # [D, D] int32 pairwise hop distance
+    slot_domain: np.ndarray  # [N] int32 domain id, TOPO_UNKNOWN elsewhere
+    tmpl_domain: np.ndarray  # [S] int32 domain id per template
+
+
+def _labels_of_triple(t: Tuple[str, str, str]) -> Dict[str, str]:
+    zone, superpod, rack = t
+    out: Dict[str, str] = {}
+    if zone:
+        out[apilabels.LABEL_TOPOLOGY_ZONE] = zone
+    if superpod:
+        out[apilabels.LABEL_TOPOLOGY_SUPERPOD] = superpod
+    if rack:
+        out[apilabels.LABEL_TOPOLOGY_RACK] = rack
+    return out
+
+
+def _triple_of_labels(labels) -> Optional[Tuple[str, str, str]]:
+    """(zone, superpod, rack) of one label dict, or None when the rack
+    label is absent — a node without a rack is unattributable and never
+    joins the catalog (soundness over completeness)."""
+    labels = labels or {}
+    rack = labels.get(apilabels.LABEL_TOPOLOGY_RACK)
+    if not rack:
+        return None
+    return (
+        labels.get(apilabels.LABEL_TOPOLOGY_ZONE) or "",
+        labels.get(apilabels.LABEL_TOPOLOGY_SUPERPOD) or "",
+        rack,
+    )
+
+
+def plan_racks(
+    node_labels: List[Dict[str, str]],
+    template_labels: List[Dict[str, str]],
+    n_slots: int,
+) -> Optional[RackPlan]:
+    """Lower the rack hierarchy for one solve. ``node_labels`` carries one
+    label dict per existing-node slot (slots [0, E)); ``template_labels``
+    one per nodeclaim template (single-valued rack/superpod/zone
+    requirement values, already resolved by the caller). Returns None when
+    NO entity carries a rack label — the topoaware subsystem stays fully
+    disengaged and every downstream plane keeps its parity-neutral
+    all-zeros default."""
+    from karpenter_core_tpu.solver import gangs as gangmod
+
+    triples: List[Tuple[str, str, str]] = []
+    seen: Set[Tuple[str, str, str]] = set()
+    node_triples = [_triple_of_labels(l) for l in node_labels]
+    tmpl_triples = [_triple_of_labels(l) for l in template_labels]
+    for t in node_triples + tmpl_triples:
+        if t is not None and t not in seen:
+            seen.add(t)
+            triples.append(t)
+    if not triples:
+        return None
+    triples.sort()
+    index = {t: i for i, t in enumerate(triples)}
+    D = len(triples)
+    hop = np.zeros((D, D), dtype=np.int32)
+    for i, a in enumerate(triples):
+        la = _labels_of_triple(a)
+        for j in range(i + 1, D):
+            d = gangmod.hop_distance(la, _labels_of_triple(triples[j]))
+            hop[i, j] = hop[j, i] = d
+    slot_domain = np.full((n_slots,), TOPO_UNKNOWN, dtype=np.int32)
+    for si, t in enumerate(node_triples[:n_slots]):
+        if t is not None:
+            slot_domain[si] = index[t]
+    tmpl_domain = np.array(
+        [TOPO_UNKNOWN if t is None else index[t] for t in tmpl_triples],
+        dtype=np.int32,
+    )
+    return RackPlan(
+        domains=triples, hop=hop, slot_domain=slot_domain,
+        tmpl_domain=tmpl_domain,
+    )
+
+
+def gang_anchors(
+    rplan: RackPlan,
+    gang_names: List[str],
+    gang_sizes: List[int],
+) -> Dict[str, int]:
+    """One anchor domain per gang: greedily the domain whose NEIGHBORHOOD
+    absorbs the gang's demand at the smallest hop radius (capacity proxy:
+    one pod per slot), with each gang's demand then debited across that
+    neighborhood in hop order — the same nearest-first order the level
+    fill consumes slots in — so a later gang sees the headroom an earlier
+    gang's spill already claimed and anchors in a different superpod (or
+    zone) instead of stacking onto one. Ties break on local headroom,
+    then sorted domain order; a catalog with no racked existing slots
+    anchors on template domains the same way. Pure heuristic — the hard
+    bound is enforced post-hoc (solver/gangs.enforce_distance) and
+    re-derived by the verifier, so a bad anchor can cost optimality,
+    never correctness."""
+    from karpenter_core_tpu.solver.gangs import MAX_HOP_DISTANCE
+
+    D = len(rplan.domains)
+    headroom = np.zeros((D,), dtype=np.int64)
+    for d in rplan.slot_domain:
+        if d >= 0:
+            headroom[int(d)] += 1
+    tmpl_only = not headroom.any()
+    if tmpl_only:
+        for d in rplan.tmpl_domain:
+            if d >= 0:
+                headroom[int(d)] += 1
+    out: Dict[str, int] = {}
+    for name, size in zip(gang_names, gang_sizes):
+        need = max(int(size), 1)
+        best, best_key = 0, None
+        for a in range(D):
+            # hop radius at which this anchor's neighborhood absorbs the
+            # demand (nearest-first, stable = sorted domain order within
+            # a hop level, mirroring the kernel's level-grouped fill)
+            order = np.argsort(rplan.hop[a], kind="stable")
+            remaining, radius = need, MAX_HOP_DISTANCE + 1
+            for d in order:
+                remaining -= int(headroom[int(d)])
+                if remaining <= 0:
+                    radius = int(rplan.hop[a, int(d)])
+                    break
+            key = (radius, -int(headroom[a]), a)
+            if best_key is None or key < best_key:
+                best, best_key = a, key
+        out[name] = best
+        remaining = need
+        for d in np.argsort(rplan.hop[best], kind="stable"):
+            take = min(remaining, int(headroom[int(d)]))
+            headroom[int(d)] -= take
+            remaining -= take
+            if remaining <= 0:
+                break
+    return out
+
+
+def hop_from_anchor(rplan: RackPlan, anchor: int,
+                    max_hop: int) -> np.ndarray:
+    """[N] int32 hop distance of every slot's domain from the anchor,
+    clipped to max_hop; unattributable slots sit at the ceiling. This row
+    IS a gang class's topo_rank plane (ops/ffd): level 0 slots fill
+    first, then 1, then 2, …"""
+    out = np.full(rplan.slot_domain.shape, max_hop, dtype=np.int32)
+    known = rplan.slot_domain >= 0
+    out[known] = np.minimum(
+        rplan.hop[anchor, rplan.slot_domain[known]], max_hop
+    )
+    return out
 
 
 def initial_hcounts(plan: TopoPlan, slot_names: List[str], n_slots: int) -> np.ndarray:

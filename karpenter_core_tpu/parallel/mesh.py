@@ -113,12 +113,14 @@ def batch_sharding(mesh: Mesh, ndim: int, axis: str = "slots") -> NamedSharding:
     return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
 
 
-# The one ClassStep field carrying a slot axis (its unbatched dim index):
-# exist_taint_ok is the scanned [J, N] per-class taint-tolerance plane;
+# The ClassStep fields carrying a slot axis (their unbatched dim index):
+# exist_taint_ok is the scanned [J, N] per-class taint-tolerance plane and
+# topo_rank the scanned [J, N] network-distance-level plane (topoaware,
+# ISSUE 20 — often the leafless None default, which shards as nothing);
 # every other field is per-class metadata and replicates. Kept here beside
 # SLOT_STATE_SPECS so the batched placement below classifies BOTH scanned
 # pytrees by field name instead of shape guessing.
-CLASS_STEP_SPECS = {"exist_taint_ok": 1}
+CLASS_STEP_SPECS = {"exist_taint_ok": 1, "topo_rank": 1}
 
 # ops/gangsched.EvPlanes — the preemption pass's evictable-capacity planes.
 # Every field leads with the slot axis ([N, P] / [N, P, R]): each slot's
@@ -160,6 +162,25 @@ def batched_gang_plane_shardings(mesh: Mesh, planes, n_slots: int,
     replicated, slot axis sharded — composes with the continuous-batching
     vmapped gang solve the same way batched_slot_shardings does."""
     return _batched_specs(mesh, planes, GANG_EV_SPECS, n_slots, axis)
+
+
+def topo_plane_shardings(mesh: Mesh, tree, n_slots: int,
+                         axis: str = "slots"):
+    """Shardings for the topoaware hop planes (ops/topoplan → the gang
+    classes' [J, N] topo_rank rows): the trailing slot axis shards over
+    the mesh, leading dims replicate — the planes ride the same scan as
+    exist_taint_ok, so they must land sharded the same way. A leaf whose
+    trailing dim is not the slot axis is a caller bug and raises (the
+    refuse-to-guess contract)."""
+    def spec(leaf):
+        if leaf.shape[-1] != n_slots:
+            raise ValueError(
+                f"topo_plane_shardings: leaf has shape {leaf.shape},"
+                f" expected trailing dim == n_slots ({n_slots})"
+            )
+        return axis_sharding(mesh, leaf.ndim, leaf.ndim - 1, axis)
+
+    return jax.tree.map(spec, tree)
 
 
 def relax_plane_shardings(mesh: Mesh, tree):
@@ -209,6 +230,11 @@ def _batched_specs(mesh: Mesh, tree, table: dict, n_slots: int, axis: str):
     specs = {}
     for f in tree._fields:
         leaf = getattr(tree, f)
+        if leaf is None:
+            # a leafless optional plane (ClassStep.topo_rank default):
+            # None in the value tree must pair with None in the spec tree
+            specs[f] = None
+            continue
         dim = table[f]
         if dim is None:
             specs[f] = replicated(mesh)

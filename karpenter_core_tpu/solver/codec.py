@@ -179,7 +179,18 @@ def decode_response(data: bytes) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
 # version-skew error → greedy fallback instead of silently shedding the
 # warm-start. Key omitted when empty, so a non-incremental request's
 # header carries no trace of the feature.
-SOLVE_WIRE_VERSION = 6
+# v7: topoaware gang placement (ISSUE 20). No new fields — rack/superpod
+# node labels and the pod-group rank/max-hops annotations ride the
+# existing label/annotation maps — but the RESULT contract changed:
+# claims' pod_uids now come back rank-ordered for ranked gangs and a
+# placement exceeding a hard max-hops bound is rejected server-side, so a
+# mixed deployment must degrade explicitly through the version-skew error
+# rather than silently serving distance-blind placements to a client
+# whose verifier enforces the distance bound. Hostile wire rank/max-hops
+# ints are range-clamped at the annotation parse (solver/gangs.gang_rank
+# / gang_max_hops, the registered GL601 normalizers) before any int32
+# plane store — the eviction-priority (priority_tier) precedent.
+SOLVE_WIRE_VERSION = 7
 
 # the solver backends a request may select; "" means unspecified (the
 # serving daemon's default applies)

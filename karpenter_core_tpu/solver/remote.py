@@ -659,7 +659,17 @@ class RemoteScheduler:
                 # materialize a gang partially — strip it BEFORE
                 # verification, which treats partial gangs as violations
                 gangmod.enforce_atomicity(results, pods)
+                # topoaware backstops (ISSUE 20), same ordering as the
+                # in-proc seam: distance stripping before eviction pruning
+                # and before verification; rank re-assignment last (a pure
+                # within-class permutation of the final packing)
+                node_labels = {
+                    n.name: getattr(n, "labels", None) or {}
+                    for n in self.existing_nodes
+                }
+                gangmod.enforce_distance(results, pods, node_labels)
                 gangmod.prune_evictions(results)
+                gangmod.rank_order_pods(results, pods, node_labels)
         except RemoteSolverError as e:
             self._note_rpc_failure(e, digest)
             m.SOLVER_RPC_FALLBACKS.inc({"endpoint": "solve"})

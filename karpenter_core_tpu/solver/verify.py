@@ -98,6 +98,7 @@ REASONS = (
     "eviction",
     "eviction_unknown",
     "gang",
+    "gang_distance",
 )
 
 
@@ -399,9 +400,15 @@ class ResultVerifier:
         skipped it would have to trust the solver's own "no gangs" claim.
         The price is one O(pods) annotation pass per verification."""
         from karpenter_core_tpu.solver.gangs import (
+            MAX_HOP_DISTANCE,
+            claim_topo_labels,
+            gang_max_hops_for,
             gang_members,
             gang_min_count,
+            placement_hop_bound,
+            pod_gang_rank,
             pod_gang_sig,
+            topo_sort_key,
         )
         from karpenter_core_tpu.utils.disruption import priority_tier
 
@@ -500,6 +507,29 @@ class ResultVerifier:
                 for p in sim.pods:
                     if z:
                         zone_of[id(p)] = z
+        # network-topology attribution (topoaware, ISSUE 20): full topo
+        # label dict per placed pod — a fresh claim attributes through its
+        # single-valued requirements (claim_topo_labels, the zone rule
+        # extended down the hierarchy), an existing node through its
+        # labels. Built only when some gang declares a hop bound or
+        # carries ranked members.
+        topo_of: Dict[int, dict] = {}
+        needs_topo = any(
+            ((g := pod_gang_sig(p)) is not None and g[4] is not None)
+            or pod_gang_rank(p) is not None
+            for mp in members.values()
+            for p in mp
+        )
+        if needs_topo:
+            for claim in results.new_node_claims:
+                lab = claim_topo_labels(claim)
+                for p in claim.pods:
+                    topo_of[id(p)] = lab
+            for sim in results.existing_nodes:
+                node = self.existing_by_name.get(sim.name)
+                lab = dict(node.labels or {}) if node is not None else {}
+                for p in sim.pods:
+                    topo_of[id(p)] = lab
         for name, mpods in sorted(members.items()):
             bound = [p for p in mpods if placed.get(id(p), 0)]
             min_count = gang_min_count(mpods)
@@ -543,6 +573,56 @@ class ResultVerifier:
                         f" but its fresh members span templates"
                         f" {sorted(pools)}",
                     ))
+            # hard max-hops bound (topoaware, ISSUE 20), re-derived purely
+            # from annotations + labels via the SOUND bound: only
+            # attributable placements count and a level only raises the
+            # bound when both sides carry it and differ — a cluster
+            # without rack labels can never manufacture a violation
+            # (soundness over completeness)
+            max_hops = gang_max_hops_for(mpods)
+            if max_hops is not None and max_hops < MAX_HOP_DISTANCE:
+                worst = placement_hop_bound(
+                    [topo_of.get(id(p)) for p in bound]
+                )
+                if worst > max_hops:
+                    out.append(Violation(
+                        "gang_distance",
+                        f"pod group {name!r} placement provably spans"
+                        f" {worst} network hops, above its declared"
+                        f" max-hops bound {max_hops}",
+                    ))
+            # rank adjacency: within one equivalence class, members sorted
+            # by rank must occupy rack-attributable placements in
+            # non-decreasing network order (each domain holds one
+            # contiguous rank run) — exactly what the solver-side
+            # rank_order_pods permutation guarantees, re-derived here
+            # from annotations + labels alone
+            ranked = [p for p in bound if pod_gang_rank(p) is not None]
+            if ranked:
+                from karpenter_core_tpu.solver.snapshot import (
+                    _spec_signature,
+                )
+
+                by_cls: Dict[tuple, list] = {}
+                for p in ranked:
+                    lab = topo_of.get(id(p)) or {}
+                    if not lab.get(apilabels.LABEL_TOPOLOGY_RACK):
+                        continue  # unattributable: soundness first
+                    by_cls.setdefault(_spec_signature(p, True), []).append(
+                        (pod_gang_rank(p), topo_sort_key(lab))
+                    )
+                for pairs in by_cls.values():
+                    pairs.sort()
+                    keys = [k for _r, k in pairs]
+                    if keys != sorted(keys):
+                        out.append(Violation(
+                            "gang_distance",
+                            f"pod group {name!r} rank order is not"
+                            " network-adjacent: rank-sorted members do"
+                            " not occupy their topology domains as"
+                            " contiguous runs",
+                        ))
+                        break
         return out
 
     # -- per-group checks --------------------------------------------------

@@ -409,7 +409,7 @@ class DigitalTwin:
                 ),
             ))
         provider = ChaosCloudProvider(
-            KwokCloudProvider(store, catalog),
+            KwokCloudProvider(store, catalog, rack_size=s.rack_size),
             schedule,
             storms=storms,
             clock=vclock,

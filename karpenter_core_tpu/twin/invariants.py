@@ -11,6 +11,11 @@ what it asserts is the operator's convergence contract under chaos:
 * **capacity** — per-node bound requests within allocatable (cpu+memory);
 * **gang atomicity** — a pod group is bound all-or-nothing: at a stable
   tick its bound count is 0 or >= its min size, never a strand;
+* **gang distance** — a pod group declaring a hard network-hop bound
+  (``pod-group-max-hops``, topoaware ISSUE 20) is never left bound
+  PROVABLY wider than it: the monitor re-derives the placement's hop
+  bound purely from annotations + node topology labels (the verifier's
+  sound lower bound, so a missing rack label can never false-positive);
 * **eviction-budget compliance** — no PodDisruptionBudget's healthy count
   sits below its desired-healthy floor once its pods are past the
   settling grace (preemption and consolidation must route around PDBs,
@@ -29,6 +34,7 @@ from dataclasses import dataclass
 from typing import Dict, List
 
 from karpenter_core_tpu.api.objects import POD_RUNNING, Pod
+from karpenter_core_tpu.solver import gangs as gangmod
 from karpenter_core_tpu.twin import workloads
 from karpenter_core_tpu.utils.pdb import _resolve
 
@@ -41,7 +47,7 @@ class Violation:
     at: float
     cluster: int
     invariant: str  # pod_conservation | capacity | gang_atomicity
-    #              | eviction_budget | verifier_rejection
+    #              | gang_distance | eviction_budget | verifier_rejection
     detail: str
 
     def encode(self) -> dict:
@@ -178,6 +184,28 @@ class InvariantMonitor:
                     f"gang {gang} stranded at {bound}/{len(members)}"
                     f" bound (min {min_size})",
                 )
+            # gang distance (topoaware): a declared hard hop bound must
+            # hold over the bound members' ACTUAL node topology labels —
+            # the same sound lower bound the verifier rejects on, so the
+            # two layers cannot drift and a missing rack label skips the
+            # member instead of manufacturing a violation
+            max_hops = gangmod.gang_max_hops_for(members)
+            if (
+                max_hops is not None
+                and max_hops < gangmod.MAX_HOP_DISTANCE
+            ):
+                placed = [
+                    dict(nodes[p.node_name].labels or {})
+                    for p in members
+                    if p.node_name and p.node_name in nodes
+                ]
+                worst = gangmod.placement_hop_bound(placed)
+                if worst > max_hops:
+                    flag(
+                        "gang_distance",
+                        f"gang {gang} bound across {worst} network hops,"
+                        f" above its declared max-hops bound {max_hops}",
+                    )
 
         # eviction-budget compliance: PDB healthy floor at stable ticks
         for pdb in sorted(op.kube.list_pdbs(), key=lambda b: b.name):

@@ -71,6 +71,15 @@ class Ledger:
         # (mean_members = member_seconds / duration)
         self.member_seconds = 0.0
         self.peak_members = 0
+        # gang network spread (topoaware, ISSUE 20): per-cluster PEAK
+        # intra-gang hop distance over rack-attributable bound members,
+        # and gang·ticks spent straggler-exposed (a gang spanning >= 2
+        # hops — beyond one superpod — at a stable tick). Recorded per
+        # run; stragglers-AVOIDED is the delta against a distance-blind
+        # control run of the same scenario (bench cfg18 / twin tests).
+        # Rack-less runs record {} / 0 — nothing to attribute.
+        self.gang_max_hops: Dict[int, int] = {}
+        self.straggler_gang_ticks = 0
         # filled by the harness at finish() from metric deltas/tier state
         self.preemption_evictions = 0
         self.slo_misses = 0
@@ -102,6 +111,42 @@ class Ledger:
             self.node_seconds[cluster] = (
                 self.node_seconds.get(cluster, 0.0) + len(nodes) * dt
             )
+            self._sample_gang_hops(cluster, op, nodes)
+
+    def _sample_gang_hops(self, cluster: int, op, nodes) -> None:
+        """One tick's gang network spread: max pairwise hop distance per
+        bound gang, measured over members on rack-labeled nodes only (on
+        a rack-less catalog there is nothing to attribute, so legacy
+        runs' ledgers gain only constant keys)."""
+        from karpenter_core_tpu.solver import gangs as gangmod
+        from karpenter_core_tpu.twin import workloads
+
+        by_name = {n.name: n for n in nodes}
+        placements: Dict[str, List[dict]] = {}
+        for pod in op.kube.list_pods():
+            if not pod.node_name:
+                continue
+            gang = workloads.gang_of(pod)
+            node = by_name.get(pod.node_name)
+            if not gang or node is None:
+                continue
+            labels = dict(node.labels or {})
+            if labels.get(apilabels.LABEL_TOPOLOGY_RACK):
+                placements.setdefault(gang, []).append(labels)
+        for gang in sorted(placements):
+            placed = placements[gang]
+            if len(placed) < 2:
+                continue
+            worst = max(
+                gangmod.hop_distance(a, b)
+                for i, a in enumerate(placed)
+                for b in placed[i + 1:]
+            )
+            self.gang_max_hops[cluster] = max(
+                self.gang_max_hops.get(cluster, 0), worst
+            )
+            if worst >= 2:
+                self.straggler_gang_ticks += 1
 
     def record_bind(self, workload_class: str, latency_s: float) -> None:
         self.bind_latencies.setdefault(workload_class, []).append(latency_s)
@@ -136,6 +181,11 @@ class Ledger:
                 str(cluster): round(self.node_seconds[cluster], 6)
                 for cluster in sorted(self.node_seconds)
             },
+            "gang_max_hops": {
+                str(cluster): self.gang_max_hops[cluster]
+                for cluster in sorted(self.gang_max_hops)
+            },
+            "straggler_gang_ticks": self.straggler_gang_ticks,
             "slo": self.slo(),
             "slo_misses": self.slo_misses,
             "preemption_evictions": self.preemption_evictions,

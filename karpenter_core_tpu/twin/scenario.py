@@ -31,7 +31,12 @@ class WorkloadWave:
     ``serving`` emits replica pods under a PodDisruptionBudget
     (``min_available``), ``batch`` emits preemptible filler. ``lifetime``
     schedules the whole wave's deletion (serving churn / batch drain);
-    0 keeps it forever."""
+    0 keeps it forever. ``max_hops`` >= 0 makes a training wave
+    comms-sensitive (topoaware, ISSUE 20): every gang declares that hard
+    network-hop bound and every member carries its rank annotation, so
+    the solver must place the gang rank-adjacent within the bound; -1
+    (default) leaves the gang distance-blind — byte-identical pods to
+    the pre-topoaware twin."""
 
     at: float
     cluster: int
@@ -43,6 +48,7 @@ class WorkloadWave:
     priority: int = 0
     lifetime: float = 0.0
     min_available: int = 0
+    max_hops: int = -1
 
 
 @dataclass(frozen=True)
@@ -125,6 +131,12 @@ class Scenario:
     # SLO bound doubling as the starvation invariant: an expected pod
     # pending longer than this at a stable tick is a violation
     max_pending: float = 600.0
+    # rack topology (topoaware, ISSUE 20): N >= 1 makes every cluster's
+    # kwok provider stamp created nodes with deterministic rack (and
+    # superpod) labels — racks of N nodes per zone, superpods of two
+    # racks — so gang placements become hop-attributable; 0 (default)
+    # keeps catalogs rack-less and the whole topo layer disengaged
+    rack_size: int = 0
     rates: Dict[str, float] = field(default_factory=dict)
     waves: Tuple[WorkloadWave, ...] = ()
     storms: Tuple[Storm, ...] = ()
@@ -162,6 +174,7 @@ def encode_scenario(s: Scenario) -> dict:
         "fleet_min": s.fleet_min,
         "fleet_max": s.fleet_max,
         "max_pending": s.max_pending,
+        "rack_size": s.rack_size,
         "rates": dict(sorted(s.rates.items())),
         "waves": _encode_items(s.waves, WorkloadWave),
         "storms": _encode_items(s.storms, Storm),
@@ -215,6 +228,7 @@ def decode_scenario(data: dict) -> Scenario:
         fleet_min=int(data.get("fleet_min", 0)),
         fleet_max=int(data.get("fleet_max", 0)),
         max_pending=float(data.get("max_pending", 600.0)),
+        rack_size=int(data.get("rack_size", 0)),
         rates={k: float(v) for k, v in sorted((data.get("rates") or {}).items())},
         waves=_decode_items(data.get("waves"), WorkloadWave),
         storms=_decode_items(data.get("storms"), Storm),
@@ -262,6 +276,8 @@ def validate_scenario(s: Scenario) -> None:
             )
     elif s.fleet_min or s.fleet_max:
         raise ValueError("fleet_min/fleet_max require autoscale")
+    if s.rack_size < 0:
+        raise ValueError(f"rack_size must be >= 0, got {s.rack_size}")
     def _cluster_in_range(what: str, cluster: int, wildcard: bool) -> None:
         lo = -1 if wildcard else 0  # -1 = every cluster, where allowed
         if not (lo <= cluster < s.clusters):
@@ -284,6 +300,18 @@ def validate_scenario(s: Scenario) -> None:
                     f"training wave count {wave.count} must be a positive"
                     f" multiple of gang_size {wave.gang_size}"
                 )
+            if not (-1 <= wave.max_hops <= 3):
+                # the annotation contract clamps hostile ints server-side;
+                # a scenario FILE declaring an impossible bound is a typo,
+                # not an adversary — reject it loudly
+                raise ValueError(
+                    f"training wave max_hops {wave.max_hops} outside"
+                    " [-1, 3]"
+                )
+        elif wave.max_hops != -1:
+            raise ValueError(
+                f"max_hops only applies to training waves, not {wave.kind!r}"
+            )
     for storm in s.storms:
         _cluster_in_range(f"storm at t={storm.start}", storm.cluster, True)
     for fault in s.fleet_faults:

@@ -32,7 +32,9 @@ from karpenter_core_tpu.api.objects import (
 from karpenter_core_tpu.chaos import fold_seed
 from karpenter_core_tpu.solver.gangs import (
     GANG_ANNOTATION,
+    GANG_MAX_HOPS_ANNOTATION,
     GANG_MIN_SIZE_ANNOTATION,
+    GANG_RANK_ANNOTATION,
 )
 from karpenter_core_tpu.twin.scenario import WorkloadWave
 
@@ -86,6 +88,20 @@ def pods_for_wave(
         for g in range(wave.count // wave.gang_size):
             gang_name = f"{wave_id}-g{g}"
             for i in range(wave.gang_size):
+                annotations = {
+                    GANG_ANNOTATION: gang_name,
+                    GANG_MIN_SIZE_ANNOTATION: str(wave.gang_size),
+                }
+                if wave.max_hops >= 0:
+                    # comms-sensitive gang (topoaware, ISSUE 20): a hard
+                    # network-hop bound plus per-member collective rank,
+                    # so the solver must place the gang rank-adjacent
+                    # within the bound and the invariant monitor can
+                    # re-derive both from annotations + node labels
+                    annotations[GANG_MAX_HOPS_ANNOTATION] = str(
+                        wave.max_hops
+                    )
+                    annotations[GANG_RANK_ANNOTATION] = str(i)
                 pods.append(_pod(
                     name=f"{gang_name}-{i}",
                     wave_id=wave_id,
@@ -93,10 +109,7 @@ def pods_for_wave(
                     cpu=wave.cpu,
                     memory_gib=wave.memory_gib,
                     labels={"app": gang_name},
-                    annotations={
-                        GANG_ANNOTATION: gang_name,
-                        GANG_MIN_SIZE_ANNOTATION: str(wave.gang_size),
-                    },
+                    annotations=annotations,
                     priority=wave.priority,
                 ))
     elif wave.kind == "serving":

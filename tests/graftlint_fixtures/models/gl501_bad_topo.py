@@ -1,0 +1,29 @@
+# GL501 bad (topoaware entry): a DeviceScheduler-shaped driver builds a
+# SlotState from host numpy — and a ClassStep carrying the topoaware
+# per-slot hop plane (topo_rank) straight from host numpy beside it — and
+# hands both to the SlotState jit entry (ops/ffd.ffd_solve) without ever
+# routing through parallel.mesh placement (slot_shardings / axis_sharding
+# / topo_plane_shardings or an explicit device_put sharding), so on a
+# multi-device mesh the level-grouped fill compiles against absent
+# shardings and silently degrades to replicated copies.
+# Lint corpus only — never imported.
+import numpy as np
+
+from karpenter_core_tpu.ops.ffd import ClassStep, SlotState, ffd_solve
+
+
+class DeviceScheduler:
+    def _make_topo_state(self, n_slots, k, v):
+        # every plane is host numpy: provenance {host}, never placed
+        return SlotState(
+            valmask=np.ones((n_slots, k, v), dtype=bool),
+            kind=np.zeros((n_slots,), dtype=np.int8),
+        )
+
+    def solve(self, statics, n_steps, n_slots, k, v):
+        state = self._make_topo_state(n_slots, k, v)
+        steps = ClassStep(
+            count=np.zeros((n_steps,), dtype=np.int32),
+            topo_rank=np.zeros((n_steps, n_slots), dtype=np.int32),
+        )
+        return ffd_solve(state, steps, statics, level_iters=32)  # GL501

@@ -211,3 +211,27 @@ def test_fast_bench_emits_well_formed_json():
     assert gangs["gang_atomicity_ok"] is True
     assert gangs["eviction_minimality_ok"] is True
     assert gangs["gangs_placed"] > 0
+
+    # the tiny cfg18 topoaware smoke (ISSUE 20): the identical gang
+    # problem solved distance-aware vs distance-blind on a racked
+    # 2-zone fleet — the aware run lands strictly fewer intra-gang hops
+    # at equal-or-better node count, never provably exceeds the declared
+    # hard max-hops bound on an accepted placement, and places every
+    # gang in both runs (the comparison is not vacuous)
+    topo = line["detail"]["cfg18_topoaware"]
+    for key in ("max_hops_bound", "aware", "blind", "p50_ratio",
+                "gangs_placed_ok", "topo_hops_ok", "hard_bound_ok"):
+        assert key in topo, key
+    assert topo["gangs_placed_ok"] is True, topo
+    assert topo["topo_hops_ok"] is True, topo
+    assert topo["hard_bound_ok"] is True, topo
+    aware, blind = topo["aware"], topo["blind"]
+    assert aware["max_intra_gang_hops"] < blind["max_intra_gang_hops"]
+    assert aware["node_count"] <= blind["node_count"]
+    assert aware["provable_hop_bound"] <= topo["max_hops_bound"]
+    for half in (aware, blind):
+        for key in ("p50_solve_s", "max_intra_gang_hops",
+                    "provable_hop_bound", "gangs_placed", "node_count",
+                    "cost_dollars_per_hour", "unschedulable"):
+            assert key in half, key
+        assert half["unschedulable"] == 0, half

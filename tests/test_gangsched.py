@@ -151,12 +151,12 @@ class TestAnnotationContract:
 
     def test_signature_components(self):
         p = gang_pod("a", "job-1", min_size=3, same_zone=True)
-        assert pod_gang_sig(p) == ("job-1", 3, True, False)
+        assert pod_gang_sig(p) == ("job-1", 3, True, False, None)
 
     def test_garbage_min_size_defaults_to_whole_group(self):
         p = gang_pod("a", "job-1")
         p.metadata.annotations[GANG_MIN_SIZE_ANNOTATION] = "not-a-number"
-        assert pod_gang_sig(p) == ("job-1", 0, False, False)
+        assert pod_gang_sig(p) == ("job-1", 0, False, False, None)
         assert gang_min_count([p, gang_pod("b", "job-1")]) == 2
 
     def test_min_count_resolves_largest_declared_capped_at_size(self):
@@ -259,7 +259,7 @@ class TestSnapshotSplit:
         classes = group_pods([a, b])
         assert len(classes) == 2
         gangs = [c.gang for c in classes]
-        assert None in gangs and ("job-1", 0, False, False) in gangs
+        assert None in gangs and ("job-1", 0, False, False, None) in gangs
 
     def test_default_pods_share_the_pre_gang_signature(self):
         """The off-by-default contract's root: a default-tier gang-free

@@ -22,6 +22,7 @@ from types import SimpleNamespace
 
 import pytest
 
+from karpenter_core_tpu.api import labels as apilabels
 from karpenter_core_tpu.api.objects import (
     Node,
     NodeStatus,
@@ -515,6 +516,166 @@ class TestInvariantMonitor:
         )
         assert [v.invariant for v in fresh] == ["pod_conservation"]
         assert "pending" in fresh[0].detail
+
+
+# ---------------------------------------------------------------------------
+# topology-aware gangs (topoaware, ISSUE 20): distance-bound monitor,
+# ledger hop accounting, and the racked closed loop
+# ---------------------------------------------------------------------------
+
+
+def _topo_node(name: str, zone: str, rack: str, cpu: float = 32.0) -> Node:
+    node = _node(name, cpu=cpu)
+    node.metadata.labels = {
+        apilabels.LABEL_TOPOLOGY_ZONE: zone,
+        apilabels.LABEL_TOPOLOGY_SUPERPOD: f"{zone}-s0",
+        apilabels.LABEL_TOPOLOGY_RACK: rack,
+    }
+    return node
+
+
+def _bounded_gang(max_hops: int = 0):
+    """One 4-member gang declaring a hard hop bound, via the same wave
+    generator the twin runs (annotations are the production contract)."""
+    wave = WorkloadWave(
+        at=0.0, cluster=0, kind="training", count=4, gang_size=4,
+        max_hops=max_hops,
+    )
+    pods, _ = pods_for_wave(wave, "t0", seed=0)
+    return pods
+
+
+class TestGangDistanceMonitor:
+    def test_bound_exceeding_placement_flags(self):
+        op, store = _stub_op()
+        # two racks in two ZONES: provable 3 hops against a 0-hop bound
+        store.create(_topo_node("n1", "zone-a", "zone-a-r0"))
+        store.create(_topo_node("n2", "zone-b", "zone-b-r0"))
+        live = {}
+        for i, pod in enumerate(_bounded_gang(max_hops=0)):
+            store.create(pod)
+            store.bind(store.get(Pod, pod.name), f"n{1 + i % 2}")
+            live[pod.name] = pod
+        monitor = InvariantMonitor()
+        fresh = monitor.check(TWIN_EPOCH + 1.0, [op], {0: live})
+        assert [v.invariant for v in fresh] == ["gang_distance"]
+        assert "max-hops bound 0" in fresh[0].detail
+
+    def test_placement_within_bound_is_clean(self):
+        op, store = _stub_op()
+        store.create(_topo_node("n1", "zone-a", "zone-a-r0"))
+        store.create(_topo_node("n2", "zone-a", "zone-a-r0"))
+        live = {}
+        for i, pod in enumerate(_bounded_gang(max_hops=0)):
+            store.create(pod)
+            store.bind(store.get(Pod, pod.name), f"n{1 + i % 2}")
+            live[pod.name] = pod
+        monitor = InvariantMonitor()
+        assert monitor.check(TWIN_EPOCH + 1.0, [op], {0: live}) == []
+
+    def test_missing_rack_labels_skip_soundly(self):
+        # rack-less nodes are unattributable: the sound bound must SKIP
+        # them (soundness over completeness), never manufacture a
+        # violation out of missing labels — even spanning two zones
+        op, store = _stub_op()
+        store.create(_node("n1"))
+        store.create(_node("n2"))
+        live = {}
+        for i, pod in enumerate(_bounded_gang(max_hops=0)):
+            store.create(pod)
+            store.bind(store.get(Pod, pod.name), f"n{1 + i % 2}")
+            live[pod.name] = pod
+        monitor = InvariantMonitor()
+        assert monitor.check(TWIN_EPOCH + 1.0, [op], {0: live}) == []
+
+    def test_undeclared_bound_never_flags_distance(self):
+        op, store = _stub_op()
+        store.create(_topo_node("n1", "zone-a", "zone-a-r0"))
+        store.create(_topo_node("n2", "zone-b", "zone-b-r0"))
+        live = {}
+        for i, pod in enumerate(_bounded_gang(max_hops=-1)):
+            store.create(pod)
+            store.bind(store.get(Pod, pod.name), f"n{1 + i % 2}")
+            live[pod.name] = pod
+        monitor = InvariantMonitor()
+        assert monitor.check(TWIN_EPOCH + 1.0, [op], {0: live}) == []
+
+
+def _topo_scenario(**overrides) -> Scenario:
+    """Racked closed loop: a comms-sensitive training gang (hard hop
+    bound + member ranks) competing with serving replicas under a PDB,
+    on a catalog whose nodes carry deterministic rack labels."""
+    base = dict(
+        seed=9,
+        clusters=1,
+        duration=150.0,
+        tick=30.0,
+        solver="tpu",
+        rack_size=2,
+        waves=(
+            WorkloadWave(at=0.0, cluster=0, kind="training", count=6,
+                         gang_size=6, cpu=4.0, priority=100, max_hops=1),
+            WorkloadWave(at=30.0, cluster=0, kind="serving", count=12,
+                         min_available=2),
+        ),
+    )
+    base.update(overrides)
+    return Scenario(**base)
+
+
+class TestTopoAwareTwin:
+    def test_racked_run_respects_hard_bound_and_records_hops(self):
+        result = run_scenario(_topo_scenario())
+        # zero violations INCLUDES gang_distance and verifier_rejection:
+        # the hard bound held at every stable tick and no accepted result
+        # was rejected server-side
+        assert result.violations == []
+        assert result.counters["result_rejected"] == 0
+        ledger = result.ledger.encode()
+        # non-vacuous: the gang actually bound (all 6 members)
+        assert ledger["slo"]["training"]["n"] == 6
+        # ...and the hop accounting saw it: a recorded peak within the
+        # declared bound (rack_size=2 packs the gang's nodes co-located)
+        assert ledger["gang_max_hops"]["0"] <= 1
+        assert ledger["straggler_gang_ticks"] == 0
+
+    def test_rackless_run_ledger_keys_stay_constant(self):
+        # off-by-default: without rack labels there is nothing to
+        # attribute, so legacy scenarios' ledgers gain only constant keys
+        result = run_scenario(_topo_scenario(
+            rack_size=0,
+            waves=(
+                WorkloadWave(at=0.0, cluster=0, kind="training", count=6,
+                             gang_size=6, cpu=4.0, priority=100),
+            ),
+        ))
+        assert result.violations == []
+        ledger = result.ledger.encode()
+        assert ledger["gang_max_hops"] == {}
+        assert ledger["straggler_gang_ticks"] == 0
+
+    def test_racked_run_is_byte_deterministic(self):
+        scenario = _topo_scenario()
+        a = run_scenario(scenario)
+        b = run_scenario(scenario)
+        assert a.trace_json() == b.trace_json()
+        assert a.ledger_json() == b.ledger_json()
+
+    def test_max_hops_round_trips_and_validates(self):
+        s = _topo_scenario()
+        back = scenario_from_json(scenario_to_json(s))
+        assert back == s
+        assert back.waves[0].max_hops == 1 and back.rack_size == 2
+        with pytest.raises(ValueError, match="max_hops"):
+            run_scenario(_topo_scenario(waves=(
+                WorkloadWave(at=0.0, cluster=0, kind="training", count=4,
+                             gang_size=4, max_hops=9),
+            )))
+        with pytest.raises(ValueError, match="training"):
+            run_scenario(_topo_scenario(waves=(
+                WorkloadWave(at=0.0, cluster=0, kind="batch", count=4,
+                             max_hops=1),
+            )))
 
 
 # ---------------------------------------------------------------------------

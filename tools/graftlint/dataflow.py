@@ -66,6 +66,9 @@ _MESH_SHARDERS = {
     "batched_slot_shardings", "batched_step_shardings",
     "gang_plane_shardings", "batched_gang_plane_shardings",
     "relax_plane_shardings",
+    # topoaware (ISSUE 20): slot-axis sharding for the per-class hop
+    # planes (ClassStep.topo_rank and friends)
+    "topo_plane_shardings",
     # the pallas fused kernels' placement route (ISSUE 18): whole-plane
     # replication ahead of the GSPMD-opaque pallas_call boundary
     "pallas_slot_shardings",
@@ -440,10 +443,14 @@ NARROW_INT_DTYPES = frozenset({"int8", "int16", "int32"})
 # grants the CLAMPED guard — the sanctioned way through a GL601 narrowing
 # store. utils/disruption.priority_tier is THE tier normalizer (kernel /
 # fallback / verifier all ride it); codec._clamp_slots is the decode-net
-# clamp for the wire's slot ceiling.
+# clamp for the wire's slot ceiling; solver/gangs.gang_rank and
+# gang_max_hops (topoaware, ISSUE 20) are the annotation-parse clamps a
+# hostile wire rank/max-hops int must pass before any int32 plane store.
 RANGE_NORMALIZERS: Dict[str, tuple] = {
     "priority_tier": (-(2 ** 31 - 1), 2 ** 31 - 1),
     "_clamp_slots": (1, 1 << 20),
+    "gang_rank": (0, 1 << 20),
+    "gang_max_hops": (0, 3),
 }
 
 # calls whose result is explicitly clipped: (lo-arg index, hi-arg index)
